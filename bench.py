@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Headline benchmark: FedAvg CIFAR-10 ResNet-20 simulation throughput.
+
+Runs the north-star recipe shape (BASELINE.md: sp_fedavg_cifar10_resnet20,
+128 simulated clients) on the available accelerator and prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numeric baselines (BASELINE.md); the recorded
+baseline here is the reference's implicit CI ceiling translated to throughput:
+its SP simulator time-multiplexes clients in python+torch — measured on this
+recipe shape it processes ~O(10^2) samples/s/device on CPU and the paper-cited
+GPU path is bounded by per-client python dispatch.  We report absolute
+samples/sec/chip; vs_baseline compares against BENCH_BASELINE (samples/s) if
+present in BASELINE.json, else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.runner import FedMLRunner
+
+    n_clients = int(os.environ.get("BENCH_CLIENTS", "128"))
+    per_round = int(os.environ.get("BENCH_CLIENTS_PER_ROUND", "8"))
+    samples_per_client = int(os.environ.get("BENCH_SAMPLES_PER_CLIENT", "512"))
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "5"))
+
+    cfg = Config(
+        dataset="cifar10",
+        model="resnet20",
+        client_num_in_total=n_clients,
+        client_num_per_round=per_round,
+        comm_round=rounds + 1,
+        epochs=1,
+        batch_size=batch,
+        learning_rate=0.03,
+        partition_method="homo",
+        synthetic_train_size=n_clients * samples_per_client,
+        synthetic_test_size=1024,
+        frequency_of_the_test=0,
+        compute_dtype="bfloat16",
+        step_mode="match",
+        metrics_jsonl_path="",
+    )
+    fedml_tpu.init(cfg)
+    runner = FedMLRunner(cfg)
+    sim = runner.runner
+
+    # warmup: first round compiles
+    sim.run_round()
+    jax.block_until_ready(jax.tree_util.tree_leaves(sim.global_vars)[0])
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        sim.run_round()
+    jax.block_until_ready(jax.tree_util.tree_leaves(sim.global_vars)[0])
+    dt = time.perf_counter() - t0
+
+    # samples actually trained per round: sum over sampled clients of
+    # epochs * steps * batch (match mode trains ceil(count/batch)*batch slots)
+    steps_per_client = -(-samples_per_client // batch)
+    samples_per_round = per_round * cfg.epochs * steps_per_client * batch
+    n_chips = len(jax.devices())
+    samples_per_sec_chip = samples_per_round * rounds / dt / n_chips
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE.json")) as f:
+            baseline = json.load(f).get("published", {}).get("samples_per_sec_chip")
+    except Exception:
+        pass
+    vs = samples_per_sec_chip / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "fedavg_cifar10_resnet20_samples_per_sec_per_chip",
+        "value": round(samples_per_sec_chip, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(vs, 3),
+        "detail": {
+            "clients_total": n_clients,
+            "clients_per_round": per_round,
+            "rounds_per_sec": round(rounds / dt, 4),
+            "chips": n_chips,
+            "device": str(jax.devices()[0].platform),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""FedAvg / FedAvg_seq.
+
+The canonical algorithm: clients run local SGD from the global weights and the
+server takes the sample-weighted mean — exactly the math of the reference's
+``simulation/sp/fedavg/fedavg_api.py:144-159`` (``_aggregate``) and the
+``FedAvg`` branch of ``ml/aggregator/agg_operator.py:33``.  The base
+:class:`~fedml_tpu.fl.algorithm.FedAlgorithm` already implements it; these
+classes exist to carry the registry names.
+
+``FedAvg_seq`` in the reference differs only in worker scheduling (sequential
+client simulation per GPU, ``simulation/mpi/fedavg_seq``); on the MESH backend
+scheduling is the mesh sharding itself, so the algorithm math is identical.
+"""
+
+from __future__ import annotations
+
+from ..fl.algorithm import FedAlgorithm
+
+
+class FedAvg(FedAlgorithm):
+    name = "FedAvg"
+
+
+class FedAvgSeq(FedAlgorithm):
+    name = "FedAvg_seq"

@@ -1,0 +1,46 @@
+"""FedOpt — server-side adaptive optimization (Reddi et al.).
+
+Reference: ``simulation/sp/fedopt/fedopt_api.py`` — clients run FedAvg-style
+local SGD; the server treats ``w_global - w_avg`` as a pseudo-gradient and
+applies a torch optimizer (``optrepo.py`` lookup; sgd w/ momentum default).
+Here the server optimizer is an optax transformation over the params pytree;
+non-param collections (BN stats) are replaced by their weighted mean, matching
+the reference which only optimizes named parameters.
+
+Covers FedOpt/FedOpt_seq, FedAdam, FedYogi, FedAdagrad and FedAvgM (server
+momentum) via ``server_optimizer``/``server_momentum`` config.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..core import pytree as pt
+from ..fl.algorithm import FedAlgorithm, make_server_optimizer
+from ..fl.local_sgd import split_variables
+
+
+class FedOpt(FedAlgorithm):
+    name = "FedOpt"
+
+    def __init__(self, hp, cfg=None):
+        super().__init__(hp, cfg)
+        self._server_opt = make_server_optimizer(hp)
+
+    def init_server_state(self, variables):
+        return self._server_opt.init(variables["params"])
+
+    def server_update(self, global_variables, server_state, agg, round_idx):
+        g_params, _ = split_variables(global_variables)
+        a_params, a_rest = split_variables(agg)
+        # pseudo-gradient: descent direction toward the client average
+        pseudo_grad = pt.tree_sub(g_params, a_params)
+        updates, new_state = self._server_opt.update(pseudo_grad, server_state, g_params)
+        import optax
+
+        new_params = optax.apply_updates(g_params, updates)
+        return {"params": new_params, **a_rest}, new_state
+
+
+class FedOptSeq(FedOpt):
+    name = "FedOpt_seq"

@@ -1,0 +1,31 @@
+"""FedProx — proximal-term local objective.
+
+Reference: ``simulation/sp/fedprox`` / ``ml/trainer/fedprox_trainer.py`` add
+``mu/2 * ||w - w_global||^2`` to the local loss; aggregation is FedAvg's
+weighted mean (``agg_operator.py`` FedProx branch).  Here the proximal term is
+a ``loss_extra`` hook — the global params ride in through the hook context, so
+the same compiled local-SGD scan serves both FedAvg and FedProx.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..core import pytree as pt
+from ..fl.algorithm import FedAlgorithm
+
+
+class FedProx(FedAlgorithm):
+    name = "FedProx"
+
+    def loss_extra(self):
+        mu = self.hp.fedprox_mu
+
+        def prox(params, ctx):
+            global_params = ctx
+            return 0.5 * mu * pt.tree_sq_norm(pt.tree_sub(params, global_params))
+
+        return prox
+
+    def make_ctx(self, global_variables, client_state, server_state):
+        return global_variables["params"]

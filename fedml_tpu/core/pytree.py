@@ -1,0 +1,145 @@
+"""Pytree parameter utilities.
+
+The reference manipulates ``OrderedDict`` torch state_dicts with explicit
+python loops (e.g. the weighted-average loop in
+``simulation/sp/fedavg/fedavg_api.py:144-159`` and the per-optimizer branches
+of ``ml/aggregator/agg_operator.py:33-135``).  Here model/optimizer state is a
+JAX pytree and every one of those loops becomes a single ``jax.tree_util.tree_map``
+— which XLA fuses into a handful of elementwise kernels on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def tree_zeros_like(tree: Pytree) -> Pytree:
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def tree_add(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def tree_sub(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree_util.tree_map(jnp.subtract, a, b)
+
+
+def tree_scale(tree: Pytree, s) -> Pytree:
+    return jax.tree_util.tree_map(lambda x: x * s, tree)
+
+
+def tree_axpy(a, x: Pytree, y: Pytree) -> Pytree:
+    """a * x + y, elementwise over the tree."""
+    return jax.tree_util.tree_map(lambda xi, yi: a * xi + yi, x, y)
+
+
+def tree_dot(a: Pytree, b: Pytree) -> jax.Array:
+    """Inner product over all leaves (f32 accumulation)."""
+    leaves = jax.tree_util.tree_map(
+        lambda x, y: jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32)), a, b
+    )
+    return jax.tree_util.tree_reduce(jnp.add, leaves, jnp.float32(0.0))
+
+
+def tree_sq_norm(tree: Pytree) -> jax.Array:
+    return tree_dot(tree, tree)
+
+
+def tree_norm(tree: Pytree) -> jax.Array:
+    return jnp.sqrt(tree_sq_norm(tree))
+
+
+def tree_size(tree: Pytree) -> int:
+    """Total number of scalar parameters (static python int)."""
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_cast(tree: Pytree, dtype) -> Pytree:
+    return jax.tree_util.tree_map(lambda x: x.astype(dtype), tree)
+
+
+def tree_weighted_mean(stacked: Pytree, weights: jax.Array) -> Pytree:
+    """Weighted mean over a leading "clients" axis.
+
+    ``stacked`` has leaves of shape ``(n, *leaf_shape)``; ``weights`` is
+    ``(n,)`` and is normalised internally.  This is the TPU-native form of the
+    reference's ``_aggregate`` loop (``fedavg_api.py:144-159``): one fused
+    reduction instead of a python loop over parameter keys and clients.
+    """
+    w = weights.astype(jnp.float32)
+    w = w / jnp.maximum(jnp.sum(w), 1e-12)
+
+    def avg(leaf):
+        wb = w.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return jnp.sum(leaf.astype(jnp.float32) * wb, axis=0).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map(avg, stacked)
+
+
+def tree_stack(trees: Sequence[Pytree]) -> Pytree:
+    """Stack a list of identically-structured trees along a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def tree_unstack(stacked: Pytree, n: int) -> list[Pytree]:
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def tree_take(stacked: Pytree, idx: jax.Array) -> Pytree:
+    """Gather a subset of the leading axis (client-sampling primitive).
+
+    Device-side gather so per-round client sampling does not retrace
+    (SURVEY.md §7 hard part 2).
+    """
+    return jax.tree_util.tree_map(lambda x: jnp.take(x, idx, axis=0), stacked)
+
+
+def tree_flatten_to_vector(tree: Pytree) -> tuple[jax.Array, Callable[[jax.Array], Pytree]]:
+    """Flatten a pytree into one f32 vector + an unravel closure.
+
+    Wire-format and defense primitives (Krum distances, norm clipping) operate
+    on flat vectors; this is the pytree analogue of the reference's
+    ``vectorize_weight`` helpers in ``core/security/defense/defense_base.py``.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(l.size) for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves]) if leaves else jnp.zeros((0,), jnp.float32)
+
+    def unravel(vec: jax.Array) -> Pytree:
+        out = []
+        offset = 0
+        for shape, size, dtype in zip(shapes, sizes, dtypes):
+            out.append(vec[offset : offset + size].reshape(shape).astype(dtype))
+            offset += size
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return flat, unravel
+
+
+def stacked_tree_to_matrix(stacked: Pytree) -> jax.Array:
+    """(n, *) stacked client trees -> (n, d) f32 matrix (for defenses)."""
+    leaves = jax.tree_util.tree_leaves(stacked)
+    n = leaves[0].shape[0]
+    return jnp.concatenate([l.reshape(n, -1).astype(jnp.float32) for l in leaves], axis=1)
+
+
+def matrix_to_stacked_tree(mat: jax.Array, template_stacked: Pytree) -> Pytree:
+    """Inverse of :func:`stacked_tree_to_matrix` using a stacked template."""
+    leaves, treedef = jax.tree_util.tree_flatten(template_stacked)
+    n = mat.shape[0]
+    out = []
+    offset = 0
+    for l in leaves:
+        size = int(l.size // n)
+        out.append(mat[:, offset : offset + size].reshape(l.shape).astype(l.dtype))
+        offset += size
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,74 @@
+"""RNG discipline.
+
+The reference seeds python/numpy/torch globally once (``python/fedml/__init__.py:105-110``)
+and re-seeds numpy per round for client sampling
+(``simulation/sp/fedavg/fedavg_api.py:132`` — ``np.random.seed(round_idx)``).
+Global mutable seeds do not compose with JAX tracing, so here every source of
+randomness is an explicit ``jax.random`` key derived by pure folding:
+
+    root key  --fold(round)--> round key --fold(client)--> client key
+
+which makes every client/round stream reproducible and independent of execution
+order, device count, or sharding layout — the property that lets the MESH
+backend and the sequential SP backend produce identical streams.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def round_key(key: jax.Array, round_idx) -> jax.Array:
+    return jax.random.fold_in(key, round_idx)
+
+
+def client_key(key: jax.Array, client_idx) -> jax.Array:
+    # Disjoint stream per client: fold with an offset tag so that
+    # client_key(round_key(k, r), c) never collides with round_key(k, r').
+    return jax.random.fold_in(jax.random.fold_in(key, 0x636C69), client_idx)
+
+
+def sample_clients(
+    key: jax.Array, round_idx, client_num_in_total: int, client_num_per_round: int
+) -> jax.Array:
+    """Sample a per-round subset of client indices, without replacement.
+
+    Matches the semantics (not the bit-stream) of the reference's
+    ``_client_sampling`` (``fedavg_api.py:127-141``): if all clients fit, take
+    everyone; else a uniform subset seeded by the round index.  Runs inside jit
+    (permutation + static slice), so sampling never triggers a retrace
+    (SURVEY.md §7 hard part 2).
+    """
+    if client_num_in_total <= client_num_per_round:
+        return jnp.arange(client_num_in_total, dtype=jnp.int32)
+    k = round_key(key, round_idx)
+    perm = jax.random.permutation(k, client_num_in_total)
+    return perm[:client_num_per_round].astype(jnp.int32)
+
+
+def sample_clients_np(seed_round: int, client_num_in_total: int, client_num_per_round: int) -> np.ndarray:
+    """Bit-exact replica of the reference's sampler for parity tests:
+    ``np.random.seed(round_idx); np.random.choice(range(n), m, replace=False)``
+    (``simulation/sp/fedavg/fedavg_api.py:127-141``)."""
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total, dtype=np.int64)
+    rs = np.random.RandomState(seed_round)
+    return np.array(rs.choice(range(client_num_in_total), client_num_per_round, replace=False))
+
+
+def seed_everything(seed: int) -> None:
+    """Seed host-side numpy/python RNGs (data partitioning, shuffling).
+
+    Device-side randomness never touches these — it flows through explicit
+    keys above.  Mirrors reference ``__init__.py:105-110`` minus torch.
+    """
+    import random
+
+    random.seed(seed)
+    np.random.seed(seed)

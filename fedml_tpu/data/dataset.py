@@ -1,0 +1,145 @@
+"""Federated dataset containers.
+
+The reference's loaders all return the 8-tuple
+``[train_num, test_num, train_global, test_global, local_num_dict,
+train_local_dict, test_local_dict, class_num]`` of torch DataLoaders
+(``data/data_loader.py:234``).  Torch dataloaders are host-side iterators; a
+TPU round wants **device-resident, statically-shaped** arrays.  So the native
+container is :class:`FederatedDataset` (global arrays + per-client index
+lists), and :func:`stack_clients` turns it into the padded
+``(n_clients, capacity, ...)`` arrays + sample-count vector that the jitted
+round consumes (SURVEY.md §7 hard part 1: ragged shards -> pad + mask).
+
+``as_reference_tuple`` provides the 8-tuple shape (with numpy batch iterators
+standing in for DataLoaders) for API-parity consumers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+@dataclass
+class FederatedDataset:
+    train_x: np.ndarray  # (N_train, ...) float32 features
+    train_y: np.ndarray  # (N_train,) int labels (or multi-hot for *_lr tasks)
+    test_x: np.ndarray
+    test_y: np.ndarray
+    client_idx: list  # list[np.ndarray] — per-client train sample indices
+    class_num: int
+    test_client_idx: Optional[list] = None  # per-client test split (LEAF-style)
+    name: str = ""
+
+    @property
+    def n_clients(self) -> int:
+        return len(self.client_idx)
+
+    @property
+    def train_num(self) -> int:
+        return int(self.train_x.shape[0])
+
+    @property
+    def test_num(self) -> int:
+        return int(self.test_x.shape[0])
+
+    def local_sample_counts(self) -> np.ndarray:
+        return np.array([len(ix) for ix in self.client_idx], dtype=np.int32)
+
+
+@dataclass
+class StackedClientData:
+    """Padded per-client arrays: the device-side form of the dataset.
+
+    ``x``: (n_clients, capacity, *feat) — client shards padded to ``capacity``
+    ``y``: (n_clients, capacity)
+    ``counts``: (n_clients,) true sample counts (the FedAvg weights)
+    Padding samples are repeats of real samples; the mask (position < count is
+    not used — instead batches are drawn by modular indexing over the true
+    count, see ``sim.engine``), so no gradient correction is needed.
+    """
+
+    x: np.ndarray
+    y: np.ndarray
+    counts: np.ndarray
+
+    @property
+    def n_clients(self) -> int:
+        return int(self.x.shape[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.x.shape[1])
+
+
+def stack_clients(
+    ds: FederatedDataset, capacity: Optional[int] = None, multiple_of: int = 1
+) -> StackedClientData:
+    """Pad client shards to a common capacity by cyclic repetition.
+
+    Cyclic repetition (rather than zero-padding) keeps every slot a valid
+    sample, so fixed-size batches can index ``(perm % count)`` without masks;
+    weighting by true ``counts`` preserves the reference's sample-weighted
+    FedAvg math exactly.
+
+    ``multiple_of`` (typically the batch size) rounds the capacity up so the
+    local-SGD scan's fixed-size batch slices always fit exactly.
+    """
+    counts = ds.local_sample_counts()
+    cap = int(capacity if capacity is not None else counts.max())
+    if multiple_of > 1:
+        cap = ((cap + multiple_of - 1) // multiple_of) * multiple_of
+    n = ds.n_clients
+    x = np.empty((n, cap) + ds.train_x.shape[1:], dtype=ds.train_x.dtype)
+    y = np.empty((n, cap) + ds.train_y.shape[1:], dtype=ds.train_y.dtype)
+    for i, idxs in enumerate(ds.client_idx):
+        if len(idxs) == 0:
+            raise ValueError(f"client {i} has no samples")
+        reps = np.resize(idxs, cap)  # cyclic repeat to capacity
+        x[i] = ds.train_x[reps]
+        y[i] = ds.train_y[reps]
+    return StackedClientData(x=x, y=y, counts=counts)
+
+
+def pad_eval_set(x: np.ndarray, y: np.ndarray, batch_size: int):
+    """Tile an eval set up to a batch multiple (>= one full batch).
+
+    Returns (x_padded, y_padded, n_valid); eval masks positions >= n_valid.
+    np.resize-style tiling handles sets smaller than one batch.
+    """
+    n = x.shape[0]
+    target = max(batch_size, ((n + batch_size - 1) // batch_size) * batch_size)
+    if target != n:
+        reps = np.resize(np.arange(n), target)
+        x, y = x[reps], y[reps]
+    return x, y, n
+
+
+def batch_iterator(x: np.ndarray, y: np.ndarray, batch_size: int, seed: int = 0, shuffle: bool = True) -> Iterator:
+    n = x.shape[0]
+    order = np.random.RandomState(seed).permutation(n) if shuffle else np.arange(n)
+    for s in range(0, n, batch_size):
+        sel = order[s : s + batch_size]
+        yield x[sel], y[sel]
+
+
+def as_reference_tuple(ds: FederatedDataset, batch_size: int):
+    """Reference 8-tuple shape (``data/data_loader.py:234``), numpy iterators
+    in place of torch DataLoaders."""
+    train_global = list(batch_iterator(ds.train_x, ds.train_y, batch_size, shuffle=False))
+    test_global = list(batch_iterator(ds.test_x, ds.test_y, batch_size, shuffle=False))
+    local_num = {i: len(ix) for i, ix in enumerate(ds.client_idx)}
+    train_local = {
+        i: list(batch_iterator(ds.train_x[ix], ds.train_y[ix], batch_size, shuffle=False))
+        for i, ix in enumerate(ds.client_idx)
+    }
+    if ds.test_client_idx is not None:
+        test_local = {
+            i: list(batch_iterator(ds.test_x[ix], ds.test_y[ix], batch_size, shuffle=False))
+            for i, ix in enumerate(ds.test_client_idx)
+        }
+    else:
+        test_local = {i: test_global for i in range(ds.n_clients)}
+    return [ds.train_num, ds.test_num, train_global, test_global, local_num, train_local, test_local, ds.class_num]

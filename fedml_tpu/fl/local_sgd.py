@@ -1,0 +1,223 @@
+"""Local training as a jitted scan — the TPU form of ``ClientTrainer.train``.
+
+The reference's local loop (``ml/trainer/my_model_trainer_classification.py:21``)
+is epochs x minibatches of torch fwd/bwd/step on one device.  Here the same
+loop is ``lax.scan`` over ``epochs * steps_per_epoch`` steps of an optax
+update, so XLA compiles ONE program per round and the whole client dimension
+vmaps/shards over the mesh (SURVEY.md §3.1 "hot loops -> jit(scan)").
+
+Ragged client shards (SURVEY.md §7 hard part 1) are handled by:
+- cyclic-padded shards (every slot is a real sample, see ``data.dataset``),
+- per-epoch permutations for shuffled epoch semantics,
+- ``step_mode="match"``: steps beyond a client's own budget
+  ``epochs * ceil(count/batch)`` are masked to no-ops, reproducing the
+  reference's per-client step counts while keeping shapes static.
+
+Algorithm customisation is via two pure hooks (closed over at build time):
+``loss_extra(params, global_params, ctx)`` (FedProx/FedDyn terms) and
+``grad_hook(grads, ctx)`` (SCAFFOLD/Mime corrections).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core import pytree as pt
+from .losses import get_loss_fn
+from .types import HParams
+
+
+def make_optimizer(hp: HParams) -> optax.GradientTransformation:
+    if hp.client_optimizer == "sgd":
+        chain = []
+        if hp.weight_decay:
+            chain.append(optax.add_decayed_weights(hp.weight_decay))
+        chain.append(optax.sgd(hp.learning_rate, momentum=hp.momentum or None))
+        return optax.chain(*chain)
+    if hp.client_optimizer == "adam":
+        return optax.adamw(hp.learning_rate, weight_decay=hp.weight_decay)
+    raise ValueError(f"unknown client optimizer {hp.client_optimizer!r}")
+
+
+def split_variables(variables: dict) -> tuple[Any, dict]:
+    """Split flax variables into (params, rest-collections e.g. batch_stats)."""
+    params = variables["params"]
+    rest = {k: v for k, v in variables.items() if k != "params"}
+    return params, rest
+
+
+def make_local_train_fn(
+    model,
+    hp: HParams,
+    loss_extra: Optional[Callable] = None,
+    grad_hook: Optional[Callable] = None,
+):
+    """Build ``local_train(variables, x, y, count, key, ctx) -> (new_variables, metrics)``.
+
+    ``ctx`` is an arbitrary pytree threaded to the hooks (global params,
+    control variates, server momentum...).  All shapes static; jit/vmap-safe.
+    """
+    if hp.steps_per_epoch <= 0:
+        raise ValueError(
+            "HParams.steps_per_epoch must be positive (got "
+            f"{hp.steps_per_epoch}); build it via algorithms.hparams_from_config"
+            "(cfg, steps_per_epoch=ceil(capacity/batch)) or the simulator, which"
+            " computes it from the stacked client capacity"
+        )
+    base_loss = get_loss_fn(hp.loss)
+    opt = make_optimizer(hp)
+    compute_dtype = jnp.bfloat16 if hp.compute_dtype == "bfloat16" else jnp.float32
+
+    def loss_fn(params, rest, x, y, dropout_key, ctx):
+        variables = {"params": params, **rest}
+        mutable = [k for k in rest.keys()]
+        x = x.astype(compute_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        if mutable:
+            logits, new_rest = model.apply(
+                variables, x, train=True, mutable=mutable, rngs={"dropout": dropout_key}
+            )
+        else:
+            logits = model.apply(variables, x, train=True, rngs={"dropout": dropout_key})
+            new_rest = rest
+        loss = base_loss(logits.astype(jnp.float32), y)
+        if loss_extra is not None:
+            loss = loss + loss_extra(params, ctx)
+        return loss, new_rest
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def local_train(variables: dict, x: jax.Array, y: jax.Array, count: jax.Array, key: jax.Array, ctx=None):
+        params, rest = split_variables(variables)
+        opt_state = opt.init(params)
+        cap = x.shape[0]
+        bsz = hp.batch_size
+        spe = hp.steps_per_epoch
+        total_steps = hp.epochs * spe
+        # per-client step budget (reference: epochs * ceil(len(local)/batch))
+        own_steps = hp.epochs * ((count + bsz - 1) // bsz)
+
+        def step(carry, s):
+            params, rest, opt_state = carry
+            epoch = s // spe
+            step_in_epoch = s % spe
+            ekey = jax.random.fold_in(key, epoch)
+            perm = jax.random.permutation(jax.random.fold_in(ekey, 1), cap)
+            idx = jax.lax.dynamic_slice_in_dim(perm, step_in_epoch * bsz, bsz)
+            bx = jnp.take(x, idx, axis=0)
+            by = jnp.take(y, idx, axis=0)
+            dkey = jax.random.fold_in(ekey, 2 + step_in_epoch)
+            (loss, new_rest), grads = grad_fn(params, rest, bx, by, dkey, ctx)
+            if grad_hook is not None:
+                grads = grad_hook(grads, ctx)
+            updates, new_opt = opt.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            if hp.step_mode == "match":
+                active = s < own_steps
+                new_params = _select_tree(active, new_params, params)
+                new_rest = _select_tree(active, new_rest, rest)
+                new_opt = _select_tree(active, new_opt, opt_state)
+                loss = jnp.where(active, loss, 0.0)
+                active_f = active.astype(jnp.float32)
+            else:
+                active_f = jnp.float32(1.0)
+            return (new_params, new_rest, new_opt), (loss, active_f)
+
+        (params, rest, _), (losses, actives) = jax.lax.scan(
+            step, (params, rest, opt_state), jnp.arange(total_steps)
+        )
+        n_active = jnp.maximum(jnp.sum(actives), 1.0)
+        metrics = {
+            "train_loss": jnp.sum(losses) / n_active,
+            "num_steps": n_active,
+            "num_samples": count.astype(jnp.float32),
+        }
+        return {"params": params, **rest}, metrics
+
+    return local_train
+
+
+def _select_tree(pred, on_true, on_false):
+    return jax.tree_util.tree_map(lambda t, f: jnp.where(pred, t, f), on_true, on_false)
+
+
+def make_full_grad_fn(model, hp: HParams):
+    """Gradient of the mean loss over a client's whole (cyclic-padded) shard,
+    at fixed variables — the FedSGD client step and Mime's ``grad f_i(x)``.
+    Batched scan; batch_stats frozen (inference statistics)."""
+    base_loss = get_loss_fn(hp.loss)
+    bsz = hp.batch_size
+
+    def full_grad(variables: dict, x: jax.Array, y: jax.Array, count: jax.Array, key: jax.Array):
+        params, rest = split_variables(variables)
+        cap = x.shape[0]
+        n_batches = cap // bsz
+
+        def loss_of(params, bx, by, dkey):
+            if rest:
+                logits, _ = model.apply(
+                    {"params": params, **rest}, bx, train=True,
+                    mutable=list(rest.keys()), rngs={"dropout": dkey},
+                )
+            else:
+                logits = model.apply({"params": params}, bx, train=True, rngs={"dropout": dkey})
+            return base_loss(logits.astype(jnp.float32), by)
+
+        gfn = jax.grad(loss_of)
+
+        def body(acc, i):
+            bx = jax.lax.dynamic_slice_in_dim(x, i * bsz, bsz)
+            by = jax.lax.dynamic_slice_in_dim(y, i * bsz, bsz)
+            g = gfn(params, bx, by, jax.random.fold_in(key, i))
+            return jax.tree_util.tree_map(jnp.add, acc, g), None
+
+        zero = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        acc, _ = jax.lax.scan(body, zero, jnp.arange(n_batches))
+        return jax.tree_util.tree_map(lambda g: g / jnp.maximum(n_batches, 1), acc)
+
+    return full_grad
+
+
+def make_eval_fn(model, hp: HParams, batch_size: int = 256):
+    """Global test eval: batched scan over a (padded) test set with a
+    validity mask; returns (loss, accuracy) — the TPU form of
+    ``ServerAggregator.test`` (``ml/aggregator/default_aggregator.py``)."""
+    base_loss = get_loss_fn(hp.loss)
+
+    def eval_fn(variables: dict, x: jax.Array, y: jax.Array, n_valid: jax.Array):
+        n = x.shape[0]
+        n_batches = n // batch_size
+
+        def body(carry, i):
+            loss_sum, correct, seen = carry
+            bx = jax.lax.dynamic_slice_in_dim(x, i * batch_size, batch_size)
+            by = jax.lax.dynamic_slice_in_dim(y, i * batch_size, batch_size)
+            pos = i * batch_size + jnp.arange(batch_size)
+            mask = (pos < n_valid).astype(jnp.float32)
+            logits = model.apply(variables, bx, train=False)
+            logits = logits.astype(jnp.float32)
+            if logits.ndim == by.ndim + 1:
+                per = optax.softmax_cross_entropy_with_integer_labels(logits, by)
+                pred_ok = (jnp.argmax(logits, -1) == by).astype(jnp.float32)
+                if per.ndim == 2:  # sequence task: mean over time
+                    per = per.mean(-1)
+                    pred_ok = pred_ok.mean(-1)
+            else:
+                per = optax.sigmoid_binary_cross_entropy(logits, by).mean(-1)
+                pred_ok = ((logits > 0) == (by > 0.5)).astype(jnp.float32).mean(-1)
+            return (
+                loss_sum + jnp.sum(per * mask),
+                correct + jnp.sum(pred_ok * mask),
+                seen + jnp.sum(mask),
+            ), None
+
+        (loss_sum, correct, seen), _ = jax.lax.scan(
+            body, (jnp.float32(0), jnp.float32(0), jnp.float32(0)), jnp.arange(n_batches)
+        )
+        seen = jnp.maximum(seen, 1.0)
+        return {"test_loss": loss_sum / seen, "test_acc": correct / seen}
+
+    return eval_fn

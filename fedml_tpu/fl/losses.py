@@ -1,0 +1,38 @@
+"""Loss and metric functions (pure, shape-polymorphic over task families).
+
+Replaces the reference's per-task trainer branches (CE for classification
+``my_model_trainer_classification.py``, NWP/seq CE, MSE regression in
+``my_model_trainer_regression.py``, BCE for tag prediction) with one dispatch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE.  Handles (B, C) + int (B,) and seq (B, T, C) + (B, T)."""
+    if logits.ndim == labels.ndim + 1:
+        return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    # multi-hot targets (stackoverflow_lr tag prediction)
+    return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+
+
+def mse(pred: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.mean((pred - target) ** 2)
+
+
+def accuracy_count(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Number of correct predictions (summable across shards/batches)."""
+    pred = jnp.argmax(logits, axis=-1)
+    return jnp.sum(pred == labels)
+
+
+def get_loss_fn(name: str):
+    if name == "cross_entropy":
+        return cross_entropy
+    if name == "mse":
+        return mse
+    raise ValueError(f"unknown loss {name!r}")

@@ -1,0 +1,44 @@
+"""Dataset-keyed model factory.
+
+Parity with the reference's ``model/model_hub.py:19`` (``create(args, output_dim)``):
+dispatch on ``(args.model, args.dataset)`` to a model instance.  Returns a
+flax.linen Module; parameter init happens in the trainer frame so the factory
+stays cheap and side-effect free.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..arguments import Config
+from . import resnet, rnn, simple
+
+
+def create(cfg: Config, output_dim: int) -> Any:
+    name = cfg.model.lower()
+    norm = getattr(cfg, "norm", "batch")
+    if name in ("lr", "logistic_regression"):
+        return simple.LogisticRegression(num_classes=output_dim)
+    if name in ("cnn", "cnn_dropout"):
+        only_digits = cfg.dataset in ("mnist", "fashionmnist")
+        return simple.FedAvgCNN(num_classes=output_dim, only_digits=only_digits)
+    if name in ("simple-cnn", "cifar_cnn", "cnn_web"):
+        return simple.CifarCNN(num_classes=output_dim)
+    if name == "mlp":
+        return simple.MLP(num_classes=output_dim)
+    if name == "resnet20":
+        return resnet.resnet20(output_dim, norm)
+    if name == "resnet32":
+        return resnet.resnet32(output_dim, norm)
+    if name == "resnet44":
+        return resnet.resnet44(output_dim, norm)
+    if name == "resnet56":
+        return resnet.resnet56(output_dim, norm)
+    if name in ("resnet18_gn", "resnet_gn"):
+        # BN-free escape hatch (reference model/cv/resnet_gn.py)
+        return resnet.resnet20(output_dim, "group")
+    if name in ("rnn", "char_lstm", "rnn_originalfedavg"):
+        return rnn.CharLSTM(vocab_size=output_dim)
+    if name in ("rnn_stackoverflow", "word_lstm"):
+        return rnn.WordLSTM(vocab_size=output_dim)
+    raise ValueError(f"unknown model {cfg.model!r} (dataset {cfg.dataset!r})")

@@ -1,0 +1,59 @@
+"""Character/word RNN models for the text FL benchmarks.
+
+Parity with the reference's ``model/nlp/rnn.py``: ``RNN_OriginalFedAvg``
+(shakespeare next-char: 8-dim embedding -> 2xLSTM(256) -> dense vocab) and
+``RNN_StackOverFlow`` (next-word prediction: embed(96) -> LSTM(670) -> dense).
+
+Implemented with ``nn.scan``-wrapped ``OptimizedLSTMCell`` so the sequence loop
+is a single XLA while/scan (compiler-friendly control flow), not a python loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class StackedLSTM(nn.Module):
+    hidden: int
+    layers: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (batch, seq, feat) -> (batch, seq, hidden)
+        for _ in range(self.layers):
+            cell = nn.OptimizedLSTMCell(self.hidden)
+            scan = nn.RNN(cell)
+            x = scan(x)
+        return x
+
+
+class CharLSTM(nn.Module):
+    """Shakespeare next-char model (``RNN_OriginalFedAvg``)."""
+
+    vocab_size: int = 90
+    embed_dim: int = 8
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        # tokens: (batch, seq) int32 -> logits (batch, seq, vocab)
+        x = nn.Embed(self.vocab_size, self.embed_dim)(tokens)
+        x = StackedLSTM(self.hidden, layers=2)(x)
+        return nn.Dense(self.vocab_size)(x)
+
+
+class WordLSTM(nn.Module):
+    """StackOverflow next-word model (``RNN_StackOverFlow``)."""
+
+    vocab_size: int = 10004
+    embed_dim: int = 96
+    hidden: int = 670
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        x = nn.Embed(self.vocab_size, self.embed_dim)(tokens)
+        x = StackedLSTM(self.hidden, layers=1)(x)
+        x = nn.Dense(self.embed_dim)(x)
+        return nn.Dense(self.vocab_size)(x)

@@ -1,0 +1,106 @@
+"""Small models: logistic regression, CNNs, MLP, GAN.
+
+Parity targets from the reference model zoo (``model/model_hub.py:19`` dispatch):
+- ``lr``        -> LogisticRegression (MNIST 784->10; ``model/linear/lr.py``)
+- ``cnn``       -> FedAvg-paper CNN for FeMNIST/MNIST (``model/cv/cnn.py``)
+- ``cnn_web``   / tag-prediction MLPs
+- mnist GAN (``model/gan/``) for the FedGAN algorithm.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class LogisticRegression(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)
+
+
+class FedAvgCNN(nn.Module):
+    """The McMahan-et-al FedAvg CNN (2x conv5x5 + 2 dense), as the reference's
+    ``CNN_DropOut`` (``model/cv/cnn.py``) used for FeMNIST/MNIST."""
+
+    num_classes: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        n_out = 10 if self.only_digits else self.num_classes
+        return nn.Dense(n_out)(x)
+
+
+class CifarCNN(nn.Module):
+    """Simple CIFAR CNN (reference ``model/cv/cnn.py`` CNN_WEB / simple-cnn)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(32, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class MLP(nn.Module):
+    """Tag-prediction / stackoverflow_lr style MLP over sparse features."""
+
+    hidden: int = 128
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class MnistGanGenerator(nn.Module):
+    """MNIST GAN generator (reference ``model/gan/`` for FedGan)."""
+
+    latent_dim: int = 100
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        x = nn.Dense(256)(z)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Dense(512)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Dense(784)(x)
+        return jnp.tanh(x).reshape((-1, 28, 28, 1))
+
+
+class MnistGanDiscriminator(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Dense(256)(x)
+        x = nn.leaky_relu(x, 0.2)
+        return nn.Dense(1)(x)

@@ -1,0 +1,77 @@
+"""Metrics / events / spans with pluggable sinks.
+
+TPU-native replacement for ``core/mlops`` (SURVEY.md §2.12/§5): the reference
+ships metrics over MQTT to a SaaS backend (``MLOpsMetrics``,
+``mlops_profiler_event.py:9``); here the same call shapes write to pluggable
+sinks — stdout, JSONL file, or an in-memory buffer (tests) — and spans use
+``jax.profiler`` trace annotations so they show up in TPU profiles.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+import jax
+
+log = logging.getLogger("fedml_tpu")
+
+
+class MetricsLogger:
+    """``mlops.log(...)`` equivalent (``core/mlops/__init__.py:172``)."""
+
+    def __init__(self, jsonl_path: Optional[str] = None, stdout: bool = True):
+        self.jsonl_path = jsonl_path
+        self.stdout = stdout
+        self.records: list[dict] = []
+        self._fh = open(jsonl_path, "a") if jsonl_path else None
+
+    def log(self, metrics: dict, step: Optional[int] = None) -> None:
+        rec = {k: (float(v) if hasattr(v, "__float__") else v) for k, v in metrics.items()}
+        if step is not None:
+            rec["step"] = step
+        rec["ts"] = time.time()
+        self.records.append(rec)
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        if self.stdout:
+            items = " ".join(
+                f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in rec.items() if k != "ts"
+            )
+            log.info("metrics %s", items)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class EventTracer:
+    """Span events (``MLOpsProfilerEvent`` ``mlops_profiler_event.py:9``):
+    ``started/ended`` pairs, mirrored into jax.profiler TraceAnnotation so
+    spans land in XLA device profiles."""
+
+    def __init__(self, logger: Optional[MetricsLogger] = None):
+        self.logger = logger
+        self.events: list[dict] = []
+
+    def log_event_started(self, name: str, value: Any = None) -> None:
+        self.events.append({"event": name, "phase": "started", "value": value, "ts": time.time()})
+
+    def log_event_ended(self, name: str, value: Any = None) -> None:
+        self.events.append({"event": name, "phase": "ended", "value": value, "ts": time.time()})
+
+    @contextmanager
+    def span(self, name: str, value: Any = None):
+        self.log_event_started(name, value)
+        with jax.profiler.TraceAnnotation(name):
+            try:
+                yield
+            finally:
+                self.log_event_ended(name, value)

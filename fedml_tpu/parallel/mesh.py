@@ -1,0 +1,96 @@
+"""Mesh construction for FL-on-TPU.
+
+The reference scales by spawning processes (MPI ranks, torchrun DDP groups,
+NCCL LocalAggregators — SURVEY.md §2.14 P1-P5).  Here the same strategies are
+expressed as axes of one ``jax.sharding.Mesh``:
+
+- simulation (P1-P3):  1-D ``("clients",)`` axis — each shard simulates a
+  subset of clients; aggregation is a mean over the stacked-client dim that
+  GSPMD lowers to an ICI all-reduce.
+- intra-silo DP (P4):  ``("data",)`` axis — batch-sharded local SGD.
+- hierarchical (P5):   2-D ``("silo", "data")`` — outer FL axis over DCN
+  (multi-slice), inner DP axis over ICI.
+- ZeRO-3 (P6):         parameter shardings over the ``data`` axis (GSPMD
+  handles gather/scatter natively).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_CLIENTS = "clients"
+AXIS_DATA = "data"
+AXIS_SILO = "silo"
+AXIS_MODEL = "model"  # tensor-parallel axis (beyond reference parity)
+AXIS_SEQ = "seq"  # context/sequence-parallel axis (ring attention)
+
+
+def make_mesh(
+    axis_names: Sequence[str] = (AXIS_CLIENTS,),
+    axis_sizes: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    If ``axis_sizes`` is None the first axis absorbs all devices.  Sizes may
+    use -1 for "remaining devices" (like a reshape).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if axis_sizes is None:
+        axis_sizes = [n] + [1] * (len(axis_names) - 1)
+    sizes = list(axis_sizes)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(axis_names, sizes))} needs {total} devices, have {n}")
+    dev_array = np.array(devs[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def parse_mesh_shape(spec: str) -> tuple[list[str], list[int]]:
+    """Parse ``"clients:8"`` / ``"silo:2,data:4"`` from Config.mesh_shape."""
+    names, sizes = [], []
+    for part in spec.split(","):
+        name, _, size = part.strip().partition(":")
+        names.append(name)
+        sizes.append(int(size) if size else -1)
+    return names, sizes
+
+
+def mesh_from_config(cfg, devices=None) -> Mesh:
+    if getattr(cfg, "mesh_shape", ""):
+        names, sizes = parse_mesh_shape(cfg.mesh_shape)
+        return make_mesh(names, sizes, devices)
+    return make_mesh((AXIS_CLIENTS,), None, devices)
+
+
+def client_sharding(mesh: Mesh, axis: str = AXIS_CLIENTS) -> NamedSharding:
+    """Sharding for arrays with a leading stacked-clients dimension."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_leading_axis(tree, mesh: Mesh, axis: str = AXIS_CLIENTS):
+    """Place a stacked pytree with its leading dim sharded over ``axis``."""
+    sh = client_sharding(mesh, axis)
+
+    def put(x):
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def replicate(tree, mesh: Mesh):
+    rep = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), tree)

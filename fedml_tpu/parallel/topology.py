@@ -1,0 +1,73 @@
+"""Decentralized-FL topologies (mixing matrices).
+
+TPU-native replacement for ``core/distributed/topology/`` in the reference:
+``SymmetricTopologyManager.generate_topology``
+(``symmetric_topology_manager.py:21``) builds a ring plus random
+Watts-Strogatz-style extra links and row-normalises; the asymmetric variant
+drops symmetry.  Here the topology is a dense ``(n, n)`` mixing matrix used by
+the decentralized algorithms (DSGD/PushSum) as a single matmul over stacked
+client models — a gossip step becomes ``W @ params_matrix`` on the MXU rather
+than per-neighbor message passing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def ring_topology(n: int, symmetric: bool = True) -> np.ndarray:
+    """Ring with self-loops, row-normalized (uniform over {self, prev, next})."""
+    W = np.zeros((n, n), dtype=np.float32)
+    for i in range(n):
+        W[i, i] = 1.0
+        W[i, (i - 1) % n] = 1.0
+        W[i, (i + 1) % n] = 1.0
+    if not symmetric:
+        for i in range(n):
+            W[i, (i - 1) % n] = 0.0
+    return W / W.sum(axis=1, keepdims=True)
+
+
+def symmetric_topology(n: int, neighbor_num: int, seed: int = 0) -> np.ndarray:
+    """Ring + random symmetric extra links, row-normalized.
+
+    Semantics of the reference's ``SymmetricTopologyManager`` (undirected ring
+    with ``neighbor_num`` target degree via random rewiring), deterministic in
+    ``seed`` instead of global numpy state.
+    """
+    rng = np.random.RandomState(seed)
+    A = np.zeros((n, n), dtype=np.float32)
+    for i in range(n):
+        A[i, i] = 1.0
+        A[i, (i - 1) % n] = 1.0
+        A[i, (i + 1) % n] = 1.0
+    extra = max(0, neighbor_num - 2)
+    for i in range(n):
+        candidates = [j for j in range(n) if j != i and A[i, j] == 0]
+        if not candidates:
+            continue
+        picks = rng.choice(candidates, size=min(extra, len(candidates)), replace=False)
+        for j in picks:
+            A[i, j] = 1.0
+            A[j, i] = 1.0  # keep symmetric
+    return A / A.sum(axis=1, keepdims=True)
+
+
+def asymmetric_topology(n: int, neighbor_num: int, seed: int = 0) -> np.ndarray:
+    """Directed ring + random out-links, row-normalized (PushSum-style)."""
+    rng = np.random.RandomState(seed)
+    A = np.zeros((n, n), dtype=np.float32)
+    for i in range(n):
+        A[i, i] = 1.0
+        A[i, (i + 1) % n] = 1.0
+        candidates = [j for j in range(n) if j != i and A[i, j] == 0]
+        extra = max(0, neighbor_num - 1)
+        if candidates and extra:
+            picks = rng.choice(candidates, size=min(extra, len(candidates)), replace=False)
+            for j in picks:
+                A[i, j] = 1.0
+    return A / A.sum(axis=1, keepdims=True)
+
+
+def fully_connected(n: int) -> np.ndarray:
+    return np.full((n, n), 1.0 / n, dtype=np.float32)

@@ -1,0 +1,94 @@
+"""FedMLRunner — platform dispatch.
+
+Reference: ``python/fedml/runner.py:19`` picks a platform runner from
+``args.training_type``/``args.backend``.  Same dispatch here; the simulation
+path constructs the MeshSimulator directly (no actor hierarchy to build).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import constants as C
+from .arguments import Config
+
+
+def _check_unimplemented_flags(cfg: Config) -> None:
+    """Security/privacy flags must never be silent no-ops: until the trust
+    stack handles a flag, enabling it is an error (silent absence of DP noise
+    or defenses is worse than a crash)."""
+    pending = [
+        name
+        for name in ("enable_attack", "enable_defense", "enable_dp", "enable_secagg", "enable_fhe", "enable_contribution")
+        if getattr(cfg, name, False) and name not in _IMPLEMENTED_TRUST_FLAGS
+    ]
+    if pending:
+        raise NotImplementedError(
+            f"trust features {pending} are enabled in the config but not yet "
+            "implemented in fedml_tpu; refusing to run without them"
+        )
+
+
+# updated as the trust stack lands
+_IMPLEMENTED_TRUST_FLAGS: set = set()
+
+
+class FedMLRunner:
+    def __init__(
+        self,
+        cfg: Config,
+        dataset=None,
+        model=None,
+        client_trainer=None,
+        server_aggregator=None,
+    ):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+        self.client_trainer = client_trainer
+        self.server_aggregator = server_aggregator
+        _check_unimplemented_flags(cfg)
+        if cfg.training_type == C.TRAINING_PLATFORM_SIMULATION:
+            self.runner = self._init_simulation_runner()
+        elif cfg.training_type == C.TRAINING_PLATFORM_CROSS_SILO:
+            self.runner = self._init_cross_silo_runner()
+        elif cfg.training_type == C.TRAINING_PLATFORM_CENTRALIZED:
+            self.runner = self._init_centralized_runner()
+        else:
+            raise ValueError(f"unsupported training_type {cfg.training_type!r}")
+
+    def _load_data_model(self):
+        if self.dataset is None:
+            from .data import loader
+
+            self.dataset = loader.load(self.cfg)
+        if self.model is None:
+            from .models import model_hub
+
+            self.model = model_hub.create(self.cfg, self.dataset.class_num)
+        return self.dataset, self.model
+
+    def _init_simulation_runner(self):
+        dataset, model = self._load_data_model()
+        from .sim.engine import MeshSimulator
+
+        return MeshSimulator(self.cfg, dataset, model, algorithm=self.client_trainer)
+
+    def _init_cross_silo_runner(self):
+        dataset, model = self._load_data_model()
+        try:
+            from .cross_silo import create_cross_silo_runner
+        except ImportError as e:
+            raise NotImplementedError(
+                "cross_silo platform is not yet available in this build"
+            ) from e
+        return create_cross_silo_runner(self.cfg, dataset, model)
+
+    def _init_centralized_runner(self):
+        dataset, model = self._load_data_model()
+        from .sim.centralized import CentralizedTrainer
+
+        return CentralizedTrainer(self.cfg, dataset, model)
+
+    def run(self):
+        return self.runner.run()

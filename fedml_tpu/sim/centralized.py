@@ -1,0 +1,67 @@
+"""Centralized (non-FL) trainer for baselines.
+
+Reference: ``python/fedml/centralized/centralized_trainer.py:9`` — plain
+centralized training used as an accuracy baseline.  Here: the same jitted
+local-SGD scan over the whole (un-partitioned) training set.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms import hparams_from_config
+from ..arguments import Config
+from ..core import rng
+from ..data.dataset import pad_eval_set
+from ..fl.local_sgd import make_eval_fn, make_local_train_fn
+from ..obs.metrics import MetricsLogger
+
+
+class CentralizedTrainer:
+    def __init__(self, cfg: Config, dataset, model):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+        n = dataset.train_x.shape[0]
+        spe = max(1, math.ceil(n / cfg.batch_size))
+        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        self._train = jax.jit(make_local_train_fn(model, self.hp))
+        eval_bs = min(256, max(32, cfg.test_batch_size))
+        tx, ty, n_valid = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
+        self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
+        self._eval = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
+        k0 = rng.root_key(cfg.random_seed)
+        self.variables = model.init(
+            {"params": jax.random.fold_in(k0, 1), "dropout": jax.random.fold_in(k0, 2)},
+            jnp.asarray(dataset.train_x[: cfg.batch_size]), train=True,
+        )
+        self.key = k0
+        self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
+
+    def run(self):
+        ds = self.dataset
+        n_real = ds.train_x.shape[0]
+        cap = self.hp.steps_per_epoch * self.cfg.batch_size
+        reps = np.resize(np.arange(n_real), cap)  # cyclic tile to batch multiple
+        x = jnp.asarray(ds.train_x[reps])
+        y = jnp.asarray(ds.train_y[reps])
+        n = jnp.int32(n_real)
+        history = []
+        for r in range(self.cfg.comm_round):
+            t0 = time.perf_counter()
+            self.variables, metrics = self._train(
+                self.variables, x, y, n, rng.round_key(self.key, r), None
+            )
+            out = {k: float(v) for k, v in metrics.items()}
+            out["round"] = r
+            out["round_time_s"] = time.perf_counter() - t0
+            ev = self._eval(self.variables, *self._test)
+            out.update({k: float(v) for k, v in ev.items()})
+            self.logger.log(out)
+            history.append(out)
+        return history

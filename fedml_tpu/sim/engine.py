@@ -1,0 +1,241 @@
+"""MeshSimulator — FL simulation as one sharded, jitted program per round.
+
+This subsumes the reference's three simulation backends (SURVEY.md §2.3):
+- SP sequential loop        (``simulation/sp/fedavg/fedavg_api.py:66-177``)
+- MPI worker processes      (``simulation/mpi/fedavg/FedAvgAPI.py``)
+- NCCL LocalAggregators     (``simulation/nccl/base_framework/common.py:129``)
+
+On TPU there is no actor system: the round IS a compiled function.
+
+    round(global_vars, server_state, client_states, round_idx, key):
+      sampled  = permutation-sample m of N client ids        (device-side)
+      shards   = gather client data + state by id            (jnp.take)
+      outputs  = vmap(algorithm.client_update) over clients  (sharded on mesh)
+      agg      = hooks(before_agg) -> algorithm.aggregate    (all-reduce)
+      global'  = algorithm.server_update(agg)
+      states'  = scatter refreshed client states back
+
+The ``clients`` mesh axis shards the vmapped dimension and the stacked client
+data/state, so local SGD runs on every chip in parallel and the weighted mean
+lowers to one ICI all-reduce — the reference's whole process/message machinery
+(bullets P1-P3 of SURVEY.md §2.14) collapses into sharding annotations.
+
+``backend="sp"`` runs the same pure functions in a host loop over clients
+(one jitted client_update at a time) — the numerics-regression twin of the
+reference's single-process simulator; tests assert MESH == SP.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import constants as C
+from ..algorithms import create as create_algorithm, hparams_from_config
+from ..arguments import Config
+from ..core import pytree as pt, rng
+from ..data.dataset import FederatedDataset, StackedClientData, pad_eval_set, stack_clients
+from ..fl.local_sgd import make_eval_fn
+from ..parallel import mesh as meshlib
+from ..obs.metrics import MetricsLogger
+
+
+class MeshSimulator:
+    def __init__(
+        self,
+        cfg: Config,
+        dataset: FederatedDataset,
+        model,
+        algorithm=None,
+        mesh=None,
+        client_hook: Optional[Callable] = None,
+        agg_hook: Optional[Callable] = None,
+        logger: Optional[MetricsLogger] = None,
+    ):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+        self.backend = cfg.backend_sim if cfg.backend_sim else C.SIMULATION_BACKEND_MESH
+        self.client_hook = client_hook  # (stacked_contributions, weights, key) -> same
+        self.agg_hook = agg_hook  # (stacked_contributions, weights, global_vars, key) -> (contribs, weights)
+        self.logger = logger or MetricsLogger(cfg.metrics_jsonl_path or None)
+
+        # ---- data: pad + stack, shard over the clients axis ----
+        stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
+        self.capacity = stacked.capacity
+        steps_per_epoch = max(1, math.ceil(self.capacity / cfg.batch_size))
+        self.hp = hparams_from_config(cfg, steps_per_epoch=steps_per_epoch)
+        self.algorithm = (algorithm or create_algorithm(cfg, self.hp)).build(model)
+
+        self.mesh = mesh if mesh is not None else meshlib.mesh_from_config(cfg)
+        self._data = self._place_data(stacked)
+        self.counts = jnp.asarray(stacked.counts)
+
+        # ---- model/state init ----
+        k0 = rng.root_key(cfg.random_seed)
+        sample_x = jnp.asarray(stacked.x[0, : cfg.batch_size])
+        self.global_vars = self.model.init(
+            {"params": jax.random.fold_in(k0, 1), "dropout": jax.random.fold_in(k0, 2)},
+            sample_x, train=True,
+        )
+        self.global_vars = meshlib.replicate(jax.device_get(self.global_vars), self.mesh)
+        self.server_state = self.algorithm.init_server_state(self.global_vars)
+        cs_template = self.algorithm.init_client_state(self.global_vars)
+        if cs_template is not None:
+            n = dataset.n_clients
+            stacked_cs = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), cs_template
+            )
+            self.client_states = meshlib.shard_leading_axis(stacked_cs, self.mesh)
+        else:
+            self.client_states = None
+
+        # ---- test data (tiled to eval batch multiple) ----
+        eval_bs = min(256, max(32, cfg.test_batch_size))
+        tx, ty, n_test = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
+        self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_test))
+        self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
+
+        self.root_key = k0
+        self.round_idx = 0
+        self._round_fn = jax.jit(self._make_round_fn()) if self.backend != C.SIMULATION_BACKEND_SP else None
+        self._client_fn_sp = jax.jit(self._sp_client_update) if self.backend == C.SIMULATION_BACKEND_SP else None
+
+    # ------------------------------------------------------------------
+    def _place_data(self, stacked: StackedClientData):
+        x = jnp.asarray(stacked.x)
+        y = jnp.asarray(stacked.y)
+        if self.backend == C.SIMULATION_BACKEND_SP:
+            return (x, y)
+        return tuple(meshlib.shard_leading_axis((x, y), self.mesh))
+
+    # ------------------------------------------------------------------
+    def _make_round_fn(self):
+        algo = self.algorithm
+        cfg = self.cfg
+        n_total = self.dataset.n_clients
+        m = min(cfg.client_num_per_round, n_total)
+
+        def round_fn(global_vars, server_state, client_states, counts, data_x, data_y, round_idx, key):
+            sampled = rng.sample_clients(key, round_idx, n_total, m)
+            xs = jnp.take(data_x, sampled, axis=0)
+            ys = jnp.take(data_y, sampled, axis=0)
+            cnts = jnp.take(counts, sampled)
+            cs = pt.tree_take(client_states, sampled) if client_states is not None else None
+            rkey = rng.round_key(key, round_idx)
+            keys = jax.vmap(lambda i: rng.client_key(rkey, i))(sampled)
+
+            def one_client(cstate, x, y, cnt, k):
+                out = algo.client_update(global_vars, cstate, server_state, x, y, cnt, k)
+                return out.contribution, out.client_state, out.metrics
+
+            if cs is not None:
+                contribs, new_cs, metrics = jax.vmap(one_client, in_axes=(0, 0, 0, 0, 0))(cs, xs, ys, cnts, keys)
+            else:
+                contribs, new_cs, metrics = jax.vmap(
+                    lambda x, y, cnt, k: one_client(None, x, y, cnt, k)
+                )(xs, ys, cnts, keys)
+
+            weights = cnts.astype(jnp.float32)
+            if self.client_hook is not None:
+                contribs = self.client_hook(contribs, weights, rkey)
+            if self.agg_hook is not None:
+                contribs, weights = self.agg_hook(contribs, weights, global_vars, rkey)
+            agg = algo.aggregate(contribs, weights)
+            new_global, new_server = algo.server_update(global_vars, server_state, agg, round_idx)
+
+            if client_states is not None:
+                new_states = jax.tree_util.tree_map(
+                    lambda full, upd: full.at[sampled].set(upd), client_states, new_cs
+                )
+            else:
+                new_states = None
+            round_metrics = {k: jnp.mean(v) for k, v in metrics.items()}
+            return new_global, new_server, new_states, round_metrics
+
+        return round_fn
+
+    def _sp_client_update(self, global_vars, cstate, server_state, x, y, cnt, key):
+        out = self.algorithm.client_update(global_vars, cstate, server_state, x, y, cnt, key)
+        return out.contribution, out.client_state, out.metrics
+
+    # ------------------------------------------------------------------
+    def run_round(self) -> dict:
+        r = self.round_idx
+        if self.backend == C.SIMULATION_BACKEND_SP:
+            metrics = self._run_round_sp(r)
+        else:
+            gv, ss, cs, metrics = self._round_fn(
+                self.global_vars, self.server_state, self.client_states,
+                self.counts, self._data[0], self._data[1],
+                jnp.int32(r), self.root_key,
+            )
+            self.global_vars, self.server_state, self.client_states = gv, ss, cs
+        self.round_idx += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def _run_round_sp(self, r: int) -> dict:
+        """Sequential reference twin: same sampling, same per-client keys, same
+        aggregate — but a host loop like ``fedavg_api.py:88-103``."""
+        cfg = self.cfg
+        n_total = self.dataset.n_clients
+        m = min(cfg.client_num_per_round, n_total)
+        sampled = np.asarray(rng.sample_clients(self.root_key, r, n_total, m))
+        rkey = rng.round_key(self.root_key, r)
+        contribs, new_states, metrics_list = [], [], []
+        for ci in sampled:
+            k = rng.client_key(rkey, int(ci))
+            cs = (
+                jax.tree_util.tree_map(lambda s: s[int(ci)], self.client_states)
+                if self.client_states is not None else None
+            )
+            x = self._data[0][int(ci)]
+            y = self._data[1][int(ci)]
+            contrib, new_cs, mt = self._client_fn_sp(
+                self.global_vars, cs, self.server_state, x, y, self.counts[int(ci)], k
+            )
+            contribs.append(contrib)
+            new_states.append(new_cs)
+            metrics_list.append(mt)
+        stacked = pt.tree_stack(contribs)
+        weights = self.counts[sampled].astype(jnp.float32)
+        agg = self.algorithm.aggregate(stacked, weights)
+        self.global_vars, self.server_state = self.algorithm.server_update(
+            self.global_vars, self.server_state, agg, r
+        )
+        if self.client_states is not None and new_states[0] is not None:
+            for ci, ncs in zip(sampled, new_states):
+                self.client_states = jax.tree_util.tree_map(
+                    lambda full, upd: full.at[int(ci)].set(upd), self.client_states, ncs
+                )
+        stacked_m = pt.tree_stack(metrics_list)
+        return {k: jnp.mean(v) for k, v in stacked_m.items()}
+
+    # ------------------------------------------------------------------
+    def evaluate(self) -> dict:
+        res = self._eval_fn(self.global_vars, *self._test)
+        return {k: float(v) for k, v in res.items()}
+
+    def run(self) -> list[dict]:
+        """The fit loop (reference ``FedAvgAPI.train`` ``fedavg_api.py:66``)."""
+        history = []
+        cfg = self.cfg
+        for r in range(cfg.comm_round):
+            t0 = time.perf_counter()
+            metrics = self.run_round()
+            metrics["round_time_s"] = time.perf_counter() - t0
+            metrics["round"] = r
+            if cfg.frequency_of_the_test and (
+                (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1
+            ):
+                metrics.update(self.evaluate())
+            self.logger.log(metrics)
+            history.append(metrics)
+        return history

@@ -1,0 +1,77 @@
+"""Algorithm-zoo coverage: every registered optimizer runs end-to-end on the
+mesh backend and learns on the tiny synthetic task.
+
+The reference covers algorithm math only for security ops (SURVEY.md §4);
+here each federated optimizer is exercised through the full jitted round —
+including the stateful ones (SCAFFOLD control variates, FedDyn lambda,
+EF-TopK residuals) whose per-client state rides the device scatter/gather.
+"""
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+ALGOS = [
+    "FedAvg",
+    "FedAvg_seq",
+    "FedOpt",
+    "FedProx",
+    "FedNova",
+    "FedDyn",
+    "SCAFFOLD",
+    "Mime",
+    "FedSGD",
+]
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+def test_algorithm_runs_and_learns(algo, eight_devices):
+    import fedml_tpu
+
+    kwargs = dict(federated_optimizer=algo, comm_round=6, learning_rate=0.3, client_num_per_round=8)
+    if algo == "FedOpt":
+        kwargs.update(server_optimizer="adam", server_lr=0.03)
+    if algo == "FedSGD":
+        kwargs.update(server_lr=0.5, server_optimizer="sgd", comm_round=12)
+    history = fedml_tpu.run_simulation(tiny_config(**kwargs))
+    accs = [h["test_acc"] for h in history if "test_acc" in h]
+    assert np.isfinite(accs).all()
+    assert accs[-1] > 0.25, f"{algo}: acc stuck at {accs}"
+
+
+@pytest.mark.parametrize("compression", ["topk", "eftopk", "quantize", "qsgd"])
+def test_fedsgd_compression(compression, eight_devices):
+    import fedml_tpu
+
+    cfg = tiny_config(
+        federated_optimizer="FedSGD",
+        compression=compression,
+        compression_ratio=0.3,
+        server_lr=0.5,
+        comm_round=10,
+        client_num_per_round=8,
+    )
+    history = fedml_tpu.run_simulation(cfg)
+    accs = [h["test_acc"] for h in history if "test_acc" in h]
+    assert np.isfinite(accs).all()
+    assert accs[-1] > 0.15, f"{compression}: {accs}"
+
+
+def test_scaffold_state_persists(eight_devices):
+    """Control variates must be non-zero after training (state round-trip)."""
+    import jax
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(federated_optimizer="SCAFFOLD", comm_round=2, client_num_per_round=4)
+    fedml_tpu.init(cfg)
+    runner = FedMLRunner(cfg)
+    runner.run()
+    sim = runner.runner
+    leaves = jax.tree_util.tree_leaves(sim.client_states)
+    total = sum(float(abs(l).sum()) for l in leaves)
+    assert total > 0, "SCAFFOLD c_i never updated"
+    server_c = sum(float(abs(l).sum()) for l in jax.tree_util.tree_leaves(sim.server_state))
+    assert server_c > 0, "SCAFFOLD global c never updated"
