@@ -30,7 +30,12 @@ def _check_unimplemented_flags(cfg: Config) -> None:
 
 
 # updated as the trust stack lands
-_IMPLEMENTED_TRUST_FLAGS: set = set()
+_IMPLEMENTED_TRUST_FLAGS: set = {
+    "enable_attack",
+    "enable_defense",
+    "enable_dp",
+    "enable_contribution",
+}
 
 
 class FedMLRunner:

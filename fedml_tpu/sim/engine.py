@@ -55,16 +55,21 @@ class MeshSimulator:
         model,
         algorithm=None,
         mesh=None,
-        client_hook: Optional[Callable] = None,
-        agg_hook: Optional[Callable] = None,
+        trust=None,
         logger: Optional[MetricsLogger] = None,
     ):
         self.cfg = cfg
         self.dataset = dataset
         self.model = model
         self.backend = cfg.backend_sim if cfg.backend_sim else C.SIMULATION_BACKEND_MESH
-        self.client_hook = client_hook  # (stacked_contributions, weights, key) -> same
-        self.agg_hook = agg_hook  # (stacked_contributions, weights, global_vars, key) -> (contribs, weights)
+        if trust is None:
+            from ..trust.pipeline import build_trust_pipeline
+
+            trust = build_trust_pipeline(cfg)
+        self.trust = trust
+        if trust is not None and trust.attacker is not None and trust.attacker.is_data_attack():
+            dataset = trust.attacker.poison_data(dataset)
+            self.dataset = dataset
         self.logger = logger or MetricsLogger(cfg.metrics_jsonl_path or None)
 
         # ---- data: pad + stack, shard over the clients axis ----
@@ -105,6 +110,14 @@ class MeshSimulator:
 
         self.root_key = k0
         self.round_idx = 0
+        # history for cross-round defenses: flat global delta of the previous
+        # round, threaded through the jitted round as a real argument (a
+        # captured attribute would be baked in at trace time)
+        if self.trust is not None and self.trust.needs_history:
+            flat, _ = pt.tree_flatten_to_vector(self.global_vars)
+            self.defense_history = jnp.zeros_like(flat)
+        else:
+            self.defense_history = None
         self._round_fn = jax.jit(self._make_round_fn()) if self.backend != C.SIMULATION_BACKEND_SP else None
         self._client_fn_sp = jax.jit(self._sp_client_update) if self.backend == C.SIMULATION_BACKEND_SP else None
 
@@ -123,7 +136,7 @@ class MeshSimulator:
         n_total = self.dataset.n_clients
         m = min(cfg.client_num_per_round, n_total)
 
-        def round_fn(global_vars, server_state, client_states, counts, data_x, data_y, round_idx, key):
+        def round_fn(global_vars, server_state, client_states, counts, data_x, data_y, round_idx, key, prev_delta):
             sampled = rng.sample_clients(key, round_idx, n_total, m)
             xs = jnp.take(data_x, sampled, axis=0)
             ys = jnp.take(data_y, sampled, axis=0)
@@ -144,12 +157,9 @@ class MeshSimulator:
                 )(xs, ys, cnts, keys)
 
             weights = cnts.astype(jnp.float32)
-            if self.client_hook is not None:
-                contribs = self.client_hook(contribs, weights, rkey)
-            if self.agg_hook is not None:
-                contribs, weights = self.agg_hook(contribs, weights, global_vars, rkey)
-            agg = algo.aggregate(contribs, weights)
-            new_global, new_server = algo.server_update(global_vars, server_state, agg, round_idx)
+            new_global, new_server, new_delta = self._server_path(
+                contribs, weights, sampled, global_vars, server_state, rkey, round_idx, prev_delta
+            )
 
             if client_states is not None:
                 new_states = jax.tree_util.tree_map(
@@ -158,9 +168,34 @@ class MeshSimulator:
             else:
                 new_states = None
             round_metrics = {k: jnp.mean(v) for k, v in metrics.items()}
-            return new_global, new_server, new_states, round_metrics
+            return new_global, new_server, new_states, new_delta, round_metrics
 
         return round_fn
+
+    def _server_path(self, contribs, weights, sampled, global_vars, server_state, rkey, round_idx, prev_delta):
+        """Trust hooks + aggregation + server update — shared by the MESH
+        round program and the SP host loop, so security semantics are
+        backend-independent."""
+        algo = self.algorithm
+        if self.trust is not None:
+            contribs, weights = self.trust.on_client_outputs(
+                contribs, weights, sampled, global_vars, rkey
+            )
+            contribs, weights, agg_override = self.trust.on_aggregation(
+                contribs, weights, global_vars, rkey, prev_delta=prev_delta
+            )
+        else:
+            agg_override = None
+        agg = agg_override if agg_override is not None else algo.aggregate(contribs, weights)
+        new_global, new_server = algo.server_update(global_vars, server_state, agg, round_idx)
+        if self.trust is not None:
+            new_global = self.trust.on_after_aggregation(new_global, global_vars, rkey)
+        new_delta = None
+        if prev_delta is not None:
+            new_flat, _ = pt.tree_flatten_to_vector(new_global)
+            old_flat, _ = pt.tree_flatten_to_vector(global_vars)
+            new_delta = new_flat - old_flat
+        return new_global, new_server, new_delta
 
     def _sp_client_update(self, global_vars, cstate, server_state, x, y, cnt, key):
         out = self.algorithm.client_update(global_vars, cstate, server_state, x, y, cnt, key)
@@ -172,12 +207,14 @@ class MeshSimulator:
         if self.backend == C.SIMULATION_BACKEND_SP:
             metrics = self._run_round_sp(r)
         else:
-            gv, ss, cs, metrics = self._round_fn(
+            gv, ss, cs, nd, metrics = self._round_fn(
                 self.global_vars, self.server_state, self.client_states,
                 self.counts, self._data[0], self._data[1],
-                jnp.int32(r), self.root_key,
+                jnp.int32(r), self.root_key, self.defense_history,
             )
             self.global_vars, self.server_state, self.client_states = gv, ss, cs
+            if nd is not None:
+                self.defense_history = nd
         self.round_idx += 1
         return {k: float(v) for k, v in metrics.items()}
 
@@ -206,10 +243,12 @@ class MeshSimulator:
             metrics_list.append(mt)
         stacked = pt.tree_stack(contribs)
         weights = self.counts[sampled].astype(jnp.float32)
-        agg = self.algorithm.aggregate(stacked, weights)
-        self.global_vars, self.server_state = self.algorithm.server_update(
-            self.global_vars, self.server_state, agg, r
+        self.global_vars, self.server_state, nd = self._server_path(
+            stacked, weights, jnp.asarray(sampled, jnp.int32), self.global_vars,
+            self.server_state, rkey, jnp.int32(r), self.defense_history,
         )
+        if nd is not None:
+            self.defense_history = nd
         if self.client_states is not None and new_states[0] is not None:
             for ci, ncs in zip(sampled, new_states):
                 self.client_states = jax.tree_util.tree_map(
@@ -238,4 +277,49 @@ class MeshSimulator:
                 metrics.update(self.evaluate())
             self.logger.log(metrics)
             history.append(metrics)
+        if getattr(cfg, "enable_contribution", False):
+            scores = self.assess_contribution()
+            if scores is not None:
+                self.logger.log({f"contribution_c{i}": float(s) for i, s in enumerate(scores)})
         return history
+
+    def assess_contribution(self):
+        """Shapley contribution of the last round's sampled clients
+        (reference ``ServerAggregator.assess_contribution``
+        ``server_aggregator.py:105``): re-runs the last round's client updates
+        and scores coalitions by test accuracy."""
+        from ..trust.contribution import ContributionAssessorManager
+
+        mgr = ContributionAssessorManager(self.cfg)
+        if not mgr.enabled or self.round_idx == 0:
+            return None
+        r = self.round_idx - 1
+        n_total = self.dataset.n_clients
+        m = min(self.cfg.client_num_per_round, n_total)
+        sampled = np.asarray(rng.sample_clients(self.root_key, r, n_total, m))
+        # recompute the last round's contributions with the pre-round state is
+        # not retained; assess on fresh local updates from the current global
+        rkey = rng.round_key(self.root_key, r + 0x5A)
+        contribs, weights = [], []
+        fn = self._client_fn_sp or jax.jit(self._sp_client_update)
+        for ci in sampled:
+            cs = (
+                jax.tree_util.tree_map(lambda s: s[int(ci)], self.client_states)
+                if self.client_states is not None else None
+            )
+            contrib, _, _ = fn(
+                self.global_vars, cs, self.server_state,
+                self._data[0][int(ci)], self._data[1][int(ci)],
+                self.counts[int(ci)], rng.client_key(rkey, int(ci)),
+            )
+            contribs.append(contrib)
+            weights.append(float(self.counts[int(ci)]))
+        stacked = pt.tree_stack(contribs)
+        one = jax.tree_util.tree_map(lambda x: x[0], stacked)
+        if jax.tree_util.tree_structure(one) != jax.tree_util.tree_structure(self.global_vars):
+            return None  # contribution defined on weight-style contributions
+
+        def eval_fn(agg_vars):
+            return self._eval_fn(agg_vars, *self._test)["test_acc"]
+
+        return mgr.assess(stacked, np.asarray(weights), eval_fn, empty_model=self.global_vars)

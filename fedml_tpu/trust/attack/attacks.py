@@ -1,0 +1,174 @@
+"""Attack simulations (robustness evaluation).
+
+Parity with ``FedMLAttacker`` (``core/security/fedml_attacker.py:14``) and the
+attack classes under ``core/security/attack/``: Byzantine (random/zero/flip),
+label flipping (dataset poisoning), model-replacement backdoor, lazy worker.
+Privacy attacks (DLG et al.) live in ``dlg.py``.
+
+Byzantine-style attacks are pure transforms of the stacked (m, d) client
+update matrix + a per-client malicious mask — they slot into the engine's
+``client_hook`` (the point where the reference's
+``attack_model_list``/``poison_model`` runs, server-side before aggregation).
+Label flipping poisons the host-side dataset before stacking, matching the
+reference's ``ClientTrainer.update_dataset`` poisoning hook
+(``client_trainer.py:38``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def malicious_mask(m: int, sampled_idx: jax.Array, attacker_ids: Sequence[int]) -> jax.Array:
+    """(m,) 1.0 where the sampled client id is an attacker."""
+    ids = jnp.asarray(list(attacker_ids), dtype=jnp.int32)
+    if ids.size == 0:
+        return jnp.zeros((m,), jnp.float32)
+    return jnp.any(sampled_idx[:, None] == ids[None, :], axis=1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Byzantine family (byzantine_attack.py modes: random / zero / flip)
+# ---------------------------------------------------------------------------
+
+def byzantine_random(updates: jax.Array, mask: jax.Array, key: jax.Array, scale: float = 1.0) -> jax.Array:
+    noise = jax.random.normal(key, updates.shape) * scale
+    return jnp.where(mask[:, None] > 0, noise, updates)
+
+
+def byzantine_zero(updates: jax.Array, mask: jax.Array) -> jax.Array:
+    return jnp.where(mask[:, None] > 0, 0.0, updates)
+
+
+def byzantine_flip(updates: jax.Array, mask: jax.Array, global_flat: jax.Array) -> jax.Array:
+    """Sign-flip the delta around the global model (gradient ascent)."""
+    flipped = 2.0 * global_flat[None, :] - updates
+    return jnp.where(mask[:, None] > 0, flipped, updates)
+
+
+def model_replacement(updates: jax.Array, mask: jax.Array, global_flat: jax.Array, boost: float) -> jax.Array:
+    """Model-replacement backdoor (model_replacement_backdoor_attack.py):
+    attacker scales its delta by ``boost`` (typically n/eta) so the averaged
+    global becomes its target model."""
+    boosted = global_flat[None, :] + boost * (updates - global_flat[None, :])
+    return jnp.where(mask[:, None] > 0, boosted, updates)
+
+
+def lazy_worker(updates: jax.Array, mask: jax.Array, global_flat: jax.Array) -> jax.Array:
+    """Lazy/free-rider (lazy_worker.py): returns the global weights untrained."""
+    return jnp.where(mask[:, None] > 0, global_flat[None, :], updates)
+
+
+# ---------------------------------------------------------------------------
+# Label flipping (label_flipping_attack.py) — host-side dataset poisoning
+# ---------------------------------------------------------------------------
+
+def flip_labels(
+    labels: np.ndarray,
+    client_idx: list,
+    poisoned_clients: Sequence[int],
+    original_class: int,
+    target_class: int,
+) -> np.ndarray:
+    """Return a copy of ``labels`` where poisoned clients' samples of
+    ``original_class`` become ``target_class``."""
+    out = labels.copy()
+    for c in poisoned_clients:
+        ix = client_idx[c]
+        sel = ix[out[ix] == original_class]
+        out[sel] = target_class
+    return out
+
+
+def backdoor_pixel_pattern(x: np.ndarray, client_idx: list, poisoned_clients: Sequence[int],
+                           target_class: int, labels: np.ndarray, frac: float = 0.5,
+                           seed: int = 0):
+    """Pixel-pattern backdoor (backdoor_attack.py): stamp a corner trigger on a
+    fraction of poisoned clients' images and relabel to the target class.
+    Returns (x', labels')."""
+    x = x.copy()
+    labels = labels.copy()
+    rng = np.random.RandomState(seed)
+    for c in poisoned_clients:
+        ix = client_idx[c]
+        n_poison = int(len(ix) * frac)
+        sel = rng.choice(ix, size=n_poison, replace=False)
+        x[sel, :3, :3, :] = x.max()  # 3x3 corner trigger
+        labels[sel] = target_class
+    return x, labels
+
+
+MODEL_ATTACKS = (
+    "byzantine_random", "byzantine_zero", "byzantine_flip",
+    "model_replacement", "lazy_worker",
+)
+DATA_ATTACKS = ("label_flipping", "backdoor")
+KNOWN_ATTACKS = MODEL_ATTACKS + DATA_ATTACKS
+
+
+class FedMLAttacker:
+    """Singleton-style facade matching the reference API shape
+    (``fedml_attacker.py``): enabled by config, exposes
+    ``poison_model`` (stacked update matrix) and ``poison_data``
+    (host-side dataset, the reference's ``update_dataset`` hook)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.enabled = bool(getattr(cfg, "enable_attack", False))
+        self.attack_type = getattr(cfg, "attack_type", "")
+        if self.enabled and self.attack_type not in KNOWN_ATTACKS:
+            raise ValueError(
+                f"unknown attack_type {self.attack_type!r}; known: {sorted(KNOWN_ATTACKS)}"
+            )
+        self.attackers = tuple(getattr(cfg, "poisoned_client_list", ()) or ())
+        extra = getattr(cfg, "extra", {}) or {}
+        self.boost = float(extra.get("attack_boost", 10.0))
+        self.original_class = int(extra.get("attack_original_class", 0))
+        self.target_class = int(extra.get("attack_target_class", 1))
+        self.poison_frac = float(extra.get("attack_poison_frac", 0.5))
+
+    def is_model_attack(self) -> bool:
+        return self.enabled and self.attack_type in MODEL_ATTACKS
+
+    def is_data_attack(self) -> bool:
+        return self.enabled and self.attack_type in DATA_ATTACKS
+
+    def poison_data(self, ds):
+        """Poison the host-side FederatedDataset in place-of (returns a new
+        dataset) before client shards are stacked — mirrors the poisoning hook
+        in ``ClientTrainer.update_dataset`` (``client_trainer.py:38``)."""
+        import dataclasses
+
+        if self.attack_type == "label_flipping":
+            new_y = flip_labels(
+                ds.train_y, ds.client_idx, self.attackers,
+                self.original_class, self.target_class,
+            )
+            return dataclasses.replace(ds, train_y=new_y)
+        if self.attack_type == "backdoor":
+            new_x, new_y = backdoor_pixel_pattern(
+                ds.train_x, ds.client_idx, self.attackers,
+                self.target_class, ds.train_y, frac=self.poison_frac,
+            )
+            return dataclasses.replace(ds, train_x=new_x, train_y=new_y)
+        return ds
+
+    def poison_model(self, updates: jax.Array, sampled_idx: jax.Array,
+                     global_flat: jax.Array, key: jax.Array) -> jax.Array:
+        mask = malicious_mask(updates.shape[0], sampled_idx, self.attackers)
+        t = self.attack_type
+        if t == "byzantine_random":
+            return byzantine_random(updates, mask, key)
+        if t == "byzantine_zero":
+            return byzantine_zero(updates, mask)
+        if t == "byzantine_flip":
+            return byzantine_flip(updates, mask, global_flat)
+        if t == "model_replacement":
+            return model_replacement(updates, mask, global_flat, self.boost)
+        if t == "lazy_worker":
+            return lazy_worker(updates, mask, global_flat)
+        raise ValueError(f"unknown model attack {t!r}")

@@ -1,0 +1,73 @@
+"""Privacy attacks: DLG gradient inversion + label revelation.
+
+Parity with ``core/security/attack/dlg_attack.py``,
+``invert_gradient_attack.py`` and
+``revealing_labels_from_gradients_attack.py``.  DLG ("Deep Leakage from
+Gradients", Zhu et al.) reconstructs training inputs by optimizing dummy data
+so its gradients match the victim's.  The reference runs an L-BFGS torch loop;
+here the matching objective is differentiated with ``jax.grad`` and optimized
+with Adam under ``lax.scan`` — one compiled program, TPU-resident.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def revealing_labels_from_gradients(last_layer_grad_b: jax.Array) -> jax.Array:
+    """Infer which labels were in the victim batch from the last dense layer's
+    BIAS gradient (iDLG observation): for CE loss,
+    dL/db_c = mean_i (softmax_c - 1{y_i = c}), which is negative exactly when
+    class c appears in the batch (for reasonably calibrated logits).
+
+    For weight gradients with non-negative activations (post-ReLU), the same
+    sign rule applies to column sums — pass ``grad_w.sum(axis=0)``.
+
+    Returns (classes,) boolean — class judged present.
+    """
+    return last_layer_grad_b < 0
+
+
+def dlg_attack(
+    grad_fn: Callable,
+    victim_grads,
+    x_shape: tuple,
+    n_classes: int,
+    key: jax.Array,
+    steps: int = 200,
+    lr: float = 0.1,
+):
+    """Reconstruct (x, y-probs) whose gradients match ``victim_grads``.
+
+    grad_fn(params_free_x, y_soft) -> grads pytree matching victim_grads
+    (closed over model params).  Returns (x_hat, y_soft_hat, final_loss).
+    """
+    kx, ky = jax.random.split(key)
+    x0 = jax.random.normal(kx, x_shape) * 0.1
+    y0 = jax.random.normal(ky, (x_shape[0], n_classes)) * 0.1
+    opt = optax.adam(lr)
+
+    def match_loss(xy):
+        x, y_logits = xy
+        y_soft = jax.nn.softmax(y_logits, axis=-1)
+        g = grad_fn(x, y_soft)
+        diffs = jax.tree_util.tree_map(lambda a, b: jnp.sum((a - b) ** 2), g, victim_grads)
+        return jax.tree_util.tree_reduce(jnp.add, diffs, jnp.float32(0.0))
+
+    vg = jax.value_and_grad(match_loss)
+
+    def step(carry, _):
+        xy, opt_state = carry
+        loss, g = vg(xy)
+        updates, opt_state = opt.update(g, opt_state, xy)
+        xy = optax.apply_updates(xy, updates)
+        return (xy, opt_state), loss
+
+    xy0 = (x0, y0)
+    (xy, _), losses = jax.lax.scan(step, (xy0, opt.init(xy0)), None, length=steps)
+    x_hat, y_logits = xy
+    return x_hat, jax.nn.softmax(y_logits, axis=-1), losses[-1]

@@ -1,0 +1,108 @@
+"""Client contribution assessment (Shapley values).
+
+Parity with ``core/contribution/``: ``ContributionAssessorManager``
+(``contribution_assessor_manager.py:9``), ``gtg_shapley_value.py`` (GTG —
+"Guided Truncation Gradient" Shapley: within-round truncated Monte-Carlo over
+permutations of client updates), ``leave_one_out.py``.
+
+An "eval" here is a pure function ``eval_fn(agg_vars) -> float`` (accuracy on
+held-out data); candidate models are weighted means of client-update subsets —
+built with the same ``tree_weighted_mean`` as real aggregation, so assessing
+k subsets is k fused reductions, vmap-able if needed.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..core import pytree as pt
+
+
+def _subset_model(stacked_contribs, weights: np.ndarray, mask: np.ndarray, empty_model=None):
+    """Aggregate of the masked coalition; the EMPTY coalition is the pre-round
+    global model (``empty_model``), not a degenerate normalized mean — the
+    weighted mean normalizes weights, so near-zero masks would silently
+    reproduce the full-coalition model."""
+    import jax.numpy as jnp
+
+    if mask.sum() == 0:
+        if empty_model is None:
+            raise ValueError("empty coalition requires empty_model")
+        return empty_model
+    w = jnp.asarray(weights * mask)
+    return pt.tree_weighted_mean(stacked_contribs, w)
+
+
+def leave_one_out(stacked_contribs, weights: np.ndarray, eval_fn: Callable, empty_model=None) -> np.ndarray:
+    """v(all) - v(all \\ {i}) per client (leave_one_out.py)."""
+    m = len(weights)
+    full = float(eval_fn(_subset_model(stacked_contribs, weights, np.ones(m))))
+    scores = np.zeros(m)
+    for i in range(m):
+        mask = np.ones(m)
+        mask[i] = 0.0
+        scores[i] = full - float(eval_fn(_subset_model(stacked_contribs, weights, mask, empty_model)))
+    return scores
+
+
+def gtg_shapley(
+    stacked_contribs,
+    weights: np.ndarray,
+    eval_fn: Callable,
+    empty_model,
+    rounds_cap: int = 20,
+    eps: float = 1e-3,
+    seed: int = 0,
+) -> np.ndarray:
+    """Truncated Monte-Carlo Shapley (gtg_shapley_value.py): sample client
+    permutations, walk marginal contributions, truncate a walk when the
+    running value is within eps of the full-coalition value; stop when the
+    estimate stabilizes or rounds_cap permutations are used.
+
+    ``empty_model``: the pre-round global variables — v(empty coalition)."""
+    rng = np.random.RandomState(seed)
+    m = len(weights)
+    v_full = float(eval_fn(_subset_model(stacked_contribs, weights, np.ones(m))))
+    v_empty = float(eval_fn(empty_model))
+    shap = np.zeros(m)
+    count = np.zeros(m)
+    prev_est = None
+    for it in range(rounds_cap):
+        perm = rng.permutation(m)
+        mask = np.zeros(m)
+        v_prev = v_empty
+        for pos, i in enumerate(perm):
+            if abs(v_full - v_prev) < eps:  # truncation: rest contribute ~0
+                marginal = 0.0
+                v_curr = v_prev
+            else:
+                mask[i] = 1.0
+                v_curr = float(eval_fn(_subset_model(stacked_contribs, weights, mask, empty_model)))
+                marginal = v_curr - v_prev
+            shap[i] += marginal
+            count[i] += 1
+            v_prev = v_curr
+        est = shap / np.maximum(count, 1)
+        if prev_est is not None and np.max(np.abs(est - prev_est)) < eps / 10:
+            break
+        prev_est = est
+    return shap / np.maximum(count, 1)
+
+
+class ContributionAssessorManager:
+    """Facade with the reference's shape: built from config, runs the chosen
+    method after aggregation."""
+
+    def __init__(self, cfg):
+        self.enabled = bool(getattr(cfg, "enable_contribution", False))
+        self.method = getattr(cfg, "contribution_method", "gtg_shapley")
+
+    def assess(self, stacked_contribs, weights, eval_fn, empty_model=None) -> np.ndarray:
+        w = np.asarray(weights, dtype=np.float64)
+        if self.method in ("gtg_shapley", "GTG"):
+            return gtg_shapley(stacked_contribs, w, eval_fn, empty_model)
+        if self.method in ("leave_one_out", "LOO"):
+            return leave_one_out(stacked_contribs, w, eval_fn, empty_model)
+        raise ValueError(f"unknown contribution_method {self.method!r}")

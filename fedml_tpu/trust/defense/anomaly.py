@@ -1,0 +1,160 @@
+"""Anomaly-detection defenses: FoolsGold, 3-sigma family, outlier detection,
+residual reweighting, cross-round consistency.
+
+Reference: ``core/security/defense/foolsgold_defense.py``,
+``three_sigma_defense.py`` (+ ``three_sigma_geomedian_defense.py``,
+``three_sigma_krum_defense.py``), ``outlier_detection.py``,
+``RFA_defense.py``-adjacent ``residual_reweight*``, ``crossround_defense.py``.
+Each is vectorized over the (m, d) update matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import Defense, pairwise_sq_dists, weighted_mean
+
+
+class FoolsGoldDefense(Defense):
+    """FoolsGold: down-weight clients whose updates are too similar (sybils).
+
+    Cosine-similarity logic of ``foolsgold_defense.py:fools_gold_score``:
+    cs_ij = cosine sims; v_i = max_j cs_ij; rescale, clamp, logit.
+    The reference accumulates historical gradients; this stateless variant
+    uses the current round (the engine can thread history later).
+    """
+
+    name = "foolsgold"
+
+    def before(self, updates, weights, global_flat):
+        m = updates.shape[0]
+        norm = jnp.linalg.norm(updates, axis=1, keepdims=True)
+        un = updates / jnp.maximum(norm, 1e-12)
+        cs = un @ un.T - jnp.eye(m)
+        v = jnp.max(cs, axis=1)  # max similarity per client
+        # pardoning: scale cs rows by v_i/v_j asymmetry
+        scale = jnp.minimum(1.0, v[:, None] / jnp.maximum(v[None, :], 1e-12))
+        cs = cs * scale
+        alpha = 1.0 - jnp.max(cs, axis=1)
+        alpha = alpha / jnp.maximum(jnp.max(alpha), 1e-12)
+        alpha = jnp.clip(alpha, 1e-6, 1 - 1e-6)
+        wv = jnp.log(alpha / (1 - alpha)) + 0.5
+        wv = jnp.clip(wv, 0.0, 1.0)
+        return updates, weights * wv
+
+
+class ThreeSigmaDefense(Defense):
+    """3-sigma: score clients by distance to a robust center (coordinate
+    median); zero-weight those beyond k sigma (three_sigma_defense.py)."""
+
+    name = "three_sigma"
+
+    def __init__(self, cfg=None, k: float = 3.0):
+        super().__init__(cfg)
+        self.k = getattr(cfg, "outlier_detection_k", k) if cfg else k
+
+    def center(self, updates, weights):
+        return jnp.median(updates, axis=0)
+
+    def before(self, updates, weights, global_flat):
+        c = self.center(updates, weights)
+        d = jnp.linalg.norm(updates - c[None, :], axis=1)
+        mu, sigma = jnp.mean(d), jnp.std(d) + 1e-12
+        keep = (d <= mu + self.k * sigma).astype(jnp.float32)
+        return updates, weights * keep
+
+
+class ThreeSigmaGeoMedianDefense(ThreeSigmaDefense):
+    """Variant scoring against the geometric median (three_sigma_geomedian)."""
+
+    name = "three_sigma_geomedian"
+
+    def center(self, updates, weights, iters: int = 8):
+        w = jnp.ones(updates.shape[0]) / updates.shape[0]
+        z = w @ updates
+
+        def step(z, _):
+            dist = jnp.sqrt(jnp.sum((updates - z[None, :]) ** 2, axis=1) + 1e-6)
+            a = w / dist
+            a = a / jnp.maximum(a.sum(), 1e-12)
+            return a @ updates, None
+
+        z, _ = jax.lax.scan(step, z, None, length=iters)
+        return z
+
+
+class ThreeSigmaKrumDefense(ThreeSigmaDefense):
+    """Variant scoring against the Krum-selected client (three_sigma_krum)."""
+
+    name = "three_sigma_krum"
+
+    def center(self, updates, weights):
+        from .robust_agg import krum_scores
+
+        scores = krum_scores(updates, byzantine_num=1)
+        best = jnp.argmin(scores)
+        return updates[best]
+
+
+class OutlierDetectionDefense(Defense):
+    """Per-coordinate z-score outlier masking (outlier_detection.py): replace
+    entries deviating > k sigma from the coordinate mean with the coordinate
+    median before averaging."""
+
+    name = "outlier_detection"
+
+    def __init__(self, cfg=None, k: float = 3.0):
+        super().__init__(cfg)
+        self.k = getattr(cfg, "outlier_detection_k", k) if cfg else k
+
+    def before(self, updates, weights, global_flat):
+        mu = jnp.mean(updates, axis=0, keepdims=True)
+        sd = jnp.std(updates, axis=0, keepdims=True) + 1e-12
+        med = jnp.median(updates, axis=0, keepdims=True)
+        mask = jnp.abs(updates - mu) <= self.k * sd
+        return jnp.where(mask, updates, med), weights
+
+
+class ResidualReweightDefense(Defense):
+    """IRLS residual-based reweighting (residual_reweighting): weight clients
+    by a Huber-style function of their residual to the coordinate median."""
+
+    name = "residual_reweight"
+
+    def __init__(self, cfg=None, delta: float = 1.0):
+        super().__init__(cfg)
+        self.delta = delta
+
+    def before(self, updates, weights, global_flat):
+        med = jnp.median(updates, axis=0)
+        r = jnp.linalg.norm(updates - med[None, :], axis=1)
+        r = r / jnp.maximum(jnp.median(r), 1e-12)
+        wgt = jnp.where(r <= self.delta, 1.0, self.delta / r)
+        return updates, weights * wgt
+
+
+class CrossRoundDefense(Defense):
+    """Cross-round consistency (crossround_defense.py): compare each client's
+    update direction with the previous global movement; down-weight clients
+    whose cosine to the last round's aggregate delta is negative."""
+
+    name = "cross_round"
+
+    def __init__(self, cfg=None):
+        super().__init__(cfg)
+        self._prev_delta = None  # set by engine between rounds (host-side)
+
+    def set_history(self, prev_delta_flat):
+        self._prev_delta = prev_delta_flat
+
+    def before(self, updates, weights, global_flat):
+        if self._prev_delta is None:
+            return updates, weights
+        delta = updates - global_flat[None, :]
+        pd = self._prev_delta / jnp.maximum(jnp.linalg.norm(self._prev_delta), 1e-12)
+        cos = (delta @ pd) / jnp.maximum(jnp.linalg.norm(delta, axis=1), 1e-12)
+        keep = (cos >= 0.0).astype(jnp.float32)
+        # never discard everyone
+        keep = jnp.where(keep.sum() > 0, keep, jnp.ones_like(keep))
+        return updates, weights * keep

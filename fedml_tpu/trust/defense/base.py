@@ -1,0 +1,64 @@
+"""Defense pipeline frame.
+
+Reference: ``FedMLDefender`` (``core/security/fedml_defender.py:40``) threads
+every defense through three lifecycle hooks around aggregation
+(``defend_before_aggregation`` / ``defend_on_aggregation`` /
+``defend_after_aggregation``), each consuming a python list of
+``(sample_num, state_dict)`` tuples.  Here the same three hooks are pure
+functions over the **stacked client-update matrix** ``(m, d)`` (flattened
+pytrees, see ``core.pytree.stacked_tree_to_matrix``), so a defense is a few
+matmuls/reductions that fuse into the round program — pairwise-distance
+defenses (Krum, Bulyan) become one ``U @ U.T`` on the MXU instead of nested
+python loops.
+
+Weight semantics: defenses signal "discard client i" by zeroing its weight;
+the weighted mean downstream then ignores it — shapes stay static (no boolean
+filtering inside jit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core import pytree as pt
+
+
+class Defense:
+    """Base: identity at all three hooks.  Subclasses override any subset.
+
+    All methods are pure and jit-traceable.  ``before`` may modify updates
+    and/or weights; ``on_agg`` may replace the aggregation entirely (return
+    aggregated flat vector); ``after`` may post-process the new global.
+    """
+
+    name = "identity"
+
+    def __init__(self, cfg=None):
+        self.cfg = cfg
+
+    def before(self, updates: jax.Array, weights: jax.Array, global_flat: jax.Array):
+        """(m, d) updates, (m,) weights -> same shapes."""
+        return updates, weights
+
+    def on_agg(self, updates: jax.Array, weights: jax.Array, global_flat: jax.Array) -> Optional[jax.Array]:
+        """Return (d,) aggregate to REPLACE the weighted mean, or None."""
+        return None
+
+    def after(self, new_global_flat: jax.Array, old_global_flat: jax.Array) -> jax.Array:
+        return new_global_flat
+
+
+def weighted_mean(updates: jax.Array, weights: jax.Array) -> jax.Array:
+    w = weights / jnp.maximum(weights.sum(), 1e-12)
+    return w @ updates
+
+
+def pairwise_sq_dists(u: jax.Array) -> jax.Array:
+    """(m, d) -> (m, m) squared euclidean distances, via one gram matmul."""
+    sq = jnp.sum(u * u, axis=1)
+    g = u @ u.T
+    d2 = sq[:, None] + sq[None, :] - 2.0 * g
+    return jnp.maximum(d2, 0.0)

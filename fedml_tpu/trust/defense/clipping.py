@@ -1,0 +1,130 @@
+"""Clipping / noise defenses: norm-diff clipping, centered clip, weak DP,
+SLSGD, robust learning rate, CRFL.
+
+Reference: ``core/security/defense/norm_diff_clipping_defense.py``,
+``cclip_defense.py``, ``weak_dp_defense.py``, ``slsgd_defense.py``,
+``robust_learning_rate_defense.py``, ``crfl_defense.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import Defense, weighted_mean
+
+
+class NormDiffClippingDefense(Defense):
+    """Clip each client's update delta (w_i - w_global) to a norm bound
+    (norm_diff_clipping_defense.py)."""
+
+    name = "norm_diff_clipping"
+
+    def __init__(self, cfg=None, norm_bound: float = 5.0):
+        super().__init__(cfg)
+        self.norm_bound = getattr(cfg, "norm_bound", norm_bound) if cfg else norm_bound
+
+    def before(self, updates, weights, global_flat):
+        delta = updates - global_flat[None, :]
+        norms = jnp.linalg.norm(delta, axis=1, keepdims=True)
+        scale = jnp.minimum(1.0, self.norm_bound / jnp.maximum(norms, 1e-12))
+        return global_flat[None, :] + delta * scale, weights
+
+
+class CClipDefense(Defense):
+    """Centered clipping (Karimireddy et al.): clip deltas around the previous
+    global model with bound tau, then average (cclip_defense.py)."""
+
+    name = "cclip"
+
+    def __init__(self, cfg=None, tau: float = 10.0):
+        super().__init__(cfg)
+        self.tau = getattr(cfg, "norm_bound", tau) if cfg else tau
+
+    def on_agg(self, updates, weights, global_flat):
+        delta = updates - global_flat[None, :]
+        norms = jnp.linalg.norm(delta, axis=1, keepdims=True)
+        scale = jnp.minimum(1.0, self.tau / jnp.maximum(norms, 1e-12))
+        return global_flat + weighted_mean(delta * scale, weights)
+
+
+class WeakDPDefense(Defense):
+    """Weak DP: clip then add small gaussian noise to each update
+    (weak_dp_defense.py).  The noise key is derived from the round key the
+    engine passes via ``set_key``."""
+
+    name = "weak_dp"
+
+    def __init__(self, cfg=None, norm_bound: float = 5.0, stddev: float = 0.002):
+        super().__init__(cfg)
+        self.norm_bound = getattr(cfg, "norm_bound", norm_bound) if cfg else norm_bound
+        self.stddev = stddev
+        self._key = jax.random.PRNGKey(0)
+
+    def set_key(self, key):
+        self._key = key
+
+    def before(self, updates, weights, global_flat):
+        delta = updates - global_flat[None, :]
+        norms = jnp.linalg.norm(delta, axis=1, keepdims=True)
+        scale = jnp.minimum(1.0, self.norm_bound / jnp.maximum(norms, 1e-12))
+        noise = jax.random.normal(self._key, updates.shape) * self.stddev
+        return global_flat[None, :] + delta * scale + noise, weights
+
+
+class SLSGDDefense(Defense):
+    """SLSGD: trimmed-mean aggregate mixed with the previous global:
+    w' = (1-a) w + a agg (slsgd_defense.py)."""
+
+    name = "slsgd"
+
+    def __init__(self, cfg=None, alpha: float = 0.5, trim_b: int = 1):
+        super().__init__(cfg)
+        self.alpha = alpha
+        self.trim_b = trim_b
+
+    def on_agg(self, updates, weights, global_flat):
+        m = updates.shape[0]
+        b = min(self.trim_b, (m - 1) // 2)
+        s = jnp.sort(updates, axis=0)
+        agg = jnp.mean(s[b : m - b], axis=0) if b > 0 else weighted_mean(updates, weights)
+        return (1.0 - self.alpha) * global_flat + self.alpha * agg
+
+
+class RobustLearningRateDefense(Defense):
+    """Robust LR (Ozdayi et al.): per-coordinate, flip the server lr sign
+    where fewer than ``theta`` clients agree on the update direction
+    (robust_learning_rate_defense.py)."""
+
+    name = "robust_learning_rate"
+
+    def __init__(self, cfg=None, theta: int = 1):
+        super().__init__(cfg)
+        self.theta = theta
+
+    def on_agg(self, updates, weights, global_flat):
+        delta = updates - global_flat[None, :]
+        sign_sum = jnp.abs(jnp.sum(jnp.sign(delta), axis=0))
+        lr_sign = jnp.where(sign_sum >= self.theta, 1.0, -1.0)
+        return global_flat + lr_sign * weighted_mean(delta, weights)
+
+
+class CRFLDefense(Defense):
+    """CRFL (certified robustness): clip the aggregated global to a norm bound
+    and add gaussian perturbation after aggregation (crfl_defense.py)."""
+
+    name = "crfl"
+
+    def __init__(self, cfg=None, norm_bound: float = 15.0, stddev: float = 0.002):
+        super().__init__(cfg)
+        self.norm_bound = getattr(cfg, "norm_bound", norm_bound) if cfg else norm_bound
+        self.stddev = stddev
+        self._key = jax.random.PRNGKey(0)
+
+    def set_key(self, key):
+        self._key = key
+
+    def after(self, new_global_flat, old_global_flat):
+        norm = jnp.linalg.norm(new_global_flat)
+        clipped = new_global_flat * jnp.minimum(1.0, self.norm_bound / jnp.maximum(norm, 1e-12))
+        return clipped + jax.random.normal(self._key, clipped.shape) * self.stddev

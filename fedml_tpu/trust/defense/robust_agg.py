@@ -1,0 +1,130 @@
+"""Robust aggregation defenses: Krum/MultiKrum, RFA geometric median,
+coordinate-wise median, trimmed mean, Bulyan.
+
+Reference implementations (python loops over state_dict lists):
+``core/security/defense/krum_defense.py``, ``geometric_median_defense.py``,
+``coordinate_wise_median_defense.py``, ``coordinate_wise_trimmed_mean_defense.py``,
+``bulyan_defense.py``.  Here each is dense linear algebra over the stacked
+``(m, d)`` update matrix: Krum's pairwise distances are one gram matmul; the
+geometric median is a fixed number of Weiszfeld iterations under ``lax.scan``
+(compiler-friendly, no data-dependent loop).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import Defense, pairwise_sq_dists, weighted_mean
+
+
+def krum_scores(updates: jax.Array, byzantine_num: int) -> jax.Array:
+    """Krum score: for each client, sum of its m - f - 2 smallest squared
+    distances to other clients (lower = more central)."""
+    m = updates.shape[0]
+    d2 = pairwise_sq_dists(updates)
+    d2 = d2 + jnp.eye(m) * 1e30  # exclude self
+    k = max(1, m - byzantine_num - 2)
+    neg_smallest, _ = jax.lax.top_k(-d2, k)  # (m, k) smallest distances
+    return -jnp.sum(neg_smallest, axis=1)
+
+
+class KrumDefense(Defense):
+    """Krum (krum_param_m=1) / Multi-Krum (m>1): keep only the m most central
+    clients (zero the rest's weights)."""
+
+    name = "krum"
+
+    def __init__(self, cfg=None, byzantine_num: int = 1, select_m: int = 1):
+        super().__init__(cfg)
+        self.byzantine_num = getattr(cfg, "byzantine_client_num", byzantine_num) if cfg else byzantine_num
+        self.select_m = getattr(cfg, "krum_param_m", select_m) if cfg else select_m
+
+    def before(self, updates, weights, global_flat):
+        scores = krum_scores(updates, self.byzantine_num)
+        m = updates.shape[0]
+        k = min(self.select_m, m)
+        _, best = jax.lax.top_k(-scores, k)
+        mask = jnp.zeros((m,)).at[best].set(1.0)
+        return updates, weights * mask
+
+
+class MultiKrumDefense(KrumDefense):
+    name = "multikrum"
+
+
+class GeometricMedianDefense(Defense):
+    """RFA (Pillutla et al.): smoothed Weiszfeld geometric median of client
+    updates, weighted by sample counts.  Fixed ``iters`` under scan."""
+
+    name = "geometric_median"
+
+    def __init__(self, cfg=None, iters: int = 8, eps: float = 1e-6):
+        super().__init__(cfg)
+        self.iters = iters
+        self.eps = eps
+
+    def on_agg(self, updates, weights, global_flat):
+        w = weights / jnp.maximum(weights.sum(), 1e-12)
+        z0 = w @ updates
+
+        def step(z, _):
+            dist = jnp.sqrt(jnp.sum((updates - z[None, :]) ** 2, axis=1) + self.eps)
+            alpha = w / dist
+            alpha = alpha / jnp.maximum(alpha.sum(), 1e-12)
+            return alpha @ updates, None
+
+        z, _ = jax.lax.scan(step, z0, None, length=self.iters)
+        return z
+
+
+class CoordinateWiseMedianDefense(Defense):
+    name = "coordinate_median"
+
+    def on_agg(self, updates, weights, global_flat):
+        return jnp.median(updates, axis=0)
+
+
+class TrimmedMeanDefense(Defense):
+    """Coordinate-wise beta-trimmed mean: drop the beta*m largest and smallest
+    per coordinate, average the rest."""
+
+    name = "trimmed_mean"
+
+    def __init__(self, cfg=None, beta: float = 0.1):
+        super().__init__(cfg)
+        self.beta = getattr(cfg, "trimmed_mean_beta", beta) if cfg else beta
+
+    def on_agg(self, updates, weights, global_flat):
+        m = updates.shape[0]
+        b = min(int(self.beta * m), (m - 1) // 2)
+        if b == 0:
+            return jnp.mean(updates, axis=0)
+        s = jnp.sort(updates, axis=0)
+        return jnp.mean(s[b : m - b], axis=0)
+
+
+class BulyanDefense(Defense):
+    """Bulyan: MultiKrum-select 2f+3... simplified faithful variant — select
+    theta = m - 2f clients by Krum score, then coordinate-wise trimmed mean
+    (trim f) over the selected set, implemented with weight masking to keep
+    shapes static."""
+
+    name = "bulyan"
+
+    def __init__(self, cfg=None, byzantine_num: int = 1):
+        super().__init__(cfg)
+        self.byzantine_num = getattr(cfg, "byzantine_client_num", byzantine_num) if cfg else byzantine_num
+
+    def on_agg(self, updates, weights, global_flat):
+        m, d = updates.shape
+        f = self.byzantine_num
+        theta = max(1, m - 2 * f)
+        scores = krum_scores(updates, f)
+        _, best = jax.lax.top_k(-scores, theta)
+        sel = updates[best]  # (theta, d)
+        b = min(f, (theta - 1) // 2)
+        if b == 0:
+            return jnp.mean(sel, axis=0)
+        s = jnp.sort(sel, axis=0)
+        return jnp.mean(s[b : theta - b], axis=0)

@@ -1,0 +1,98 @@
+"""Differential privacy: mechanisms, LDP/CDP solutions, NbAFL.
+
+Parity with ``core/dp/`` (``FedMLDifferentialPrivacy``
+``fedml_differential_privacy.py:13``; mechanisms ``mechanisms/gaussian.py``,
+``laplace.py``; frames ``frames/NbAFL.py``, ``cdp.py``, ``ldp.py``).
+
+- LDP: noise added to each client's update before it leaves the client
+  (hook: after local training).
+- CDP: clip client deltas + noise the aggregated global (hook: after
+  aggregation).
+- NbAFL: both up-link and down-link noise with the paper's sigma formulas.
+
+All pure: noise keys flow from the round key; calibration is the standard
+(epsilon, delta)-Gaussian / epsilon-Laplace mechanism math.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def gaussian_sigma(epsilon: float, delta: float, sensitivity: float) -> float:
+    """Classic analytic Gaussian mechanism calibration
+    (mechanisms/gaussian.py): sigma = sqrt(2 ln(1.25/delta)) * S / eps."""
+    return math.sqrt(2.0 * math.log(1.25 / delta)) * sensitivity / epsilon
+
+
+def laplace_scale(epsilon: float, sensitivity: float) -> float:
+    return sensitivity / epsilon
+
+
+def add_gaussian_noise(x: jax.Array, key: jax.Array, sigma: float) -> jax.Array:
+    return x + jax.random.normal(key, x.shape) * sigma
+
+
+def add_laplace_noise(x: jax.Array, key: jax.Array, scale: float) -> jax.Array:
+    return x + jax.random.laplace(key, x.shape) * scale
+
+
+def clip_by_norm(x: jax.Array, clip: float) -> jax.Array:
+    n = jnp.linalg.norm(x)
+    return x * jnp.minimum(1.0, clip / jnp.maximum(n, 1e-12))
+
+
+class FedMLDifferentialPrivacy:
+    """Facade with the reference's API shape (is_ldp_enabled/is_cdp_enabled/
+    add_local_noise/add_global_noise + clipping)."""
+
+    def __init__(self, cfg):
+        self.enabled = bool(getattr(cfg, "enable_dp", False))
+        self.solution = getattr(cfg, "dp_solution_type", "ldp").lower()
+        self.mechanism = getattr(cfg, "mechanism_type", "gaussian").lower()
+        self.epsilon = float(getattr(cfg, "epsilon", 1.0))
+        self.delta = float(getattr(cfg, "delta", 1e-5))
+        self.sensitivity = float(getattr(cfg, "sensitivity", 1.0))
+        self.clipping_norm = float(getattr(cfg, "clipping_norm", 1.0))
+
+    def is_ldp_enabled(self) -> bool:
+        return self.enabled and self.solution in ("ldp", "nbafl")
+
+    def is_cdp_enabled(self) -> bool:
+        return self.enabled and self.solution in ("cdp", "nbafl")
+
+    def _noise(self, x, key):
+        if self.mechanism == "gaussian":
+            return add_gaussian_noise(x, key, gaussian_sigma(self.epsilon, self.delta, self.sensitivity))
+        if self.mechanism == "laplace":
+            return add_laplace_noise(x, key, laplace_scale(self.epsilon, self.sensitivity))
+        raise ValueError(f"unknown mechanism {self.mechanism!r}")
+
+    def add_local_noise(self, update_flat: jax.Array, key: jax.Array) -> jax.Array:
+        """LDP: per-client noise on the update (reference ldp.py)."""
+        return self._noise(update_flat, key)
+
+    def add_global_noise(self, global_flat: jax.Array, key: jax.Array) -> jax.Array:
+        """CDP: noise on the aggregate (reference cdp.py / NbAFL down-link)."""
+        return self._noise(global_flat, key)
+
+    def global_clip(self, delta_flat: jax.Array) -> jax.Array:
+        return clip_by_norm(delta_flat, self.clipping_norm)
+
+
+def nbafl_uplink_sigma(clip: float, n_local: int, epsilon: float, delta: float) -> float:
+    """NbAFL (Wei et al., frames/NbAFL.py) up-link sigma_u = c*C*L/(n*eps)
+    with c = sqrt(2 ln(1.25/delta)); L=1 exposure per round."""
+    c = math.sqrt(2.0 * math.log(1.25 / delta))
+    return c * clip / max(n_local, 1) / epsilon
+
+
+def nbafl_downlink_sigma(clip: float, n_clients: int, rounds: int, epsilon: float, delta: float) -> float:
+    """NbAFL down-link sigma_d; zero when rounds <= sqrt(N) (paper Thm 2)."""
+    if rounds <= math.sqrt(n_clients):
+        return 0.0
+    c = math.sqrt(2.0 * math.log(1.25 / delta))
+    return 2.0 * c * clip * math.sqrt(rounds**2 - n_clients) / (max(n_clients, 1) * epsilon)

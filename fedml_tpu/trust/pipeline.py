@@ -1,0 +1,113 @@
+"""TrustPipeline — attacks, defenses, and DP around aggregation.
+
+This is the TPU form of the reference's lifecycle-hook chain (SURVEY.md §2.2):
+``ClientTrainer.on_after_local_training`` (LDP noise) ->
+``ServerAggregator.on_before_aggregation`` (defense filter + attack sim) ->
+``agg`` (defense may replace the operator) ->
+``on_after_aggregation`` (CDP clip/noise, defense post-processing)
+(``core/alg_frame/client_trainer.py:61-97``, ``server_aggregator.py:44-104``).
+
+All three hooks are pure and traced into the round program.  They operate on
+the flat (m, d) matrix of stacked client contributions; structured
+contributions (SCAFFOLD tuples etc.) are flattened wholesale — attack/defense
+geometry is calibrated for weights-style contributions, matching the
+reference, which likewise applies defenses to the raw client state_dict list.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import pytree as pt
+from .attack.attacks import FedMLAttacker
+from .defense import create as create_defense
+from .dp.dp import FedMLDifferentialPrivacy
+
+
+class TrustPipeline:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.attacker = FedMLAttacker(cfg) if getattr(cfg, "enable_attack", False) else None
+        self.defense = create_defense(cfg) if getattr(cfg, "enable_defense", False) else None
+        self.dp = FedMLDifferentialPrivacy(cfg) if getattr(cfg, "enable_dp", False) else None
+
+    @property
+    def active(self) -> bool:
+        return any((self.attacker, self.defense, self.dp))
+
+    @property
+    def needs_history(self) -> bool:
+        """True when the defense consumes the previous round's global delta
+        (cross-round family); the engine then threads it as a round argument."""
+        return self.defense is not None and hasattr(self.defense, "set_history")
+
+    # -- hook 1: on client outputs (attack simulation + LDP) -----------------
+    def on_client_outputs(self, contribs, weights, sampled_idx, global_vars, key):
+        run_attack = self.attacker is not None and self.attacker.is_model_attack()
+        run_ldp = self.dp is not None and self.dp.is_ldp_enabled()
+        if not run_attack and not run_ldp:
+            return contribs, weights
+        mat = pt.stacked_tree_to_matrix(contribs)
+        gflat = self._reference_flat(contribs, global_vars, mat.shape[1])
+        if run_attack:
+            mat = self.attacker.poison_model(mat, sampled_idx, gflat, jax.random.fold_in(key, 0xA77))
+        if run_ldp:
+            keys = jax.random.split(jax.random.fold_in(key, 0x1D9), mat.shape[0])
+            mat = jax.vmap(self.dp.add_local_noise)(mat, keys)
+        return pt.matrix_to_stacked_tree(mat, contribs), weights
+
+    # -- hook 2: before/at aggregation (defenses) ----------------------------
+    def on_aggregation(self, contribs, weights, global_vars, key, prev_delta=None):
+        """Returns (contribs, weights, agg_override_tree_or_None)."""
+        if self.defense is None:
+            return contribs, weights, None
+        if hasattr(self.defense, "set_key"):
+            self.defense.set_key(jax.random.fold_in(key, 0xDEF))
+        if prev_delta is not None and hasattr(self.defense, "set_history"):
+            self.defense.set_history(prev_delta)
+        mat = pt.stacked_tree_to_matrix(contribs)
+        gflat = self._reference_flat(contribs, global_vars, mat.shape[1])
+        mat, weights = self.defense.before(mat, weights, gflat)
+        agg_flat = self.defense.on_agg(mat, weights, gflat)
+        contribs = pt.matrix_to_stacked_tree(mat, contribs)
+        agg_tree = None
+        if agg_flat is not None:
+            one = jax.tree_util.tree_map(lambda x: x[0], contribs)
+            _, unravel = pt.tree_flatten_to_vector(one)
+            agg_tree = unravel(agg_flat)
+        return contribs, weights, agg_tree
+
+    # -- hook 3: after aggregation (CDP + defense post) ----------------------
+    def on_after_aggregation(self, new_global_vars, old_global_vars, key):
+        touched = False
+        flat, unravel = pt.tree_flatten_to_vector(new_global_vars)
+        old_flat, _ = pt.tree_flatten_to_vector(old_global_vars)
+        if self.dp is not None and self.dp.is_cdp_enabled():
+            delta = self.dp.global_clip(flat - old_flat)
+            flat = old_flat + delta
+            flat = self.dp.add_global_noise(flat, jax.random.fold_in(key, 0xCD9))
+            touched = True
+        if self.defense is not None:
+            new_flat = self.defense.after(flat, old_flat)
+            touched = touched or (new_flat is not flat)
+            flat = new_flat
+        return unravel(flat) if touched else new_global_vars
+
+    @staticmethod
+    def _reference_flat(contribs, global_vars, d):
+        """Flat global reference matching the contribution structure, or zeros
+        when contributions aren't weight-shaped (e.g. gradient contributions)."""
+        one = jax.tree_util.tree_map(lambda x: x[0], contribs)
+        if jax.tree_util.tree_structure(one) == jax.tree_util.tree_structure(global_vars):
+            flat, _ = pt.tree_flatten_to_vector(global_vars)
+            if flat.shape[0] == d:
+                return flat
+        return jnp.zeros((d,), jnp.float32)
+
+
+def build_trust_pipeline(cfg) -> Optional[TrustPipeline]:
+    tp = TrustPipeline(cfg)
+    return tp if tp.active else None

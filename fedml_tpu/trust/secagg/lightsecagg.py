@@ -1,0 +1,104 @@
+"""LightSecAgg — Lagrange-coded one-shot mask reconstruction.
+
+Parity with ``core/mpc/lightsecagg.py``: each client encodes its random mask
+with Lagrange coded computing (``mask_encoding`` :97) and distributes shares;
+to unmask, each surviving client sends ONE aggregate encoded mask
+(``compute_aggregate_encoded_mask`` :126); the server interpolates the sum of
+masks from any U survivors and subtracts it (dropout-tolerant with threshold
+T, unlike pairwise-mask SecAgg which needs per-dropout recovery).
+
+Shapes: model vector of length d is padded to d' divisible by (U - T);
+mask z_i ~ F_p^{d'}; split into U-T chunks; append T random chunks; encode at
+N evaluation points via Lagrange coefficients (one int64 matmul per client).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .field import DEFAULT_PRIME, gen_lagrange_coeffs, mod_inverse
+
+
+class LightSecAggProtocol:
+    def __init__(self, n_clients: int, privacy_t: int, target_u: int, p: int = DEFAULT_PRIME, seed: int = 0):
+        """n_clients=N, privacy threshold T (collusion tolerance),
+        reconstruction target U (need >= U survivors), T < U <= N."""
+        assert privacy_t < target_u <= n_clients
+        self.n = n_clients
+        self.t = privacy_t
+        self.u = target_u
+        self.p = p
+        self.rng = np.random.RandomState(seed)
+        # evaluation points: alpha_j for interpolation targets (U-T + T chunks),
+        # beta_i for the N clients — all distinct, nonzero.
+        self.alphas = np.arange(1, self.u + 1, dtype=np.int64)
+        self.betas = np.arange(self.u + 1, self.u + self.n + 1, dtype=np.int64)
+
+    def pad_len(self, d: int) -> int:
+        k = self.u - self.t
+        return ((d + k - 1) // k) * k
+
+    def gen_mask(self, d: int) -> np.ndarray:
+        return self.rng.randint(0, self.p, size=self.pad_len(d), dtype=np.int64)
+
+    def encode_mask(self, mask: np.ndarray) -> np.ndarray:
+        """(N, d'/(U-T)) encoded sub-masks, one row per receiving client —
+        reference ``mask_encoding``."""
+        k = self.u - self.t
+        chunks = mask.reshape(k, -1)  # (U-T, s)
+        noise = self.rng.randint(0, self.p, size=(self.t, chunks.shape[1]), dtype=np.int64)
+        extended = np.concatenate([chunks, noise], axis=0)  # (U, s)
+        W = gen_lagrange_coeffs(self.betas, self.alphas, self.p)  # (N, U)
+        # int64 modular matmul: accumulate mod p chunk-wise to avoid overflow
+        out = np.zeros((self.n, chunks.shape[1]), dtype=np.int64)
+        for j in range(self.u):
+            out = (out + W[:, j : j + 1] * extended[j : j + 1, :]) % self.p
+        return out
+
+    @staticmethod
+    def aggregate_encoded_masks(shares: list[np.ndarray]) -> np.ndarray:
+        """Each surviving client sums the encoded sub-masks it holds —
+        reference ``compute_aggregate_encoded_mask``."""
+        out = shares[0].copy()
+        for s in shares[1:]:
+            out = (out + s) % DEFAULT_PRIME
+        return out
+
+    def decode_aggregate_mask(self, agg_shares: dict[int, np.ndarray], d_pad: int) -> np.ndarray:
+        """Server: interpolate sum-of-masks from >= U survivors' aggregates —
+        reference ``aggregate_models_in_finite`` decoding path."""
+        survivors = sorted(agg_shares.keys())[: self.u]
+        assert len(survivors) >= self.u, f"need {self.u} survivors, have {len(agg_shares)}"
+        eval_pts = self.betas[np.array(survivors)]
+        W = gen_lagrange_coeffs(self.alphas[: self.u - self.t], eval_pts, self.p)  # (U-T, U)
+        s = agg_shares[survivors[0]].shape[0]
+        chunks = np.zeros((self.u - self.t, s), dtype=np.int64)
+        for col, cid in enumerate(survivors):
+            chunks = (chunks + W[:, col : col + 1] * agg_shares[cid][None, :]) % self.p
+        return chunks.reshape(-1)[:d_pad]
+
+
+def secure_aggregate(vectors: list[np.ndarray], protocol: LightSecAggProtocol,
+                     dropout: set[int] = frozenset()) -> np.ndarray:
+    """End-to-end round over quantized field vectors: mask, share, drop some
+    clients, reconstruct the sum of SURVIVORS' vectors.  Returns field sum."""
+    n = protocol.n
+    d = len(vectors[0])
+    dp = protocol.pad_len(d)
+    masks = [protocol.gen_mask(d) for _ in range(n)]
+    encoded = [protocol.encode_mask(m) for m in masks]  # encoded[i][j] -> share of i's mask held by j
+    survivors = [i for i in range(n) if i not in dropout]
+    # each client uploads masked vector (only survivors')
+    masked = {
+        i: (np.pad(vectors[i], (0, dp - d)) + masks[i]) % protocol.p for i in survivors
+    }
+    # surviving clients aggregate the encoded sub-masks of *surviving* sources
+    agg_shares = {
+        j: LightSecAggProtocol.aggregate_encoded_masks([encoded[i][j] for i in survivors])
+        for j in survivors
+    }
+    mask_sum = protocol.decode_aggregate_mask(agg_shares, dp)
+    total = np.zeros(dp, dtype=np.int64)
+    for i in survivors:
+        total = (total + masked[i]) % protocol.p
+    return (total - mask_sum) % protocol.p

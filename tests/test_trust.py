@@ -1,0 +1,347 @@
+"""Trust stack tests.
+
+Models the reference's security test pattern (``python/tests/security/`` —
+assert attack/defense math on synthetic gradient lists, SURVEY.md §4), plus
+end-to-end "defense recovers accuracy under attack" runs the reference lacks.
+"""
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _mat(m=8, d=20, seed=0):
+    return np.random.RandomState(seed).normal(0, 1, (m, d)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# defense math units
+# ---------------------------------------------------------------------------
+
+def test_krum_rejects_outlier(eight_devices):
+    import jax.numpy as jnp
+    from fedml_tpu.trust.defense.robust_agg import KrumDefense
+
+    u = _mat()
+    u[3] += 100.0  # blatant outlier
+    d = KrumDefense(byzantine_num=1, select_m=3)
+    _, w = d.before(jnp.asarray(u), jnp.ones(8), jnp.zeros(20))
+    w = np.asarray(w)
+    assert w[3] == 0.0, "outlier should be deselected"
+    assert w.sum() == 3.0
+
+
+def test_geometric_median_robust(eight_devices):
+    import jax.numpy as jnp
+    from fedml_tpu.trust.defense.robust_agg import GeometricMedianDefense
+
+    u = np.zeros((9, 5), np.float32)
+    u[:6] = 1.0  # honest cluster at 1
+    u[6:] = 1000.0  # 3 attackers far away
+    agg = GeometricMedianDefense(iters=32).on_agg(jnp.asarray(u), jnp.ones(9), jnp.zeros(5))
+    assert np.allclose(np.asarray(agg), 1.0, atol=0.2), np.asarray(agg)
+
+
+def test_trimmed_mean_and_median(eight_devices):
+    import jax.numpy as jnp
+    from fedml_tpu.trust.defense.robust_agg import (
+        CoordinateWiseMedianDefense, TrimmedMeanDefense,
+    )
+
+    u = _mat(10, 6, seed=1)
+    u[0] = 1e6
+    med = CoordinateWiseMedianDefense().on_agg(jnp.asarray(u), jnp.ones(10), jnp.zeros(6))
+    assert np.abs(np.asarray(med)).max() < 10
+    tm = TrimmedMeanDefense(beta=0.2).on_agg(jnp.asarray(u), jnp.ones(10), jnp.zeros(6))
+    assert np.abs(np.asarray(tm)).max() < 10
+
+
+def test_norm_clipping(eight_devices):
+    import jax.numpy as jnp
+    from fedml_tpu.trust.defense.clipping import NormDiffClippingDefense
+
+    g = jnp.zeros(16)
+    u = jnp.ones((4, 16)) * 10.0
+    clipped, _ = NormDiffClippingDefense(norm_bound=1.0).before(u, jnp.ones(4), g)
+    norms = np.linalg.norm(np.asarray(clipped), axis=1)
+    assert (norms <= 1.0 + 1e-5).all()
+
+
+def test_foolsgold_downweights_sybils(eight_devices):
+    import jax.numpy as jnp
+    from fedml_tpu.trust.defense.anomaly import FoolsGoldDefense
+
+    rng = np.random.RandomState(0)
+    honest = rng.normal(0, 1, (5, 30)).astype(np.float32)
+    sybil = np.tile(rng.normal(0, 1, (1, 30)), (3, 1)).astype(np.float32)
+    u = jnp.asarray(np.concatenate([honest, sybil]))
+    _, w = FoolsGoldDefense().before(u, jnp.ones(8), jnp.zeros(30))
+    w = np.asarray(w)
+    assert w[5:].max() < 0.1 * max(w[:5].mean(), 1e-9), w
+
+
+def test_three_sigma_family(eight_devices):
+    import jax.numpy as jnp
+    from fedml_tpu.trust.defense import create
+    from fedml_tpu.arguments import Config
+
+    u = _mat(10, 8, seed=2)
+    u[7] += 50.0
+    for dt in ("three_sigma", "three_sigma_geomedian", "three_sigma_krum"):
+        cfg = Config(enable_defense=True, defense_type=dt, outlier_detection_k=2.0)
+        d = create(cfg)
+        _, w = d.before(jnp.asarray(u), jnp.ones(10), jnp.zeros(8))
+        assert np.asarray(w)[7] == 0.0, dt
+
+
+# ---------------------------------------------------------------------------
+# attack units
+# ---------------------------------------------------------------------------
+
+def test_byzantine_and_replacement(eight_devices):
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.trust.attack import attacks as atk
+
+    u = jnp.asarray(_mat(6, 10))
+    sampled = jnp.arange(6, dtype=jnp.int32)
+    mask = atk.malicious_mask(6, sampled, [1, 4])
+    np.testing.assert_array_equal(np.asarray(mask), [0, 1, 0, 0, 1, 0])
+    z = atk.byzantine_zero(u, mask)
+    assert np.asarray(z)[1].sum() == 0 and np.asarray(z)[4].sum() == 0
+    assert np.allclose(np.asarray(z)[0], np.asarray(u)[0])
+    g = jnp.ones(10)
+    lazy = atk.lazy_worker(u, mask, g)
+    assert np.allclose(np.asarray(lazy)[1], 1.0)
+    boosted = atk.model_replacement(u, mask, g, boost=5.0)
+    expected = 1.0 + 5.0 * (np.asarray(u)[1] - 1.0)
+    assert np.allclose(np.asarray(boosted)[1], expected, atol=1e-5)
+
+
+def test_label_flipping_poison():
+    from fedml_tpu.trust.attack.attacks import flip_labels
+
+    labels = np.array([0, 1, 0, 1, 0, 1, 0, 1])
+    client_idx = [np.array([0, 1, 2, 3]), np.array([4, 5, 6, 7])]
+    out = flip_labels(labels, client_idx, [0], original_class=1, target_class=0)
+    np.testing.assert_array_equal(out[:4], [0, 0, 0, 0])  # client 0 poisoned
+    np.testing.assert_array_equal(out[4:], labels[4:])  # client 1 untouched
+
+
+def test_revealing_labels(eight_devices):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from fedml_tpu.trust.attack.dlg import revealing_labels_from_gradients
+
+    # simple linear model with bias; batch contains classes {1, 3}
+    k = jax.random.PRNGKey(0)
+    W = jax.random.normal(k, (12, 5)) * 0.1
+    b = jnp.zeros(5)
+    x = jax.random.normal(jax.random.fold_in(k, 1), (4, 12))
+    y = jnp.array([1, 3, 1, 3])
+    gb = jax.grad(
+        lambda b: optax.softmax_cross_entropy_with_integer_labels(x @ W + b, y).mean()
+    )(b)
+    present = np.asarray(revealing_labels_from_gradients(gb))
+    assert present[1] and present[3]
+    assert not present[0] and not present[2] and not present[4]
+
+
+# ---------------------------------------------------------------------------
+# DP units
+# ---------------------------------------------------------------------------
+
+def test_dp_calibration_and_noise(eight_devices):
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.trust.dp.dp import FedMLDifferentialPrivacy, gaussian_sigma
+
+    sigma = gaussian_sigma(epsilon=1.0, delta=1e-5, sensitivity=1.0)
+    assert 4.0 < sigma < 5.0  # sqrt(2 ln(1.25e5)) ~ 4.84
+    dp = FedMLDifferentialPrivacy(Config(enable_dp=True, dp_solution_type="ldp", epsilon=1.0))
+    x = jnp.zeros(100000)
+    noised = dp.add_local_noise(x, jax.random.PRNGKey(0))
+    emp = float(jnp.std(noised))
+    assert abs(emp - sigma) / sigma < 0.05
+
+
+def test_rdp_accountant_monotone():
+    from fedml_tpu.trust.dp.accountant import RDPAccountant
+
+    a = RDPAccountant(q=0.01, noise_multiplier=1.0)
+    a.step(10)
+    e10 = a.get_epsilon(1e-5)
+    a.step(990)
+    e1000 = a.get_epsilon(1e-5)
+    assert 0 < e10 < e1000 < 100
+
+
+# ---------------------------------------------------------------------------
+# SecAgg units
+# ---------------------------------------------------------------------------
+
+def test_shamir_roundtrip():
+    from fedml_tpu.trust.secagg.shamir import shamir_reconstruct, shamir_share
+
+    rng = np.random.RandomState(0)
+    secret = 123456789
+    shares = shamir_share(secret, n=5, t=3, rng=rng)
+    assert shamir_reconstruct(shares[:3]) == secret
+    assert shamir_reconstruct(shares[1:4]) == secret
+    # fewer than t shares gives garbage (overwhelmingly likely)
+    assert shamir_reconstruct(shares[:2]) != secret
+
+
+def test_lightsecagg_with_dropout():
+    from fedml_tpu.trust.secagg.field import dequantize_from_field, quantize_to_field
+    from fedml_tpu.trust.secagg.lightsecagg import LightSecAggProtocol, secure_aggregate
+
+    rng = np.random.RandomState(1)
+    vecs_f = [rng.normal(0, 1, 40) for _ in range(6)]
+    proto = LightSecAggProtocol(n_clients=6, privacy_t=1, target_u=4, seed=0)
+    vecs_q = [quantize_to_field(v) for v in vecs_f]
+    # drop 2 clients; sum should equal survivors' plain sum
+    dropped = {2, 5}
+    total_field = secure_aggregate(vecs_q, proto, dropout=dropped)
+    got = dequantize_from_field(total_field[:40], n_summands=4)
+    expected = sum(vecs_f[i] for i in range(6) if i not in dropped)
+    np.testing.assert_allclose(got, expected, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: attack degrades, defense restores
+# ---------------------------------------------------------------------------
+
+def test_defense_restores_accuracy_under_attack(eight_devices):
+    import fedml_tpu
+
+    base = dict(
+        comm_round=8, learning_rate=0.3, client_num_per_round=8,
+        enable_attack=True, attack_type="byzantine_random",
+        poisoned_client_list=(0, 1, 2),
+    )
+    # attacked, undefended
+    h_atk = fedml_tpu.run_simulation(tiny_config(**base))
+    acc_atk = h_atk[-1]["test_acc"]
+    # attacked + krum defense
+    h_def = fedml_tpu.run_simulation(tiny_config(
+        **base, enable_defense=True, defense_type="multikrum",
+        byzantine_client_num=3, krum_param_m=4,
+    ))
+    acc_def = h_def[-1]["test_acc"]
+    assert acc_def > acc_atk + 0.1, f"defense {acc_def} vs attacked {acc_atk}"
+    assert acc_def > 0.4
+
+
+def test_ldp_noise_changes_model_but_learns(eight_devices):
+    import fedml_tpu
+
+    h = fedml_tpu.run_simulation(tiny_config(
+        comm_round=8, learning_rate=0.3, client_num_per_round=8,
+        enable_dp=True, dp_solution_type="ldp", mechanism_type="gaussian",
+        epsilon=50.0, delta=1e-5, sensitivity=0.01,
+    ))
+    assert h[-1]["test_acc"] > 0.3
+
+
+def test_contribution_assessment(eight_devices):
+    import fedml_tpu
+
+    cfg = tiny_config(
+        comm_round=3, client_num_per_round=4, enable_contribution=True,
+        contribution_method="leave_one_out",
+    )
+    fedml_tpu.init(cfg)
+    from fedml_tpu.runner import FedMLRunner
+
+    runner = FedMLRunner(cfg)
+    runner.run()
+    scores = runner.runner.assess_contribution()
+    assert scores is not None and len(scores) == 4
+    assert np.isfinite(scores).all()
+
+
+def test_label_flipping_end_to_end(eight_devices):
+    """Data-poisoning attacks must actually poison the stacked dataset."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(
+        comm_round=1, enable_attack=True, attack_type="label_flipping",
+        poisoned_client_list=(0, 1, 2, 3),
+    )
+    cfg.extra = {"attack_original_class": 0, "attack_target_class": 1}
+    fedml_tpu.init(cfg)
+    runner = FedMLRunner(cfg)
+    ds = runner.runner.dataset
+    for c in (0, 1, 2, 3):
+        assert (ds.train_y[ds.client_idx[c]] == 0).sum() == 0, "class 0 should be flipped"
+    # honest clients keep class 0 samples
+    remaining = sum((ds.train_y[ds.client_idx[c]] == 0).sum() for c in (4, 5, 6, 7))
+    assert remaining > 0
+
+
+def test_unknown_attack_type_raises(eight_devices):
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+    import pytest as _pt
+
+    cfg = tiny_config(enable_attack=True, attack_type="mind_control")
+    fedml_tpu.init(cfg)
+    with _pt.raises(ValueError, match="unknown attack_type"):
+        FedMLRunner(cfg)
+
+
+def test_trust_applies_on_sp_backend(eight_devices):
+    """Security hooks must be backend-independent: byzantine attack with no
+    defense must degrade the SP backend run too."""
+    import fedml_tpu
+
+    base = dict(comm_round=6, learning_rate=0.3, client_num_per_round=8, backend_sim="sp")
+    h_clean = fedml_tpu.run_simulation(tiny_config(**base))
+    h_atk = fedml_tpu.run_simulation(tiny_config(
+        **base, enable_attack=True, attack_type="byzantine_random",
+        poisoned_client_list=(0, 1, 2, 3),
+    ))
+    assert h_atk[-1]["test_acc"] < h_clean[-1]["test_acc"] - 0.1
+
+
+def test_cross_round_defense_history_threads(eight_devices):
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+    import numpy as _np
+
+    cfg = tiny_config(
+        comm_round=3, client_num_per_round=4,
+        enable_defense=True, defense_type="cross_round",
+    )
+    fedml_tpu.init(cfg)
+    runner = FedMLRunner(cfg)
+    sim = runner.runner
+    assert sim.defense_history is not None
+    assert float(abs(sim.defense_history).sum()) == 0.0
+    runner.run()
+    assert float(abs(sim.defense_history).sum()) > 0.0, "history never updated"
+
+
+def test_gtg_shapley_nonzero_on_distinct_clients(eight_devices):
+    """GTG-Shapley must produce nonzero marginals when coalitions matter."""
+    import jax.numpy as jnp
+    import numpy as _np
+    from fedml_tpu.trust.contribution import gtg_shapley
+
+    # 1-d "models": contribution i has value v_i; eval = -|mean - target|
+    stacked = {"w": jnp.asarray([[1.0], [1.0], [-5.0]])}
+    empty = {"w": jnp.asarray([0.0])}
+    weights = _np.ones(3)
+
+    def eval_fn(model):
+        return -abs(float(model["w"][0] if model["w"].ndim else model["w"]) - 1.0)
+
+    scores = gtg_shapley(stacked, weights, eval_fn, empty, rounds_cap=30, eps=1e-4, seed=0)
+    assert _np.abs(scores).sum() > 0, scores
+    # the adversarial client (-5) must score below the helpful ones
+    assert scores[2] < scores[0] and scores[2] < scores[1], scores
