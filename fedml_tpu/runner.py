@@ -73,8 +73,77 @@ class FedMLRunner:
             self.model = model_hub.create(self.cfg, self.dataset.class_num)
         return self.dataset, self.model
 
+    # simulators that bypass the MeshSimulator (and its trust pipeline /
+    # custom-trainer support)
+    _SPECIAL_SIM_OPTIMIZERS = {
+        C.FEDERATED_OPTIMIZER_DECENTRALIZED_FL,
+        C.FEDERATED_OPTIMIZER_HIERARCHICAL_FL,
+        C.FEDERATED_OPTIMIZER_ASYNC_FEDAVG,
+        C.FEDERATED_OPTIMIZER_SPLIT_NN,
+        C.FEDERATED_OPTIMIZER_FEDGKT,
+        C.FEDERATED_OPTIMIZER_VERTICAL_FL,
+    }
+    # these build their own model pair internally; model_hub model is unused
+    _OWN_MODEL_OPTIMIZERS = {
+        C.FEDERATED_OPTIMIZER_SPLIT_NN,
+        C.FEDERATED_OPTIMIZER_FEDGKT,
+        C.FEDERATED_OPTIMIZER_VERTICAL_FL,
+    }
+
     def _init_simulation_runner(self):
-        dataset, model = self._load_data_model()
+        opt = self.cfg.federated_optimizer
+        if opt in self._SPECIAL_SIM_OPTIMIZERS:
+            # trust flags must never be silent no-ops (see
+            # _check_unimplemented_flags): these simulators don't wire the
+            # trust pipeline yet, so refuse rather than ignore
+            active = [
+                f for f in _IMPLEMENTED_TRUST_FLAGS if getattr(self.cfg, f, False)
+            ]
+            if active:
+                raise NotImplementedError(
+                    f"trust features {active} are not yet wired into the "
+                    f"{opt!r} simulator (supported on the FedAvg-family mesh "
+                    "engine); refusing to run without them"
+                )
+            if self.client_trainer is not None or self.server_aggregator is not None:
+                raise ValueError(
+                    f"custom client_trainer/server_aggregator are not used by "
+                    f"the {opt!r} simulator; remove them or use a FedAvg-family optimizer"
+                )
+        if self.dataset is None:
+            from .data import loader
+
+            self.dataset = loader.load(self.cfg)
+        dataset = self.dataset
+        if self.model is None and opt not in self._OWN_MODEL_OPTIMIZERS:
+            from .models import model_hub
+
+            self.model = model_hub.create(self.cfg, dataset.class_num)
+        model = self.model
+        if opt == C.FEDERATED_OPTIMIZER_DECENTRALIZED_FL:
+            from .sim.decentralized import DecentralizedSimulator
+
+            return DecentralizedSimulator(self.cfg, dataset, model)
+        if opt == C.FEDERATED_OPTIMIZER_HIERARCHICAL_FL:
+            from .sim.hierarchical import HierarchicalSimulator
+
+            return HierarchicalSimulator(self.cfg, dataset, model)
+        if opt == C.FEDERATED_OPTIMIZER_ASYNC_FEDAVG:
+            from .sim.async_fl import AsyncSimulator
+
+            return AsyncSimulator(self.cfg, dataset, model)
+        if opt == C.FEDERATED_OPTIMIZER_SPLIT_NN:
+            from .sim.split_learning import SplitNNSimulator
+
+            return SplitNNSimulator(self.cfg, dataset)
+        if opt == C.FEDERATED_OPTIMIZER_FEDGKT:
+            from .sim.split_learning import FedGKTSimulator
+
+            return FedGKTSimulator(self.cfg, dataset)
+        if opt == C.FEDERATED_OPTIMIZER_VERTICAL_FL:
+            from .sim.vertical import VFLSimulator
+
+            return VFLSimulator(self.cfg, dataset)
         from .sim.engine import MeshSimulator
 
         return MeshSimulator(self.cfg, dataset, model, algorithm=self.client_trainer)

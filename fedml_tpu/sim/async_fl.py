@@ -1,0 +1,138 @@
+"""Asynchronous FedAvg — staleness-weighted server updates, no round barrier.
+
+Reference: ``simulation/mpi/async_fedavg/`` (``AsyncFedAVGAggregator.py:14`` —
+the server mixes each arriving client model with weight decayed by staleness;
+staleness functions constant/polynomial/hinge as in FedAsync, Xie et al.).
+
+Simulation model: server steps t = 0, 1, 2, ...; at each step one client
+"arrives" having trained from the global model of version t - s (s = its
+staleness, drawn from its speed profile).  A ring buffer of the last K global
+models provides the stale starting points — all device-resident, the whole
+step jitted.  Mixing: w_{t+1} = (1 - a_s) w_t + a_s w_client, with
+a_s = alpha * staleness_func(s).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms import hparams_from_config
+from ..arguments import Config
+from ..core import pytree as pt, rng
+from ..data.dataset import pad_eval_set, stack_clients
+from ..fl.local_sgd import make_eval_fn, make_local_train_fn
+from ..obs.metrics import MetricsLogger
+from ..parallel import mesh as meshlib
+
+HISTORY = 8  # ring buffer depth == max staleness
+
+
+def staleness_factor(kind: str, s: jax.Array, alpha: float) -> jax.Array:
+    s = s.astype(jnp.float32)
+    if kind == "constant":
+        return jnp.full_like(s, alpha)
+    if kind == "polynomial":
+        return alpha * (s + 1.0) ** -0.5
+    if kind == "hinge":
+        return alpha / (1.0 + jnp.maximum(s - 4.0, 0.0))
+    raise ValueError(f"unknown staleness function {kind!r}")
+
+
+class AsyncSimulator:
+    def __init__(self, cfg: Config, dataset, model, mesh=None):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+        stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
+        spe = max(1, math.ceil(stacked.capacity / cfg.batch_size))
+        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        self._local_train = make_local_train_fn(model, self.hp)
+        self.mesh = mesh if mesh is not None else meshlib.mesh_from_config(cfg)
+
+        k0 = rng.root_key(cfg.random_seed)
+        sample_x = jnp.asarray(stacked.x[0, : cfg.batch_size])
+        self.global_vars = model.init(
+            {"params": jax.random.fold_in(k0, 1), "dropout": jax.random.fold_in(k0, 2)},
+            sample_x, train=True,
+        )
+        # ring buffer of past globals (for stale starting points)
+        self.history = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (HISTORY,) + x.shape).copy(), self.global_vars
+        )
+        self._data = (jnp.asarray(stacked.x), jnp.asarray(stacked.y))
+        self.counts = jnp.asarray(stacked.counts)
+        self.root_key = k0
+        self.step_idx = 0
+        self.alpha = float(cfg.async_staleness_alpha)
+        self.staleness_kind = cfg.async_staleness_func
+
+        eval_bs = min(256, max(32, cfg.test_batch_size))
+        tx, ty, n_valid = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
+        self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
+        self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
+        self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
+        self._step_fn = jax.jit(self._make_step_fn())
+
+    def _make_step_fn(self):
+        n = self.dataset.n_clients
+        alpha = self.alpha
+        kind = self.staleness_kind
+
+        def step_fn(global_vars, history, data_x, data_y, counts, step_idx, key):
+            skey = rng.round_key(key, step_idx)
+            # which client arrives, and how stale is it (slower clients -> staler)
+            client = jax.random.randint(jax.random.fold_in(skey, 1), (), 0, n)
+            staleness = jax.random.randint(
+                jax.random.fold_in(skey, 2), (), 0, jnp.minimum(HISTORY, step_idx + 1)
+            )
+            start = jax.tree_util.tree_map(
+                lambda h: jnp.take(h, (step_idx - staleness) % HISTORY, axis=0), history
+            )
+            x = jnp.take(data_x, client, axis=0)
+            y = jnp.take(data_y, client, axis=0)
+            c = jnp.take(counts, client)
+            trained, metrics = self._local_train(start, x, y, c, rng.client_key(skey, client), None)
+            a = staleness_factor(kind, staleness, alpha)
+            new_global = jax.tree_util.tree_map(
+                lambda g, t: ((1.0 - a) * g.astype(jnp.float32) + a * t.astype(jnp.float32)).astype(g.dtype),
+                global_vars, trained,
+            )
+            new_history = jax.tree_util.tree_map(
+                lambda h, g: h.at[(step_idx + 1) % HISTORY].set(g), history, new_global
+            )
+            metrics = dict(metrics)
+            metrics["staleness"] = staleness.astype(jnp.float32)
+            return new_global, new_history, metrics
+
+        return step_fn
+
+    def run_step(self) -> dict:
+        self.global_vars, self.history, metrics = self._step_fn(
+            self.global_vars, self.history, self._data[0], self._data[1],
+            self.counts, jnp.int32(self.step_idx), self.root_key,
+        )
+        self.step_idx += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def evaluate(self) -> dict:
+        return {k: float(v) for k, v in self._eval_fn(self.global_vars, *self._test).items()}
+
+    def run(self) -> list[dict]:
+        """comm_round here counts server update steps (client arrivals)."""
+        history = []
+        for t in range(self.cfg.comm_round):
+            t0 = time.perf_counter()
+            metrics = self.run_step()
+            metrics.update(round=t, round_time_s=time.perf_counter() - t0)
+            if self.cfg.frequency_of_the_test and (
+                (t + 1) % self.cfg.frequency_of_the_test == 0 or t == self.cfg.comm_round - 1
+            ):
+                metrics.update(self.evaluate())
+            self.logger.log(metrics)
+            history.append(metrics)
+        return history

@@ -1,0 +1,157 @@
+"""Decentralized FL — DSGD and PushSum over topology mixing matrices.
+
+Reference: ``simulation/sp/decentralized/`` (``client_dsgd.py``,
+``client_pushsum.py``) + ``core/distributed/topology/`` and the MPI
+``decentralized_framework`` (gossip message passing between neighbor ranks).
+
+TPU-native form (SURVEY.md §2.14 P10): all N clients' parameters live as one
+stacked pytree sharded over the mesh; a gossip round is
+
+    local SGD (vmap over clients)  ->  P' = W @ P   (mixing matmul)
+
+The neighbor exchange that the reference implements with per-edge messages is
+a single (N, N) x (N, d) matmul on the MXU — sparse topologies are just
+sparse rows of W.  PushSum additionally threads the scalar weight column and
+de-biases by it (directed graphs).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms import hparams_from_config
+from ..arguments import Config
+from ..core import pytree as pt, rng
+from ..data.dataset import pad_eval_set, stack_clients
+from ..fl.local_sgd import make_eval_fn, make_local_train_fn
+from ..obs.metrics import MetricsLogger
+from ..parallel import mesh as meshlib, topology as topo
+
+
+class DecentralizedSimulator:
+    """DSGD (symmetric W) / PushSum (row-stochastic directed W)."""
+
+    def __init__(self, cfg: Config, dataset, model, mesh=None, mode: str = None):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+        if mode is None:
+            mode = (getattr(cfg, "extra", {}) or {}).get("decentralized_mode", "dsgd")
+        self.mode = mode
+        n = dataset.n_clients
+        stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
+        spe = max(1, math.ceil(stacked.capacity / cfg.batch_size))
+        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        self._local_train = make_local_train_fn(model, self.hp)
+        self.mesh = mesh if mesh is not None else meshlib.mesh_from_config(cfg)
+
+        neighbor_num = int(getattr(cfg, "extra", {}).get("topology_neighbor_num", 2) or 2)
+        if mode == "pushsum":
+            W = topo.asymmetric_topology(n, neighbor_num, seed=cfg.random_seed)
+        else:
+            W = topo.symmetric_topology(n, neighbor_num, seed=cfg.random_seed)
+        self.W = jnp.asarray(W)
+
+        k0 = rng.root_key(cfg.random_seed)
+        sample_x = jnp.asarray(stacked.x[0, : cfg.batch_size])
+        one = model.init(
+            {"params": jax.random.fold_in(k0, 1), "dropout": jax.random.fold_in(k0, 2)},
+            sample_x, train=True,
+        )
+        # every client starts from the same init, stacked over clients
+        self.client_vars = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), one
+        )
+        self.client_vars = meshlib.shard_leading_axis(self.client_vars, self.mesh)
+        self.push_weights = jnp.ones((n,))  # PushSum de-bias column
+        self._data = tuple(meshlib.shard_leading_axis((jnp.asarray(stacked.x), jnp.asarray(stacked.y)), self.mesh))
+        self.counts = jnp.asarray(stacked.counts)
+        self.root_key = k0
+        self.round_idx = 0
+
+        eval_bs = min(256, max(32, cfg.test_batch_size))
+        tx, ty, n_valid = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
+        self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
+        self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
+        self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
+        self._round_fn = jax.jit(self._make_round_fn())
+
+    def _make_round_fn(self):
+        W = self.W
+        mode = self.mode
+
+        def mix(stacked_tree):
+            return jax.tree_util.tree_map(
+                lambda leaf: jnp.tensordot(W, leaf.astype(jnp.float32), axes=([1], [0])).astype(leaf.dtype),
+                stacked_tree,
+            )
+
+        def round_fn(client_vars, push_w, data_x, data_y, counts, round_idx, key):
+            rkey = rng.round_key(key, round_idx)
+            n = counts.shape[0]
+            keys = jax.vmap(lambda i: rng.client_key(rkey, i))(jnp.arange(n))
+            trained, metrics = jax.vmap(
+                lambda v, x, y, c, k: self._local_train(v, x, y, c, k, None)
+            )(client_vars, data_x, data_y, counts, keys)
+            if mode == "pushsum":
+                # mix both the weighted params and the weights; de-bias
+                weighted = jax.tree_util.tree_map(
+                    lambda l: l * push_w.reshape((-1,) + (1,) * (l.ndim - 1)), trained
+                )
+                mixed = mix(weighted)
+                new_w = W @ push_w
+                debiased = jax.tree_util.tree_map(
+                    lambda l: l / new_w.reshape((-1,) + (1,) * (l.ndim - 1)), mixed
+                )
+                return debiased, new_w, {k: jnp.mean(v) for k, v in metrics.items()}
+            mixed = mix(trained)
+            return mixed, push_w, {k: jnp.mean(v) for k, v in metrics.items()}
+
+        return round_fn
+
+    def run_round(self) -> dict:
+        self.client_vars, self.push_weights, metrics = self._round_fn(
+            self.client_vars, self.push_weights, self._data[0], self._data[1],
+            self.counts, jnp.int32(self.round_idx), self.root_key,
+        )
+        self.round_idx += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def consensus_model(self):
+        """Average of all clients' models (the consensus point)."""
+        return jax.tree_util.tree_map(lambda l: jnp.mean(l.astype(jnp.float32), axis=0).astype(l.dtype), self.client_vars)
+
+    def consensus_distance(self) -> float:
+        """Mean squared distance of clients to the consensus — the standard
+        decentralized-convergence diagnostic."""
+        mean = self.consensus_model()
+        d = jax.tree_util.tree_map(
+            lambda l, m: jnp.mean(jnp.sum((l.astype(jnp.float32) - m[None].astype(jnp.float32)) ** 2,
+                                          axis=tuple(range(1, l.ndim)))),
+            self.client_vars, mean,
+        )
+        return float(jax.tree_util.tree_reduce(jnp.add, d, jnp.float32(0)))
+
+    def evaluate(self) -> dict:
+        return {k: float(v) for k, v in self._eval_fn(self.consensus_model(), *self._test).items()}
+
+    def run(self) -> list[dict]:
+        history = []
+        for r in range(self.cfg.comm_round):
+            t0 = time.perf_counter()
+            metrics = self.run_round()
+            metrics.update(round=r, round_time_s=time.perf_counter() - t0)
+            if self.cfg.frequency_of_the_test and (
+                (r + 1) % self.cfg.frequency_of_the_test == 0 or r == self.cfg.comm_round - 1
+            ):
+                metrics.update(self.evaluate())
+                metrics["consensus_dist"] = self.consensus_distance()
+            self.logger.log(metrics)
+            history.append(metrics)
+        return history

@@ -1,0 +1,146 @@
+"""Hierarchical FL — groups run sub-rounds, then a global aggregate.
+
+Reference: ``simulation/sp/hierarchical_fl/`` (``trainer.py:10`` — each group
+performs ``group_comm_round`` FedAvg sub-rounds over its members, then groups
+are averaged globally) and the cross-silo hierarchical topology (SURVEY.md
+§2.14 P5: intra-silo DP x inter-silo FL).
+
+TPU-native form: group membership is a static (n_clients,) -> group map; a
+global round is
+
+    scan over sub-rounds:
+        vmap local SGD over all sampled clients       (clients mesh axis)
+        segment-weighted group means  (jax.ops.segment_sum — the intra-group
+        "silo aggregation" collective)
+    weighted mean over groups                          (global aggregate)
+
+On a 2-D (silo, data) mesh the segment reduction rides the intra-silo ICI
+axis and only the final group mean crosses silos (DCN) — the same traffic
+shape as the reference's torchrun-DDP-inside + MQTT-across layout.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms import hparams_from_config
+from ..arguments import Config
+from ..core import pytree as pt, rng
+from ..data.dataset import pad_eval_set, stack_clients
+from ..fl.local_sgd import make_eval_fn, make_local_train_fn
+from ..obs.metrics import MetricsLogger
+from ..parallel import mesh as meshlib
+
+
+class HierarchicalSimulator:
+    def __init__(self, cfg: Config, dataset, model, mesh=None):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+        n = dataset.n_clients
+        self.group_num = max(1, int(cfg.group_num))
+        self.group_comm_round = max(1, int(cfg.group_comm_round))
+        # round-robin group assignment (reference partitions client list evenly)
+        self.group_of = jnp.asarray(np.arange(n) % self.group_num, jnp.int32)
+
+        stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
+        spe = max(1, math.ceil(stacked.capacity / cfg.batch_size))
+        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        self._local_train = make_local_train_fn(model, self.hp)
+        self.mesh = mesh if mesh is not None else meshlib.mesh_from_config(cfg)
+
+        k0 = rng.root_key(cfg.random_seed)
+        sample_x = jnp.asarray(stacked.x[0, : cfg.batch_size])
+        self.global_vars = model.init(
+            {"params": jax.random.fold_in(k0, 1), "dropout": jax.random.fold_in(k0, 2)},
+            sample_x, train=True,
+        )
+        self._data = tuple(
+            meshlib.shard_leading_axis((jnp.asarray(stacked.x), jnp.asarray(stacked.y)), self.mesh)
+        )
+        self.counts = jnp.asarray(stacked.counts)
+        self.root_key = k0
+        self.round_idx = 0
+
+        eval_bs = min(256, max(32, cfg.test_batch_size))
+        tx, ty, n_valid = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
+        self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
+        self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
+        self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
+        self._round_fn = jax.jit(self._make_round_fn())
+
+    def _make_round_fn(self):
+        G = self.group_num
+        group_of = self.group_of
+        sub_rounds = self.group_comm_round
+
+        def group_mean(stacked_tree, weights):
+            """Per-group sample-weighted mean via segment_sum (the silo
+            aggregation collective)."""
+            wsum = jax.ops.segment_sum(weights, group_of, num_segments=G)  # (G,)
+
+            def red(leaf):
+                wleaf = leaf.astype(jnp.float32) * weights.reshape((-1,) + (1,) * (leaf.ndim - 1))
+                s = jax.ops.segment_sum(wleaf, group_of, num_segments=G)
+                return s / jnp.maximum(wsum, 1e-12).reshape((-1,) + (1,) * (s.ndim - 1))
+
+            return jax.tree_util.tree_map(red, stacked_tree), wsum
+
+        def round_fn(global_vars, data_x, data_y, counts, round_idx, key):
+            n = counts.shape[0]
+            rkey = rng.round_key(key, round_idx)
+            weights = counts.astype(jnp.float32)
+            # group models start from the global model
+            group_vars = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (G,) + x.shape), global_vars
+            )
+
+            def sub_round(group_vars, s):
+                skey = jax.random.fold_in(rkey, s)
+                keys = jax.vmap(lambda i: rng.client_key(skey, i))(jnp.arange(n))
+                # each client trains from ITS group's current model
+                my_model = pt.tree_take(group_vars, group_of)
+                trained, metrics = jax.vmap(
+                    lambda v, x, y, c, k: self._local_train(v, x, y, c, k, None)
+                )(my_model, data_x, data_y, counts, keys)
+                new_groups, _ = group_mean(trained, weights)
+                return new_groups, metrics
+
+            group_vars, metrics = jax.lax.scan(sub_round, group_vars, jnp.arange(sub_rounds))
+            # global aggregate: group means weighted by group sample mass
+            wsum = jax.ops.segment_sum(weights, group_of, num_segments=G)
+            new_global = pt.tree_weighted_mean(group_vars, wsum)
+            round_metrics = {k: jnp.mean(v) for k, v in metrics.items()}
+            return new_global, round_metrics
+
+        return round_fn
+
+    def run_round(self) -> dict:
+        self.global_vars, metrics = self._round_fn(
+            self.global_vars, self._data[0], self._data[1], self.counts,
+            jnp.int32(self.round_idx), self.root_key,
+        )
+        self.round_idx += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def evaluate(self) -> dict:
+        return {k: float(v) for k, v in self._eval_fn(self.global_vars, *self._test).items()}
+
+    def run(self) -> list[dict]:
+        history = []
+        for r in range(self.cfg.comm_round):
+            t0 = time.perf_counter()
+            metrics = self.run_round()
+            metrics.update(round=r, round_time_s=time.perf_counter() - t0)
+            if self.cfg.frequency_of_the_test and (
+                (r + 1) % self.cfg.frequency_of_the_test == 0 or r == self.cfg.comm_round - 1
+            ):
+                metrics.update(self.evaluate())
+            self.logger.log(metrics)
+            history.append(metrics)
+        return history
