@@ -1,0 +1,36 @@
+"""Abstract communication backend + observer interface.
+
+Parity with ``core/distributed/communication/base_com_manager.py`` and
+``observer.py``: a backend moves ``Message``s between numbered endpoints and
+notifies registered observers on receive.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from .message import Message
+
+
+class Observer(ABC):
+    @abstractmethod
+    def receive_message(self, msg_type: int, msg: Message) -> None: ...
+
+
+class BaseCommunicationManager(ABC):
+    @abstractmethod
+    def send_message(self, msg: Message) -> None: ...
+
+    @abstractmethod
+    def add_observer(self, observer: Observer) -> None: ...
+
+    @abstractmethod
+    def remove_observer(self, observer: Observer) -> None: ...
+
+    @abstractmethod
+    def handle_receive_message(self) -> None:
+        """Block, dispatching received messages to observers, until
+        stop_receive_message is called."""
+
+    @abstractmethod
+    def stop_receive_message(self) -> None: ...

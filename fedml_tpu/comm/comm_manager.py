@@ -1,0 +1,85 @@
+"""FedMLCommManager — handler registry + backend factory.
+
+Parity with ``core/distributed/fedml_comm_manager.py:11``: server/client
+managers subclass this, register per-msg_type handlers, and run a blocking
+receive loop; ``_init_manager`` (:133) is the backend factory keyed by
+``args.backend``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .. import constants as C
+from .base import BaseCommunicationManager, Observer
+from .message import Message
+
+
+class FedMLCommManager(Observer):
+    def __init__(self, cfg, rank: int = 0, size: int = 0, backend: Optional[str] = None):
+        self.cfg = cfg
+        self.rank = rank
+        self.size = size
+        self.backend = backend or getattr(cfg, "backend", C.COMM_BACKEND_INPROC)
+        self.message_handler_dict: dict[int, Callable[[Message], None]] = {}
+        self.com_manager: BaseCommunicationManager = self._init_manager()
+        self.com_manager.add_observer(self)
+
+    # -- reference API shape -------------------------------------------------
+    def register_message_receive_handler(self, msg_type: int, handler: Callable) -> None:
+        self.message_handler_dict[msg_type] = handler
+
+    def send_message(self, message: Message) -> None:
+        self.com_manager.send_message(message)
+
+    def receive_message(self, msg_type: int, msg: Message) -> None:
+        handler = self.message_handler_dict.get(msg_type)
+        if handler is None:
+            raise KeyError(
+                f"no handler registered for msg_type {msg_type} (rank {self.rank}); "
+                f"registered: {sorted(self.message_handler_dict)}"
+            )
+        handler(msg)
+
+    def run(self) -> None:
+        """Blocking receive loop (reference ``FedMLCommManager.run``)."""
+        self.register_message_receive_handlers()
+        self.com_manager.handle_receive_message()
+
+    def run_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+        return t
+
+    def finish(self) -> None:
+        self.com_manager.stop_receive_message()
+
+    def register_message_receive_handlers(self) -> None:
+        """Subclasses register their protocol handlers here."""
+        raise NotImplementedError
+
+    # -- backend factory (reference _init_manager :133) ----------------------
+    def _init_manager(self) -> BaseCommunicationManager:
+        b = self.backend
+        if b == C.COMM_BACKEND_INPROC:
+            from .inproc import InProcCommManager
+
+            return InProcCommManager(getattr(self.cfg, "run_id", "0"), self.rank)
+        if b == C.COMM_BACKEND_GRPC:
+            from .grpc_backend import GRPCCommManager
+
+            base_port = int((getattr(self.cfg, "extra", {}) or {}).get("grpc_base_port", 8890))
+            ip_config = (getattr(self.cfg, "extra", {}) or {}).get("grpc_ip_config", {})
+            return GRPCCommManager(
+                "0.0.0.0", base_port + self.rank, self.rank,
+                ip_config=ip_config, base_port=base_port,
+            )
+        if b == C.COMM_BACKEND_MQTT_S3:
+            from .mqtt_s3 import MqttS3CommManager
+
+            return MqttS3CommManager(getattr(self.cfg, "run_id", "0"), self.rank)
+        raise ValueError(
+            f"unknown comm backend {b!r}; known: "
+            f"{[C.COMM_BACKEND_INPROC, C.COMM_BACKEND_GRPC, C.COMM_BACKEND_MQTT_S3]}"
+        )

@@ -1,0 +1,114 @@
+"""gRPC communication backend.
+
+Parity with ``core/distributed/communication/grpc/`` (``GRPCCommManager``
+``grpc_comm_manager.py:30``, servicer ``grpc_server.py:10``): a unary
+``SendMessage`` RPC carrying one serialized Message; an ip_config map routes
+receiver_id -> host; 1 GB max message.
+
+Differences by design: the payload is the language-neutral pytree wire format
+(not pickle), and the service is registered with a generic handler over raw
+bytes — no protoc-generated stubs to keep in sync (the .proto contract is
+just "unary bytes in, empty bytes out" at
+``/fedml_tpu.CommService/SendMessage``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from .base import BaseCommunicationManager, Observer
+from .message import Message
+
+SERVICE_METHOD = "/fedml_tpu.CommService/SendMessage"
+MAX_MESSAGE_BYTES = 1024 * 1024 * 1024  # reference: 1 GB
+_GRPC_OPTS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+]
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class _Servicer(grpc.GenericRpcHandler):
+    def __init__(self, inbox: queue.Queue):
+        self.inbox = inbox
+
+    def service(self, handler_call_details):
+        if handler_call_details.method != SERVICE_METHOD:
+            return None
+
+        def handler(request: bytes, context) -> bytes:
+            self.inbox.put(request)
+            return b""
+
+        return grpc.unary_unary_rpc_method_handler(
+            handler, request_deserializer=_identity, response_serializer=_identity
+        )
+
+
+class GRPCCommManager(BaseCommunicationManager):
+    """One endpoint = one gRPC server (receiving) + per-peer channels (sending).
+
+    ``ip_config``: {endpoint_id: "host"} (reference CSV ip_config semantics);
+    ``base_port``: endpoint i listens on base_port + i (reference does the
+    same arithmetic).
+    """
+
+    def __init__(self, host: str, port: int, rank: int,
+                 ip_config: Optional[dict] = None, base_port: int = 8890):
+        self.rank = rank
+        self.ip_config = ip_config or {}
+        self.base_port = base_port
+        self._observers: list[Observer] = []
+        self._inbox: queue.Queue = queue.Queue()
+        self._running = False
+        self._channels: dict[int, grpc.Channel] = {}
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8), options=_GRPC_OPTS
+        )
+        self._server.add_generic_rpc_handlers((_Servicer(self._inbox),))
+        self._bound_port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+
+    def _target_for(self, receiver_id: int) -> str:
+        host = self.ip_config.get(receiver_id, "127.0.0.1")
+        return f"{host}:{self.base_port + int(receiver_id)}"
+
+    def send_message(self, msg: Message) -> None:
+        rid = msg.get_receiver_id()
+        if rid not in self._channels:
+            self._channels[rid] = grpc.insecure_channel(self._target_for(rid), options=_GRPC_OPTS)
+        stub = self._channels[rid].unary_unary(
+            SERVICE_METHOD, request_serializer=_identity, response_deserializer=_identity
+        )
+        stub(msg.encode(), timeout=60.0)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            try:
+                data = self._inbox.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            msg = Message.decode(data)
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._server.stop(grace=0.2)
+        for ch in self._channels.values():
+            ch.close()

@@ -1,0 +1,93 @@
+"""In-process loopback transport — the test fake the reference never had.
+
+The reference tests multi-node by oversubscribed processes over real brokers
+(SURVEY.md §4); its comm managers have no mock transport.  This backend gives
+every endpoint a queue inside one process, routed through a shared
+``InProcRouter`` keyed by run_id — so the full cross-silo protocol (server +
+N clients, real Message encode/decode) runs hermetically in a unit test,
+including injected failures (drop/delay/disconnect) for straggler-handling
+tests (SURVEY.md §7 hard part 4).
+
+Messages ARE round-tripped through the wire format on every send, so the
+fake exercises exactly the bytes a remote backend would.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Optional
+
+from .base import BaseCommunicationManager, Observer
+from .message import Message
+
+
+class InProcRouter:
+    """Shared message fabric for one run_id (the 'broker')."""
+
+    _routers: dict[str, "InProcRouter"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.queues: dict[int, queue.Queue] = defaultdict(queue.Queue)
+        self.drop_rule: Optional[Callable[[Message], bool]] = None
+        self.delay_rule: Optional[Callable[[Message], float]] = None
+
+    @classmethod
+    def get(cls, run_id: str) -> "InProcRouter":
+        with cls._lock:
+            if run_id not in cls._routers:
+                cls._routers[run_id] = cls()
+            return cls._routers[run_id]
+
+    @classmethod
+    def reset(cls, run_id: str) -> None:
+        with cls._lock:
+            cls._routers.pop(run_id, None)
+
+    def route(self, msg: Message) -> None:
+        if self.drop_rule is not None and self.drop_rule(msg):
+            return
+        data = msg.encode()  # force the wire round-trip
+        delay = self.delay_rule(msg) if self.delay_rule is not None else 0.0
+        if delay > 0:
+            t = threading.Timer(delay, lambda: self.queues[msg.get_receiver_id()].put(data))
+            t.daemon = True
+            t.start()
+        else:
+            self.queues[msg.get_receiver_id()].put(data)
+
+
+class InProcCommManager(BaseCommunicationManager):
+    def __init__(self, run_id: str, rank: int):
+        self.run_id = str(run_id)
+        self.rank = rank
+        self.router = InProcRouter.get(self.run_id)
+        self._observers: list[Observer] = []
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        self.router.route(msg)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        q = self.router.queues[self.rank]
+        while self._running:
+            try:
+                data = q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            msg = Message.decode(data)
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False

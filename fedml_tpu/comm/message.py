@@ -1,0 +1,95 @@
+"""Message — the unit of cross-process FL communication.
+
+Parity with the reference ``Message`` (``core/distributed/communication/
+message.py:5``): a typed dict with MSG_ARG_KEY_TYPE/SENDER/RECEIVER plus
+arbitrary params.  Tensor payloads ride the pytree wire format
+(``comm.wire``) instead of pickle, so the bytes are language-neutral.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from . import wire
+
+MSG_ARG_KEY_TYPE = "msg_type"
+MSG_ARG_KEY_SENDER = "sender"
+MSG_ARG_KEY_RECEIVER = "receiver"
+
+# payload keys matching the reference vocabulary
+MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+
+
+class Message:
+    def __init__(self, msg_type: int = 0, sender_id: int = 0, receiver_id: int = 0):
+        self.msg_params: dict[str, Any] = {
+            MSG_ARG_KEY_TYPE: msg_type,
+            MSG_ARG_KEY_SENDER: sender_id,
+            MSG_ARG_KEY_RECEIVER: receiver_id,
+        }
+
+    # reference API shape
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    add = add_params
+
+    def get(self, key: str, default=None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def get_type(self) -> int:
+        return self.msg_params[MSG_ARG_KEY_TYPE]
+
+    def get_sender_id(self) -> int:
+        return self.msg_params[MSG_ARG_KEY_SENDER]
+
+    def get_receiver_id(self) -> int:
+        return self.msg_params[MSG_ARG_KEY_RECEIVER]
+
+    # -- wire ---------------------------------------------------------------
+    def encode(self) -> bytes:
+        """Control fields as JSON; array-valued params via the pytree wire."""
+        control = {}
+        tensors = {}
+        for k, v in self.msg_params.items():
+            if _is_arraylike(v):
+                tensors[k] = v
+            else:
+                control[k] = v
+        blob = wire.encode_pytree(tensors)
+        cbytes = json.dumps(control, separators=(",", ":")).encode("utf-8")
+        return len(cbytes).to_bytes(4, "little") + cbytes + blob
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Message":
+        clen = int.from_bytes(data[:4], "little")
+        control = json.loads(data[4 : 4 + clen].decode("utf-8"))
+        tensors = wire.decode_pytree(data[4 + clen :])
+        msg = cls()
+        msg.msg_params = {**control, **tensors}
+        return msg
+
+    def __repr__(self) -> str:
+        keys = [k for k in self.msg_params if k not in (MSG_ARG_KEY_TYPE, MSG_ARG_KEY_SENDER, MSG_ARG_KEY_RECEIVER)]
+        return (
+            f"Message(type={self.get_type()}, {self.get_sender_id()}->"
+            f"{self.get_receiver_id()}, params={keys})"
+        )
+
+
+def _is_arraylike(v) -> bool:
+    import numpy as np
+
+    if isinstance(v, np.ndarray):
+        return True
+    # jax arrays / pytrees of arrays
+    if isinstance(v, dict):
+        return bool(v) and all(_is_arraylike(x) for x in v.values())
+    if isinstance(v, (list, tuple)):
+        return bool(v) and all(_is_arraylike(x) for x in v)
+    return hasattr(v, "__array_interface__") or type(v).__module__.startswith("jax")

@@ -1,0 +1,182 @@
+"""MQTT + object-store backend (control/payload split).
+
+Parity with ``core/distributed/communication/mqtt_s3/``
+(``MqttS3MultiClientsCommManager`` ``mqtt_s3_multi_clients_comm_manager.py:20``):
+small control JSON rides broker topics ``fedml_{run_id}_{sender}_{receiver}``
+(QoS2 semantics), large tensor payloads are uploaded to an object store and
+the message carries only the key (``send_message`` :248 upload decision,
+``_on_message_impl`` :195 download); ONLINE/OFFLINE last-will liveness
+messages on a status topic (``mqtt_manager.py:68-74``).
+
+Both the broker and the store are small interfaces:
+- ``InMemoryBroker`` / ``InMemoryObjectStore`` — hermetic fakes (and the
+  default in this zero-egress build; paho-mqtt/boto3 are not installed).
+- A real deployment implements the same two classes over paho/boto3 without
+  touching the manager.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import uuid
+from collections import defaultdict
+from typing import Callable, Optional
+
+from .base import BaseCommunicationManager, Observer
+from .message import (
+    MSG_ARG_KEY_RECEIVER, MSG_ARG_KEY_SENDER, MSG_ARG_KEY_TYPE, Message, _is_arraylike,
+)
+from . import wire
+
+PAYLOAD_INLINE_LIMIT = 8 * 1024  # larger tensor payloads go to the store
+
+
+class InMemoryBroker:
+    """Topic pub/sub with last-will, keyed by run namespace."""
+
+    _brokers: dict[str, "InMemoryBroker"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.subs: dict[str, list[Callable[[str, bytes], None]]] = defaultdict(list)
+        self.wills: dict[str, tuple[str, bytes]] = {}
+
+    @classmethod
+    def get(cls, namespace: str) -> "InMemoryBroker":
+        with cls._lock:
+            if namespace not in cls._brokers:
+                cls._brokers[namespace] = cls()
+            return cls._brokers[namespace]
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        for cb in list(self.subs.get(topic, [])):
+            cb(topic, payload)
+
+    def subscribe(self, topic: str, cb: Callable[[str, bytes], None]) -> None:
+        self.subs[topic].append(cb)
+
+    def set_will(self, client_id: str, topic: str, payload: bytes) -> None:
+        self.wills[client_id] = (topic, payload)
+
+    def disconnect_ungraceful(self, client_id: str) -> None:
+        """Simulate a dropped connection: fire the last-will."""
+        will = self.wills.pop(client_id, None)
+        if will:
+            self.publish(*will)
+
+
+class InMemoryObjectStore:
+    """put/get blobs by key (the S3 role)."""
+
+    _stores: dict[str, "InMemoryObjectStore"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.blobs: dict[str, bytes] = {}
+
+    @classmethod
+    def get_store(cls, namespace: str) -> "InMemoryObjectStore":
+        with cls._lock:
+            if namespace not in cls._stores:
+                cls._stores[namespace] = cls()
+            return cls._stores[namespace]
+
+    def put(self, key: str, data: bytes) -> str:
+        self.blobs[key] = data
+        return key
+
+    def get(self, key: str) -> bytes:
+        return self.blobs[key]
+
+
+class MqttS3CommManager(BaseCommunicationManager):
+    def __init__(self, run_id: str, rank: int, broker: Optional[InMemoryBroker] = None,
+                 store: Optional[InMemoryObjectStore] = None):
+        self.run_id = str(run_id)
+        self.rank = rank
+        self.broker = broker or InMemoryBroker.get(self.run_id)
+        self.store = store or InMemoryObjectStore.get_store(self.run_id)
+        self._observers: list[Observer] = []
+        self._inbox: queue.Queue = queue.Queue()
+        self._running = False
+        self.client_id = f"{self.run_id}_{rank}"
+        # last-will: broker announces our death (reference OFFLINE status)
+        self.broker.set_will(
+            self.client_id,
+            self._status_topic(),
+            json.dumps({"ID": rank, "status": "OFFLINE"}).encode(),
+        )
+        # subscribe to every topic addressed to us: fedml_{run}_{s}_{r}
+        # (in-mem broker has no wildcards; we subscribe per-sender lazily via
+        # a routing topic instead)
+        self.broker.subscribe(self._my_topic(), self._on_message)
+        self.broker.publish(
+            self._status_topic(), json.dumps({"ID": rank, "status": "ONLINE"}).encode()
+        )
+
+    def _my_topic(self) -> str:
+        return f"fedml_{self.run_id}_to_{self.rank}"
+
+    def _status_topic(self) -> str:
+        return f"fedml_{self.run_id}_status"
+
+    def subscribe_status(self, cb: Callable[[dict], None]) -> None:
+        self.broker.subscribe(self._status_topic(), lambda _t, p: cb(json.loads(p.decode())))
+
+    def _on_message(self, topic: str, payload: bytes) -> None:
+        self._inbox.put(payload)
+
+    def send_message(self, msg: Message) -> None:
+        # split control vs tensor payload; offload big tensors to the store
+        control, tensors = {}, {}
+        for k, v in msg.msg_params.items():
+            (tensors if _is_arraylike(v) else control)[k] = v
+        blob = wire.encode_pytree(tensors) if tensors else b""
+        if len(blob) > PAYLOAD_INLINE_LIMIT:
+            key = f"{self.run_id}/{uuid.uuid4().hex}"
+            self.store.put(key, blob)
+            envelope = {"control": control, "store_key": key}
+            body = json.dumps(envelope).encode()
+        else:
+            body = json.dumps({"control": control}).encode() + b"\x00" + blob
+        topic = f"fedml_{self.run_id}_to_{msg.get_receiver_id()}"
+        self.broker.publish(topic, body)
+
+    def _decode(self, payload: bytes) -> Message:
+        if b"\x00" in payload[:PAYLOAD_INLINE_LIMIT + 4096]:
+            head, _, blob = payload.partition(b"\x00")
+            envelope = json.loads(head.decode())
+        else:
+            envelope = json.loads(payload.decode())
+            blob = b""
+        control = envelope["control"]
+        tensors = {}
+        if "store_key" in envelope:
+            blob = self.store.get(envelope["store_key"])
+        if blob:
+            tensors = wire.decode_pytree(blob)
+        msg = Message()
+        msg.msg_params = {**control, **tensors}
+        return msg
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            try:
+                payload = self._inbox.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            msg = self._decode(payload)
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False

@@ -1,0 +1,84 @@
+"""Pytree wire format — defined FIRST so every backend and the future C++
+client speak the same bytes (SURVEY.md §7 hard part 6).
+
+The reference pickles torch ``state_dict``s (MPI/gRPC,
+``grpc_comm_manager.py``) or uploads them to S3 (MQTT path) — Python-only and
+version-fragile.  Here a pytree serializes to a self-describing, polyglot
+layout:
+
+    [4-byte LE header length][header JSON][raw little-endian buffers...]
+
+header = {"treedef": <json pytree skeleton>, "leaves": [{dtype, shape,
+nbytes}...], "version": 1}.  A non-Python client needs only a JSON parser to
+read or produce it.  No pickle anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+import numpy as np
+
+WIRE_VERSION = 1
+
+# JSON pytree skeleton: dict -> {"d": {k: skel}}, list/tuple -> {"l"/"t": [...]},
+# leaf -> {"x": leaf_index}
+
+
+def _build_skeleton(obj, leaves: list):
+    if isinstance(obj, dict):
+        return {"d": {str(k): _build_skeleton(v, leaves) for k, v in sorted(obj.items())}}
+    if isinstance(obj, (list, tuple)):
+        tag = "l" if isinstance(obj, list) else "t"
+        return {tag: [_build_skeleton(v, leaves) for v in obj]}
+    leaves.append(obj)
+    return {"x": len(leaves) - 1}
+
+
+def _restore_skeleton(skel, leaves: list):
+    if "d" in skel:
+        return {k: _restore_skeleton(v, leaves) for k, v in skel["d"].items()}
+    if "l" in skel:
+        return [_restore_skeleton(v, leaves) for v in skel["l"]]
+    if "t" in skel:
+        return tuple(_restore_skeleton(v, leaves) for v in skel["t"])
+    return leaves[skel["x"]]
+
+
+def encode_pytree(tree: Any) -> bytes:
+    """Pytree of arrays/scalars -> wire bytes."""
+    leaves: list = []
+    skel = _build_skeleton(tree, leaves)
+    arrs = [np.asarray(l) for l in leaves]
+    header = {
+        "version": WIRE_VERSION,
+        "treedef": skel,
+        "leaves": [
+            {"dtype": a.dtype.str, "shape": list(a.shape), "nbytes": int(a.nbytes)}
+            for a in arrs
+        ],
+    }
+    hbytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    parts = [struct.pack("<I", len(hbytes)), hbytes]
+    for a in arrs:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    return b"".join(parts)
+
+
+def decode_pytree(data: bytes) -> Any:
+    """Wire bytes -> pytree of numpy arrays."""
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    header = json.loads(data[4 : 4 + hlen].decode("utf-8"))
+    if header.get("version") != WIRE_VERSION:
+        raise ValueError(f"unsupported wire version {header.get('version')}")
+    offset = 4 + hlen
+    leaves = []
+    for spec in header["leaves"]:
+        dt = np.dtype(spec["dtype"])
+        n = spec["nbytes"]
+        arr = np.frombuffer(data, dtype=dt, count=n // dt.itemsize, offset=offset).reshape(spec["shape"])
+        leaves.append(arr.copy())  # own the memory
+        offset += n
+    return _restore_skeleton(header["treedef"], leaves)

@@ -1,0 +1,92 @@
+"""Cross-silo platform ("Octopus" in the reference).
+
+Entry: ``create_cross_silo_runner(cfg, dataset, model)`` builds either the
+server (rank 0) or a client (rank k) runner from ``cfg.role``/``cfg.rank`` —
+the dispatch done by ``cross_silo/server/server_initializer.py`` /
+``client/client_initializer.py`` in the reference.
+
+``run_in_process_group`` launches 1 server + N client managers on threads
+over the in-proc backend — the hermetic equivalent of the reference's
+"background nohup processes over a public MQTT broker" smoke test
+(``tests/cross-silo/run_cross_silo.sh``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..data.dataset import pad_eval_set
+from .client import ClientMasterManager, FedMLTrainer
+from .server import FedMLAggregator, FedMLServerManager
+
+
+def _client_shard(dataset, client_idx: int):
+    ix = dataset.client_idx[client_idx]
+    return dataset.train_x[ix], dataset.train_y[ix]
+
+
+def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None) -> FedMLServerManager:
+    eval_bs = min(256, max(32, cfg.test_batch_size))
+    test_arrays = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
+    sample_x = dataset.train_x[: cfg.batch_size]
+    if trust is None:
+        from ..trust.pipeline import build_trust_pipeline
+
+        trust = build_trust_pipeline(cfg)
+    aggregator = FedMLAggregator(cfg, model, sample_x, test_arrays, trust=trust)
+    return FedMLServerManager(cfg, aggregator, backend=backend)
+
+
+def build_client(cfg, dataset, model, rank: int, backend: Optional[str] = None) -> ClientMasterManager:
+    x, y = _client_shard(dataset, rank - 1)
+    trainer = FedMLTrainer(cfg, model, x, y)
+    return ClientMasterManager(cfg, trainer, rank=rank, backend=backend)
+
+
+class _CrossSiloRunner:
+    def __init__(self, cfg, dataset, model):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+
+    def run(self):
+        cfg = self.cfg
+        if cfg.role == "server" and cfg.backend in ("INPROC", "MESH", ""):
+            # single-process orchestration (tests / local runs)
+            return run_in_process_group(cfg, self.dataset, self.model)
+        if cfg.role == "server":
+            server = build_server(cfg, self.dataset, self.model)
+            return server.run_until_done()
+        client = build_client(cfg, self.dataset, self.model, rank=int(cfg.rank))
+        thread = client.run_in_thread()
+        client.done.wait()
+        thread.join(timeout=5.0)
+        return None
+
+
+def create_cross_silo_runner(cfg, dataset, model):
+    return _CrossSiloRunner(cfg, dataset, model)
+
+
+def run_in_process_group(cfg, dataset, model, backend: str = "INPROC", timeout: float = 600.0):
+    """1 server + client_num_in_total clients on threads over the in-proc
+    fabric; returns the server history."""
+    from ..comm.inproc import InProcRouter
+
+    InProcRouter.reset(str(getattr(cfg, "run_id", "0")))
+    clients = [
+        build_client(cfg, dataset, model, rank=r, backend=backend)
+        for r in range(1, cfg.client_num_in_total + 1)
+    ]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, dataset, model, backend=backend)
+    try:
+        history = server.run_until_done(timeout=timeout)
+    finally:
+        for c in clients:
+            c.finish()
+    return history

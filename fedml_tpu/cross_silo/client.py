@@ -1,0 +1,95 @@
+"""Cross-silo FL client (silo master process).
+
+Parity with ``cross_silo/client/fedml_client_master_manager.py:22`` +
+``fedml_trainer.py:8``: handles check-status/init/sync messages, trains the
+local shard with the shared jitted local-SGD scan, uploads weights + sample
+count, honors the finish protocol.
+
+Intra-silo data parallelism (the reference's DDP-over-torchrun,
+``fedml_trainer_dist_adapter.py``) maps to a local JAX ``data`` mesh axis: a
+silo with k local chips batch-shards its local SGD — no process group or
+broadcast_object_list needed, GSPMD inserts the gradient all-reduce.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms import hparams_from_config
+from ..comm.comm_manager import FedMLCommManager
+from ..comm.message import Message
+from ..core import rng
+from ..fl.local_sgd import make_local_train_fn
+from . import message_define as md
+
+log = logging.getLogger("fedml_tpu.cross_silo.client")
+
+
+class FedMLTrainer:
+    """Local training operator (reference ``FedMLTrainer.train`` :71)."""
+
+    def __init__(self, cfg, model, x: np.ndarray, y: np.ndarray):
+        cap = ((x.shape[0] + cfg.batch_size - 1) // cfg.batch_size) * cfg.batch_size
+        reps = np.resize(np.arange(x.shape[0]), cap)
+        self.x = jnp.asarray(x[reps])
+        self.y = jnp.asarray(y[reps])
+        self.count = jnp.int32(x.shape[0])
+        spe = max(1, math.ceil(cap / cfg.batch_size))
+        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        self._train = jax.jit(make_local_train_fn(model, self.hp))
+
+    def train(self, global_vars, round_idx: int, seed_key) -> tuple:
+        key = rng.client_key(rng.round_key(seed_key, round_idx), 0)
+        variables = jax.tree_util.tree_map(jnp.asarray, global_vars)
+        new_vars, metrics = self._train(variables, self.x, self.y, self.count, key, None)
+        return jax.device_get(new_vars), float(self.count)
+
+
+class ClientMasterManager(FedMLCommManager):
+    def __init__(self, cfg, trainer: FedMLTrainer, rank: int, backend: Optional[str] = None):
+        super().__init__(cfg, rank=rank, size=cfg.client_num_in_total + 1, backend=backend)
+        self.trainer = trainer
+        self.seed_key = rng.root_key(cfg.random_seed)
+        self.done = threading.Event()
+        self.rounds_trained = 0
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.handle_message_check_status)
+        self.register_message_receive_handler(md.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
+        self.register_message_receive_handler(md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_message_receive_model)
+        self.register_message_receive_handler(md.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+
+    def handle_message_check_status(self, msg: Message) -> None:
+        reply = Message(md.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        reply.add_params(md.MSG_ARG_KEY_CLIENT_STATUS, md.CLIENT_STATUS_ONLINE)
+        reply.add_params(md.MSG_ARG_KEY_CLIENT_OS, md.CLIENT_OS_PYTHON)
+        self.send_message(reply)
+
+    def handle_message_init(self, msg: Message) -> None:
+        self._train_and_send(msg)
+
+    def handle_message_receive_model(self, msg: Message) -> None:
+        self._train_and_send(msg)
+
+    def _train_and_send(self, msg: Message) -> None:
+        round_idx = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
+        params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
+        new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key)
+        self.rounds_trained += 1
+        reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, new_vars)
+        reply.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
+        reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+        self.send_message(reply)
+
+    def handle_message_finish(self, msg: Message) -> None:
+        self.send_message(Message(md.MSG_TYPE_C2S_FINISHED, self.rank, 0))
+        self.done.set()
+        self.finish()

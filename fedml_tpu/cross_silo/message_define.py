@@ -1,0 +1,25 @@
+"""Cross-silo message protocol constants.
+
+Exact parity with ``cross_silo/server/message_define.py:7-19`` /
+``cross_silo/client/message_define.py`` so wire traces are comparable:
+"""
+
+MSG_TYPE_CONNECTION_IS_READY = 0
+MSG_TYPE_S2C_INIT_CONFIG = 1
+MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = 2
+MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
+MSG_TYPE_C2S_CLIENT_TEST_INFO = 4
+MSG_TYPE_C2S_CLIENT_STATUS = 5
+MSG_TYPE_S2C_CHECK_CLIENT_STATUS = 6
+MSG_TYPE_S2C_FINISH = 7
+MSG_TYPE_C2S_FINISHED = 8
+
+MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+MSG_ARG_KEY_CLIENT_OS = "client_os"
+
+CLIENT_STATUS_ONLINE = "ONLINE"
+CLIENT_OS_PYTHON = "python"

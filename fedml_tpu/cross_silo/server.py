@@ -1,0 +1,246 @@
+"""Cross-silo FL server.
+
+Parity with ``cross_silo/server/fedml_server_manager.py:15`` +
+``fedml_aggregator.py:13``: the event loop is
+
+  connection_ready -> check client status -> all ONLINE -> send_init
+  -> on each client model: add, check_whether_all_receive -> aggregate
+  -> test -> client_selection -> sync model out -> ... -> finish
+
+with one deliberate improvement (SURVEY.md §5 flags the gap): **bounded-wait
+straggler handling** — if ``straggler_timeout_s`` is set and a quorum
+fraction of models has arrived when the timer fires, the round proceeds with
+the received subset reweighted, instead of stalling forever on a lost client.
+
+Aggregation reuses the same pure ``FedAlgorithm.aggregate``/``server_update``
+and TrustPipeline hooks as the simulation engine — one algorithm codebase
+for both platforms.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms import create as create_algorithm, hparams_from_config
+from ..comm.comm_manager import FedMLCommManager
+from ..comm.message import Message
+from ..core import pytree as pt, rng
+from ..data.dataset import pad_eval_set
+from ..fl.local_sgd import make_eval_fn
+from ..obs.metrics import MetricsLogger
+from . import message_define as md
+
+log = logging.getLogger("fedml_tpu.cross_silo.server")
+
+
+class FedMLAggregator:
+    """Server-side state: per-round model buffer + the algorithm frame
+    (reference ``FedMLAggregator`` ``fedml_aggregator.py``)."""
+
+    def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
+        self.cfg = cfg
+        spe = max(1, math.ceil(getattr(cfg, "synthetic_train_size", 1024) / max(cfg.client_num_in_total, 1) / cfg.batch_size))
+        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        self.algorithm = create_algorithm(cfg, self.hp).build(model)
+        k0 = rng.root_key(cfg.random_seed)
+        self.global_vars = model.init(
+            {"params": jax.random.fold_in(k0, 1), "dropout": jax.random.fold_in(k0, 2)},
+            jnp.asarray(sample_x), train=True,
+        )
+        self.server_state = self.algorithm.init_server_state(self.global_vars)
+        self.trust = trust
+        self.root_key = k0
+        self.model_dict: dict[int, object] = {}
+        self.sample_num_dict: dict[int, float] = {}
+        self.flag_client_model_uploaded: dict[int, bool] = {}
+        tx, ty, n_valid = test_arrays
+        self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
+        self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=min(256, max(32, cfg.test_batch_size))))
+
+    def add_local_trained_result(self, client_idx: int, params, sample_num: float) -> None:
+        self.model_dict[client_idx] = params
+        self.sample_num_dict[client_idx] = sample_num
+        self.flag_client_model_uploaded[client_idx] = True
+
+    def received_count(self) -> int:
+        return len(self.model_dict)
+
+    def check_whether_all_receive(self, expected: int) -> bool:
+        return self.received_count() >= expected
+
+    def aggregate(self, round_idx: int):
+        ids = sorted(self.model_dict.keys())
+        trees = [jax.tree_util.tree_map(jnp.asarray, self.model_dict[i]) for i in ids]
+        stacked = pt.tree_stack(trees)
+        weights = jnp.asarray([self.sample_num_dict[i] for i in ids], jnp.float32)
+        rkey = rng.round_key(self.root_key, round_idx)
+        if self.trust is not None:
+            sampled = jnp.asarray(ids, jnp.int32)
+            stacked, weights = self.trust.on_client_outputs(
+                stacked, weights, sampled, self.global_vars, rkey
+            )
+            stacked, weights, agg_override = self.trust.on_aggregation(
+                stacked, weights, self.global_vars, rkey
+            )
+        else:
+            agg_override = None
+        agg = agg_override if agg_override is not None else self.algorithm.aggregate(stacked, weights)
+        new_global, self.server_state = self.algorithm.server_update(
+            self.global_vars, self.server_state, agg, round_idx
+        )
+        if self.trust is not None:
+            new_global = self.trust.on_after_aggregation(new_global, self.global_vars, rkey)
+        self.global_vars = new_global
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        self.flag_client_model_uploaded.clear()
+        return self.global_vars
+
+    def test_on_server(self) -> dict:
+        return {k: float(v) for k, v in self._eval_fn(self.global_vars, *self._test).items()}
+
+    def client_selection(self, round_idx: int, client_ids: list[int], per_round: int) -> list[int]:
+        """Reference ``client_selection`` (:139) semantics on real ranks."""
+        if per_round >= len(client_ids):
+            return list(client_ids)
+        idx = rng.sample_clients_np(round_idx, len(client_ids), per_round)
+        return [client_ids[i] for i in idx]
+
+
+class FedMLServerManager(FedMLCommManager):
+    def __init__(self, cfg, aggregator: FedMLAggregator, backend: Optional[str] = None,
+                 logger: Optional[MetricsLogger] = None):
+        super().__init__(cfg, rank=0, size=cfg.client_num_in_total + 1, backend=backend)
+        self.aggregator = aggregator
+        self.round_idx = 0
+        self.comm_round = cfg.comm_round
+        self.client_ids = list(range(1, cfg.client_num_in_total + 1))
+        self.per_round = min(cfg.client_num_per_round, len(self.client_ids))
+        self.active_clients: set[int] = set()
+        self.selected: list[int] = []
+        self.done = threading.Event()
+        self.history: list[dict] = []
+        self.logger = logger or MetricsLogger(cfg.metrics_jsonl_path or None)
+        # bounded-wait straggler handling
+        self.straggler_timeout = float((getattr(cfg, "extra", {}) or {}).get("straggler_timeout_s", 0) or 0)
+        self.quorum_frac = float((getattr(cfg, "extra", {}) or {}).get("straggler_quorum_frac", 0.5) or 0.5)
+        self._round_timer: Optional[threading.Timer] = None
+        self._agg_lock = threading.Lock()
+
+    # -- protocol ------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(md.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_message_client_status)
+        self.register_message_receive_handler(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_message_receive_model)
+        self.register_message_receive_handler(md.MSG_TYPE_C2S_FINISHED, self.handle_message_client_finished)
+
+    def start(self) -> None:
+        """Ask every client for status (reference connection_ready path)."""
+        for cid in self.client_ids:
+            msg = Message(md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, 0, cid)
+            self.send_message(msg)
+
+    def handle_message_client_status(self, msg: Message) -> None:
+        if msg.get(md.MSG_ARG_KEY_CLIENT_STATUS) == md.CLIENT_STATUS_ONLINE:
+            self.active_clients.add(msg.get_sender_id())
+        if len(self.active_clients) == len(self.client_ids):
+            self.send_init_msg()
+
+    def send_init_msg(self) -> None:
+        """Reference ``send_init_msg`` (:48): global model + per-client index."""
+        self.selected = self.aggregator.client_selection(self.round_idx, self.client_ids, self.per_round)
+        params = jax.device_get(self.aggregator.global_vars)
+        for cid in self.selected:
+            msg = Message(md.MSG_TYPE_S2C_INIT_CONFIG, 0, cid)
+            msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+            msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
+            msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            self.send_message(msg)
+        self._arm_straggler_timer()
+
+    def handle_message_receive_model(self, msg: Message) -> None:
+        with self._agg_lock:
+            if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) != self.round_idx:
+                return  # stale round (post-timeout arrival)
+            self.aggregator.add_local_trained_result(
+                msg.get_sender_id(),
+                msg.get(md.MSG_ARG_KEY_MODEL_PARAMS),
+                float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES)),
+            )
+            if self.aggregator.check_whether_all_receive(len(self.selected)):
+                self._finish_round()
+
+    def _arm_straggler_timer(self) -> None:
+        if self.straggler_timeout <= 0:
+            return
+        if self._round_timer is not None:
+            self._round_timer.cancel()
+        self._round_timer = threading.Timer(self.straggler_timeout, self._on_straggler_timeout)
+        self._round_timer.daemon = True
+        self._round_timer.start()
+
+    def _on_straggler_timeout(self) -> None:
+        with self._agg_lock:
+            need = max(1, int(math.ceil(self.quorum_frac * len(self.selected))))
+            if self.aggregator.received_count() >= need:
+                log.warning(
+                    "round %d: straggler timeout, aggregating %d/%d clients",
+                    self.round_idx, self.aggregator.received_count(), len(self.selected),
+                )
+                self._finish_round()
+            else:
+                self._arm_straggler_timer()  # keep waiting for quorum
+
+    def _finish_round(self) -> None:
+        """Aggregate, eval, and either sync the next round or finish.
+        Caller holds _agg_lock."""
+        if self._round_timer is not None:
+            self._round_timer.cancel()
+        self.aggregator.aggregate(self.round_idx)
+        metrics = {"round": self.round_idx}
+        if self.cfg.frequency_of_the_test and (
+            (self.round_idx + 1) % self.cfg.frequency_of_the_test == 0
+            or self.round_idx == self.comm_round - 1
+        ):
+            metrics.update(self.aggregator.test_on_server())
+        self.logger.log(metrics)
+        self.history.append(metrics)
+        self.round_idx += 1
+        if self.round_idx >= self.comm_round:
+            self.send_finish()
+            return
+        self.selected = self.aggregator.client_selection(self.round_idx, self.client_ids, self.per_round)
+        params = jax.device_get(self.aggregator.global_vars)
+        for cid in self.selected:
+            msg = Message(md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, cid)
+            msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+            msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
+            msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            self.send_message(msg)
+        self._arm_straggler_timer()
+
+    def send_finish(self) -> None:
+        for cid in self.client_ids:
+            self.send_message(Message(md.MSG_TYPE_S2C_FINISH, 0, cid))
+        self.done.set()
+        self.finish()
+
+    def handle_message_client_finished(self, msg: Message) -> None:
+        pass  # bookkeeping only
+
+    # -- runner API ----------------------------------------------------------
+    def run_until_done(self, timeout: float = 600.0) -> list[dict]:
+        thread = self.run_in_thread()
+        self.start()
+        if not self.done.wait(timeout):
+            self.finish()
+            raise TimeoutError(f"cross-silo run did not finish in {timeout}s (round {self.round_idx})")
+        thread.join(timeout=5.0)
+        return self.history

@@ -262,11 +262,59 @@ class MeshSimulator:
         res = self._eval_fn(self.global_vars, *self._test)
         return {k: float(v) for k, v in res.items()}
 
+    # -- checkpoint / resume (first-class, SURVEY.md §5) ----------------------
+    def _ckpt_state(self) -> dict:
+        state = {
+            "global_vars": self.global_vars,
+            "server_state": self.server_state,
+            "round_idx": self.round_idx,
+            "root_key": self.root_key,
+        }
+        if self.client_states is not None:
+            state["client_states"] = self.client_states
+        if self.defense_history is not None:
+            state["defense_history"] = self.defense_history
+        return state
+
+    def _checkpointer(self):
+        if getattr(self, "_ckpt", None) is None:
+            from ..core.checkpoint import RoundCheckpointer
+
+            self._ckpt = RoundCheckpointer(self.cfg.checkpoint_dir)
+        return self._ckpt
+
+    def save_checkpoint(self) -> None:
+        if not self.cfg.checkpoint_dir:
+            return
+        self._checkpointer().save(self.round_idx, self._ckpt_state())
+
+    def try_resume(self) -> bool:
+        if not (self.cfg.checkpoint_dir and self.cfg.resume):
+            return False
+        if self._checkpointer().latest_round() is None:
+            return False
+        state = self._ckpt.restore(template=self._ckpt_state())
+        # re-apply the mesh placement __init__ establishes — restore hands
+        # back host arrays, which would otherwise land unsharded on device 0
+        self.global_vars = meshlib.replicate(state["global_vars"], self.mesh)
+        self.server_state = jax.device_get(state["server_state"])
+        self.server_state = meshlib.replicate(self.server_state, self.mesh)
+        self.round_idx = int(state["round_idx"])
+        # the checkpointed RNG key is authoritative (guards against a drifted
+        # --random_seed silently changing the sampling stream mid-run)
+        self.root_key = jnp.asarray(state["root_key"])
+        if "client_states" in state:
+            self.client_states = meshlib.shard_leading_axis(state["client_states"], self.mesh)
+        if "defense_history" in state:
+            self.defense_history = jnp.asarray(state["defense_history"])
+        return True
+
     def run(self) -> list[dict]:
         """The fit loop (reference ``FedAvgAPI.train`` ``fedavg_api.py:66``)."""
         history = []
         cfg = self.cfg
-        for r in range(cfg.comm_round):
+        self.try_resume()
+        for r in range(self.round_idx, cfg.comm_round):
             t0 = time.perf_counter()
             metrics = self.run_round()
             metrics["round_time_s"] = time.perf_counter() - t0
@@ -277,6 +325,10 @@ class MeshSimulator:
                 metrics.update(self.evaluate())
             self.logger.log(metrics)
             history.append(metrics)
+            if cfg.checkpoint_every_rounds and (
+                (r + 1) % cfg.checkpoint_every_rounds == 0 or r == cfg.comm_round - 1
+            ):
+                self.save_checkpoint()
         if getattr(cfg, "enable_contribution", False):
             scores = self.assess_contribution()
             if scores is not None:
