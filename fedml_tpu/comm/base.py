@@ -7,6 +7,7 @@ notifies registered observers on receive.
 
 from __future__ import annotations
 
+import queue
 from abc import ABC, abstractmethod
 
 from .message import Message
@@ -15,6 +16,46 @@ from .message import Message
 class Observer(ABC):
     @abstractmethod
     def receive_message(self, msg_type: int, msg: Message) -> None: ...
+
+
+class ObserverLoopMixin:
+    """Shared observer registry + poll/decode/dispatch receive loop.
+
+    Backends set ``self._inbox`` (a queue of raw payloads) and may override
+    ``_decode_bytes``; everything else is identical across transports.
+    """
+
+    _observers: list
+    _inbox: "queue.Queue"
+    _running: bool = False
+
+    def _init_observer_loop(self, inbox: "queue.Queue" = None) -> None:
+        self._observers = []
+        self._inbox = inbox if inbox is not None else queue.Queue()
+        self._running = False
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def _decode_bytes(self, data: bytes) -> Message:
+        return Message.decode(data)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            try:
+                data = self._inbox.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            msg = self._decode_bytes(data)
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
 
 
 class BaseCommunicationManager(ABC):

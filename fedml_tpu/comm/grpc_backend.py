@@ -15,13 +15,12 @@ just "unary bytes in, empty bytes out" at
 from __future__ import annotations
 
 import queue
-import threading
 from concurrent import futures
 from typing import Optional
 
 import grpc
 
-from .base import BaseCommunicationManager, Observer
+from .base import BaseCommunicationManager, ObserverLoopMixin
 from .message import Message
 
 SERVICE_METHOD = "/fedml_tpu.CommService/SendMessage"
@@ -53,32 +52,36 @@ class _Servicer(grpc.GenericRpcHandler):
         )
 
 
-class GRPCCommManager(BaseCommunicationManager):
+class GRPCCommManager(ObserverLoopMixin, BaseCommunicationManager):
     """One endpoint = one gRPC server (receiving) + per-peer channels (sending).
 
-    ``ip_config``: {endpoint_id: "host"} (reference CSV ip_config semantics);
-    ``base_port``: endpoint i listens on base_port + i (reference does the
-    same arithmetic).
+    ``ip_config``: {endpoint_id: "host"} (reference CSV ip_config semantics;
+    keys may be str from YAML — normalized to int); ``base_port``: endpoint i
+    listens on base_port + i (reference does the same arithmetic).
     """
 
     def __init__(self, host: str, port: int, rank: int,
                  ip_config: Optional[dict] = None, base_port: int = 8890):
         self.rank = rank
-        self.ip_config = ip_config or {}
+        # YAML/JSON mapping keys arrive as strings; normalize so lookups hit
+        self.ip_config = {int(k): v for k, v in (ip_config or {}).items()}
         self.base_port = base_port
-        self._observers: list[Observer] = []
-        self._inbox: queue.Queue = queue.Queue()
-        self._running = False
+        self._init_observer_loop()
         self._channels: dict[int, grpc.Channel] = {}
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=8), options=_GRPC_OPTS
         )
         self._server.add_generic_rpc_handlers((_Servicer(self._inbox),))
         self._bound_port = self._server.add_insecure_port(f"{host}:{port}")
+        if self._bound_port == 0:
+            raise OSError(
+                f"gRPC endpoint {rank} failed to bind {host}:{port} "
+                "(port in use?); refusing to start a deaf endpoint"
+            )
         self._server.start()
 
     def _target_for(self, receiver_id: int) -> str:
-        host = self.ip_config.get(receiver_id, "127.0.0.1")
+        host = self.ip_config.get(int(receiver_id), "127.0.0.1")
         return f"{host}:{self.base_port + int(receiver_id)}"
 
     def send_message(self, msg: Message) -> None:
@@ -90,25 +93,8 @@ class GRPCCommManager(BaseCommunicationManager):
         )
         stub(msg.encode(), timeout=60.0)
 
-    def add_observer(self, observer: Observer) -> None:
-        self._observers.append(observer)
-
-    def remove_observer(self, observer: Observer) -> None:
-        self._observers.remove(observer)
-
-    def handle_receive_message(self) -> None:
-        self._running = True
-        while self._running:
-            try:
-                data = self._inbox.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            msg = Message.decode(data)
-            for obs in list(self._observers):
-                obs.receive_message(msg.get_type(), msg)
-
     def stop_receive_message(self) -> None:
-        self._running = False
+        super().stop_receive_message()
         self._server.stop(grace=0.2)
         for ch in self._channels.values():
             ch.close()

@@ -16,11 +16,10 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 from collections import defaultdict
 from typing import Callable, Optional
 
-from .base import BaseCommunicationManager, Observer
+from .base import BaseCommunicationManager, ObserverLoopMixin
 from .message import Message
 
 
@@ -60,34 +59,12 @@ class InProcRouter:
             self.queues[msg.get_receiver_id()].put(data)
 
 
-class InProcCommManager(BaseCommunicationManager):
+class InProcCommManager(ObserverLoopMixin, BaseCommunicationManager):
     def __init__(self, run_id: str, rank: int):
         self.run_id = str(run_id)
         self.rank = rank
         self.router = InProcRouter.get(self.run_id)
-        self._observers: list[Observer] = []
-        self._running = False
+        self._init_observer_loop(inbox=self.router.queues[rank])
 
     def send_message(self, msg: Message) -> None:
         self.router.route(msg)
-
-    def add_observer(self, observer: Observer) -> None:
-        self._observers.append(observer)
-
-    def remove_observer(self, observer: Observer) -> None:
-        self._observers.remove(observer)
-
-    def handle_receive_message(self) -> None:
-        self._running = True
-        q = self.router.queues[self.rank]
-        while self._running:
-            try:
-                data = q.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            msg = Message.decode(data)
-            for obs in list(self._observers):
-                obs.receive_message(msg.get_type(), msg)
-
-    def stop_receive_message(self) -> None:
-        self._running = False

@@ -24,11 +24,8 @@ import uuid
 from collections import defaultdict
 from typing import Callable, Optional
 
-from .base import BaseCommunicationManager, Observer
-from .message import (
-    MSG_ARG_KEY_RECEIVER, MSG_ARG_KEY_SENDER, MSG_ARG_KEY_TYPE, Message, _is_arraylike,
-)
-from . import wire
+from .base import BaseCommunicationManager, ObserverLoopMixin
+from .message import Message
 
 PAYLOAD_INLINE_LIMIT = 8 * 1024  # larger tensor payloads go to the store
 
@@ -91,16 +88,14 @@ class InMemoryObjectStore:
         return self.blobs[key]
 
 
-class MqttS3CommManager(BaseCommunicationManager):
+class MqttS3CommManager(ObserverLoopMixin, BaseCommunicationManager):
     def __init__(self, run_id: str, rank: int, broker: Optional[InMemoryBroker] = None,
                  store: Optional[InMemoryObjectStore] = None):
         self.run_id = str(run_id)
         self.rank = rank
         self.broker = broker or InMemoryBroker.get(self.run_id)
         self.store = store or InMemoryObjectStore.get_store(self.run_id)
-        self._observers: list[Observer] = []
-        self._inbox: queue.Queue = queue.Queue()
-        self._running = False
+        self._init_observer_loop()
         self.client_id = f"{self.run_id}_{rank}"
         # last-will: broker announces our death (reference OFFLINE status)
         self.broker.set_will(
@@ -129,54 +124,23 @@ class MqttS3CommManager(BaseCommunicationManager):
         self._inbox.put(payload)
 
     def send_message(self, msg: Message) -> None:
-        # split control vs tensor payload; offload big tensors to the store
-        control, tensors = {}, {}
-        for k, v in msg.msg_params.items():
-            (tensors if _is_arraylike(v) else control)[k] = v
-        blob = wire.encode_pytree(tensors) if tensors else b""
-        if len(blob) > PAYLOAD_INLINE_LIMIT:
+        """One wire format (Message.encode); the only MQTT-specific decision
+        is store-offload of large payloads: marker byte 'D' = direct bytes,
+        'R' = store reference."""
+        body = msg.encode()
+        if len(body) > PAYLOAD_INLINE_LIMIT:
             key = f"{self.run_id}/{uuid.uuid4().hex}"
-            self.store.put(key, blob)
-            envelope = {"control": control, "store_key": key}
-            body = json.dumps(envelope).encode()
+            self.store.put(key, body)
+            payload = b"R" + json.dumps({"store_key": key}).encode()
         else:
-            body = json.dumps({"control": control}).encode() + b"\x00" + blob
+            payload = b"D" + body
         topic = f"fedml_{self.run_id}_to_{msg.get_receiver_id()}"
-        self.broker.publish(topic, body)
+        self.broker.publish(topic, payload)
 
-    def _decode(self, payload: bytes) -> Message:
-        if b"\x00" in payload[:PAYLOAD_INLINE_LIMIT + 4096]:
-            head, _, blob = payload.partition(b"\x00")
-            envelope = json.loads(head.decode())
-        else:
-            envelope = json.loads(payload.decode())
-            blob = b""
-        control = envelope["control"]
-        tensors = {}
-        if "store_key" in envelope:
-            blob = self.store.get(envelope["store_key"])
-        if blob:
-            tensors = wire.decode_pytree(blob)
-        msg = Message()
-        msg.msg_params = {**control, **tensors}
-        return msg
-
-    def add_observer(self, observer: Observer) -> None:
-        self._observers.append(observer)
-
-    def remove_observer(self, observer: Observer) -> None:
-        self._observers.remove(observer)
-
-    def handle_receive_message(self) -> None:
-        self._running = True
-        while self._running:
-            try:
-                payload = self._inbox.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            msg = self._decode(payload)
-            for obs in list(self._observers):
-                obs.receive_message(msg.get_type(), msg)
-
-    def stop_receive_message(self) -> None:
-        self._running = False
+    def _decode_bytes(self, payload: bytes) -> Message:
+        marker, rest = payload[:1], payload[1:]
+        if marker == b"R":
+            rest = self.store.get(json.loads(rest.decode())["store_key"])
+        elif marker != b"D":
+            raise ValueError(f"unknown payload marker {marker!r}")
+        return Message.decode(rest)

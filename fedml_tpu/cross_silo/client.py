@@ -45,8 +45,11 @@ class FedMLTrainer:
         self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
         self._train = jax.jit(make_local_train_fn(model, self.hp))
 
-    def train(self, global_vars, round_idx: int, seed_key) -> tuple:
-        key = rng.client_key(rng.round_key(seed_key, round_idx), 0)
+    def train(self, global_vars, round_idx: int, seed_key, client_idx: int = 0) -> tuple:
+        # per-client RNG stream keyed by the server-assigned client index —
+        # matches the simulator's client_key(round_key(k, r), i) derivation so
+        # cross-silo and simulation runs share sampling/dropout streams
+        key = rng.client_key(rng.round_key(seed_key, round_idx), client_idx)
         variables = jax.tree_util.tree_map(jnp.asarray, global_vars)
         new_vars, metrics = self._train(variables, self.x, self.y, self.count, key, None)
         return jax.device_get(new_vars), float(self.count)
@@ -81,7 +84,8 @@ class ClientMasterManager(FedMLCommManager):
     def _train_and_send(self, msg: Message) -> None:
         round_idx = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
         params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
-        new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key)
+        client_idx = int(msg.get(md.MSG_ARG_KEY_CLIENT_INDEX, self.rank - 1))
+        new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key, client_idx)
         self.rounds_trained += 1
         reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, new_vars)

@@ -155,15 +155,7 @@ class FedMLServerManager(FedMLCommManager):
 
     def send_init_msg(self) -> None:
         """Reference ``send_init_msg`` (:48): global model + per-client index."""
-        self.selected = self.aggregator.client_selection(self.round_idx, self.client_ids, self.per_round)
-        params = jax.device_get(self.aggregator.global_vars)
-        for cid in self.selected:
-            msg = Message(md.MSG_TYPE_S2C_INIT_CONFIG, 0, cid)
-            msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
-            msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
-            msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
-            self.send_message(msg)
-        self._arm_straggler_timer()
+        self._broadcast_model(md.MSG_TYPE_S2C_INIT_CONFIG)
 
     def handle_message_receive_model(self, msg: Message) -> None:
         with self._agg_lock:
@@ -216,10 +208,15 @@ class FedMLServerManager(FedMLCommManager):
         if self.round_idx >= self.comm_round:
             self.send_finish()
             return
+        self._broadcast_model(md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+
+    def _broadcast_model(self, msg_type: int) -> None:
+        """Select clients, send them the global model for this round, arm the
+        straggler timer — shared by round 0 (INIT) and later rounds (SYNC)."""
         self.selected = self.aggregator.client_selection(self.round_idx, self.client_ids, self.per_round)
         params = jax.device_get(self.aggregator.global_vars)
         for cid in self.selected:
-            msg = Message(md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, cid)
+            msg = Message(msg_type, 0, cid)
             msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
             msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
             msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)

@@ -1,0 +1,77 @@
+"""LoRA — low-rank adaptation as a pure parameter transform.
+
+Parity with the reference's PEFT integration (``train/llm/configurations.py``
+``ModelArguments`` LoRA r/alpha/dropout/target fields :181-188; FedLLM
+exchanges only the PEFT state dict).  Here LoRA is functional: adapters are a
+separate pytree ``{path: {"a": (in, r), "b": (r, out)}}`` and
+
+    merged = base + (alpha / r) * reshape(a @ b)
+
+is differentiable w.r.t. the adapters, so ``jax.grad`` of
+``loss(merge(base, lora))`` trains ONLY the adapters with the base frozen —
+no model surgery, works for any flax model.  The federated payload is the
+adapter tree alone (the whole point of FedLLM: exchange K entries of rank-r
+factors, not 7B weights).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_TARGETS = r".*attn/w[qkvo]/kernel"
+
+
+def _match_paths(params, targets: str):
+    out = []
+
+    def visit(path, leaf):
+        ps = "/".join(str(getattr(p, "key", p)) for p in path)
+        if re.fullmatch(targets, ps) and leaf.ndim >= 2:
+            out.append((ps, leaf.shape, leaf.dtype))
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return out
+
+
+def init_lora(params, rank: int, key: jax.Array, targets: str = DEFAULT_TARGETS,
+              dtype=jnp.float32) -> dict:
+    """Adapter tree keyed by 'path/with/slashes' -> {a, b}."""
+    lora = {}
+    for i, (path, shape, _) in enumerate(_match_paths(params, targets)):
+        d_in = shape[0]
+        d_out = int(np.prod(shape[1:]))
+        ka = jax.random.fold_in(key, 2 * i)
+        lora[path] = {
+            "a": jax.random.normal(ka, (d_in, rank), dtype) * (1.0 / max(1, d_in)) ** 0.5,
+            "b": jnp.zeros((rank, d_out), dtype),  # zero init: merge starts as identity
+        }
+    if not lora:
+        raise ValueError(f"no parameters matched LoRA targets {targets!r}")
+    return lora
+
+
+def merge(base_params, lora: dict, alpha: float = 16.0, rank: Optional[int] = None):
+    """base + (alpha/r) * a@b, reshaped to each target's shape.  Pure and
+    differentiable in ``lora``."""
+    if rank is None:
+        rank = next(iter(lora.values()))["a"].shape[1]
+    scale = alpha / rank
+
+    def update(path, leaf):
+        ps = "/".join(str(getattr(p, "key", p)) for p in path)
+        ab = lora.get(ps)
+        if ab is None:
+            return leaf
+        delta = (ab["a"] @ ab["b"]).reshape(leaf.shape) * scale
+        return leaf + delta.astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(update, base_params)
+
+
+def lora_size(lora: dict) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(lora))

@@ -1,0 +1,152 @@
+"""Llama-style decoder-only transformer (flax.linen).
+
+Capability target: the reference's LLM stack trains HF Llama-2/TinyLlama via
+torch + DeepSpeed (``train/llm/``, SURVEY.md §2.15).  This is the TPU-native
+model: RMSNorm, rotary embeddings, (grouped-query) attention, SwiGLU MLP —
+built for GSPMD sharding (pure einsum/Dense, static shapes) with optional
+ring attention when a ``seq`` mesh axis is present (long-context,
+SURVEY.md §5) and ``jax.checkpoint``-friendly block structure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1408  # ~8/3 * d_model rounded
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True  # jax.checkpoint each block (HBM <-> FLOPs trade)
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 1024):
+        return cls(vocab_size=vocab_size, d_model=128, n_layers=2, n_heads=4,
+                   n_kv_heads=4, d_ff=352, max_seq_len=512)
+
+    @classmethod
+    def llama_7b(cls):
+        """Llama-2-7B shape (the reference FedLLM target model)."""
+        return cls(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=32, d_ff=11008, max_seq_len=4096)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding. x: (b, s, h, d)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # (b, s, 1, d/2)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Any] = None
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        hd = cfg.d_model // cfg.n_heads
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype)
+        q = dense(features=(cfg.n_heads, hd), name="wq")(x)
+        k = dense(features=(cfg.n_kv_heads, hd), name="wk")(x)
+        v = dense(features=(cfg.n_kv_heads, hd), name="wv")(x)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if cfg.n_kv_heads != cfg.n_heads:  # GQA: repeat kv heads
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if self.mesh is not None and self.seq_axis and self.mesh.shape[self.seq_axis] > 1:
+            from ..ops.ring_attention import ring_attention
+            from ..parallel.mesh import AXIS_DATA, AXIS_MODEL
+
+            out = ring_attention(
+                q, k, v, self.mesh, axis=self.seq_axis, causal=True,
+                dp_axis=AXIS_DATA, tp_axis=AXIS_MODEL,
+            )
+        else:
+            from ..ops.ring_attention import dense_attention
+
+            out = dense_attention(q, k, v, causal=True)
+        return nn.DenseGeneral(
+            features=cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype, name="wo"
+        )(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype, name="w_gate")(x)
+        up = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype, name="w_up")(x)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype, name="w_down")(
+            nn.silu(gate) * up
+        )
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Any] = None
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        x = x + Attention(cfg, self.mesh, self.seq_axis, name="attn")(
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions
+        )
+        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        return x
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Any] = None
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        b, s = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed")(tokens)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, self.mesh, self.seq_axis, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(x)
+        return logits

@@ -1,0 +1,103 @@
+"""Parameter/activation sharding rules (GSPMD).
+
+The reference scales LLM training with DeepSpeed ZeRO-3 param sharding
+(``train/llm/distributed.py:52-68``, ``ds_z3_bf16_config.json`` — SURVEY.md
+§2.14 P6).  On TPU the same thing is a set of ``PartitionSpec`` rules: fully
+sharding parameters over the ``data`` axis IS ZeRO-3 (GSPMD inserts the
+gather/scatter), and a ``model`` axis adds Megatron-style tensor parallelism
+the reference never had.
+
+Rules are (path-regex -> PartitionSpec) pairs matched against flattened
+parameter paths, the idiom used by t5x/maxtext-style trainers.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+
+# (regex over 'layer_0/attn/wq/kernel'-style paths, spec builder)
+# Specs assume kernels are (in, out) or (in, heads, head_dim).
+TRANSFORMER_RULES = [
+    # attention projections: shard heads/out over model axis, in over data (zero3)
+    (r".*attn/w[qkv]/kernel", lambda dp, tp: P(dp, tp, None)),
+    (r".*attn/wo/kernel", lambda dp, tp: P(tp, None, dp)),
+    # mlp: gate/up shard out over model; down shards in over model
+    (r".*mlp/w_(gate|up)/kernel", lambda dp, tp: P(dp, tp)),
+    (r".*mlp/w_down/kernel", lambda dp, tp: P(tp, dp)),
+    # embeddings / head: vocab over model axis
+    (r".*embed/embedding", lambda dp, tp: P(tp, dp)),
+    (r".*lm_head/kernel", lambda dp, tp: P(dp, tp)),
+    # norms replicated
+    (r".*norm.*/scale", lambda dp, tp: P()),
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def partition_specs(params, rules=TRANSFORMER_RULES, dp_axis: Optional[str] = AXIS_DATA,
+                    tp_axis: Optional[str] = AXIS_MODEL, mesh: Optional[Mesh] = None):
+    """Pytree of PartitionSpecs for ``params`` by first-matching rule.
+
+    Axes absent from ``mesh`` (or of size 1) degrade to None in the spec, so
+    the same rules serve pure-DP, pure-TP, and hybrid meshes.
+    """
+    def axis_or_none(name):
+        if name is None or mesh is None:
+            return name
+        return name if (name in mesh.shape and mesh.shape[name] > 1) else None
+
+    dp = axis_or_none(dp_axis)
+    tp = axis_or_none(tp_axis)
+
+    def spec_for(path, leaf):
+        ps = _path_str(path)
+        for pattern, builder in rules:
+            if re.fullmatch(pattern, ps):
+                spec = builder(dp, tp)
+                # trim/extend to leaf rank
+                entries = list(spec)[: leaf.ndim]
+                entries += [None] * (leaf.ndim - len(entries))
+                # drop shardings that don't divide the dim evenly
+                entries = [
+                    e if e is not None and leaf.shape[i] % (mesh.shape[e] if mesh else 1) == 0 else (e if e is None else None)
+                    for i, e in enumerate(entries)
+                ]
+                return P(*entries)
+        return P()  # replicate by default
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def named_shardings(params, mesh: Mesh, **kw):
+    specs = partition_specs(params, mesh=mesh, **kw)
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params, mesh: Mesh, **kw):
+    sh = named_shardings(params, mesh, **kw)
+    return jax.tree_util.tree_map(jax.device_put, params, sh)
+
+
+def batch_sharding(mesh: Mesh, dp_axis: str = AXIS_DATA, seq_axis: Optional[str] = None):
+    """(batch, seq, ...) activation sharding: batch over dp, seq over sp."""
+    dp = dp_axis if dp_axis in mesh.shape and mesh.shape[dp_axis] > 1 else None
+    sp = seq_axis if seq_axis and seq_axis in mesh.shape and mesh.shape[seq_axis] > 1 else None
+    return NamedSharding(mesh, P(dp, sp))
