@@ -1,0 +1,143 @@
+"""CLI — ``python -m fedml_tpu.cli <command>``.
+
+Parity with the reference CLI verbs (``python/fedml/cli/cli.py:11-80``):
+``run`` (a training recipe), ``launch`` (a job.yaml through the scheduler),
+``build`` (package a workspace), ``agent`` (start a worker), ``jobs``/``logs``
+(job DB), ``env``, ``version``.  Cloud-account verbs (``login`` to the SaaS)
+have no meaning in a self-hosted TPU build; ``login`` here registers the
+local spool directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+DEFAULT_SPOOL = os.path.expanduser("~/.fedml_tpu/spool")
+
+
+def cmd_run(args) -> int:
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = fedml_tpu.init(argv=["--cf", args.config] + (["--rank", str(args.rank)] if args.rank is not None else []) + (["--role", args.role] if args.role else []))
+    history = FedMLRunner(cfg).run()
+    if history:
+        print(json.dumps(history[-1]))
+    return 0
+
+
+def cmd_launch(args) -> int:
+    from fedml_tpu.sched.launch import FedMLLaunchManager
+
+    mgr = FedMLLaunchManager(args.spool)
+    run_id = mgr.launch_job(args.job_yaml)
+    print(run_id)
+    return 0
+
+
+def cmd_build(args) -> int:
+    from fedml_tpu.sched.launch import FedMLLaunchManager, JobSpec
+
+    mgr = FedMLLaunchManager(args.spool)
+    spec = JobSpec.from_yaml(args.job_yaml)
+    pkg = mgr.build_package(spec, base_dir=str(Path(args.job_yaml).parent))
+    print(pkg)
+    return 0
+
+
+def cmd_agent(args) -> int:
+    from fedml_tpu.sched.agent import FedMLAgent
+
+    agent = FedMLAgent(args.spool)
+    print(f"agent watching {args.spool}", file=sys.stderr)
+    try:
+        agent.run_forever(poll_s=args.poll)
+    except KeyboardInterrupt:
+        agent.stop()
+    return 0
+
+
+def cmd_jobs(args) -> int:
+    from fedml_tpu.sched.agent import JobDB
+
+    db = JobDB(str(Path(args.spool) / "jobs.sqlite"))
+    for row in db.all_jobs():
+        print(json.dumps(row))
+    return 0
+
+
+def cmd_logs(args) -> int:
+    from fedml_tpu.sched.agent import FedMLAgent
+
+    print(FedMLAgent(args.spool).logs(args.run_id))
+    return 0
+
+
+def cmd_env(args) -> int:
+    import jax
+
+    import fedml_tpu
+
+    info = {
+        "fedml_tpu": fedml_tpu.__version__,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+    }
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def cmd_version(args) -> int:
+    import fedml_tpu
+
+    print(fedml_tpu.__version__)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fedml-tpu")
+    parser.add_argument("--spool", default=DEFAULT_SPOOL, help="local scheduler spool dir")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("run", help="run a training recipe yaml")
+    p.add_argument("--cf", dest="config", required=True)
+    p.add_argument("--rank", type=int, default=None)
+    p.add_argument("--role", default=None)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("launch", help="package + submit a job.yaml")
+    p.add_argument("job_yaml")
+    p.set_defaults(fn=cmd_launch)
+
+    p = sub.add_parser("build", help="build a run package without submitting")
+    p.add_argument("job_yaml")
+    p.set_defaults(fn=cmd_build)
+
+    p = sub.add_parser("agent", help="start a worker agent on the spool")
+    p.add_argument("--poll", type=float, default=0.5)
+    p.set_defaults(fn=cmd_agent)
+
+    p = sub.add_parser("jobs", help="list job statuses")
+    p.set_defaults(fn=cmd_jobs)
+
+    p = sub.add_parser("logs", help="print a run's logs")
+    p.add_argument("run_id")
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("env", help="print environment info")
+    p.set_defaults(fn=cmd_env)
+
+    p = sub.add_parser("version", help="print version")
+    p.set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

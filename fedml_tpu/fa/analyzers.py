@@ -1,0 +1,203 @@
+"""Federated analytics operators.
+
+Parity with the reference analyzer set (``fa/local_analyzer/*`` +
+``fa/aggregator/*``, SURVEY.md §2.15): average, frequency estimation,
+heavy hitter (TrieHH — DP trie growth), set intersection, union,
+k-percentile.  Host-side numpy: analytics payloads are tiny; the federation
+structure (sampling, rounds, per-client locality), not FLOPs, is the point.
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Any, Optional
+
+import numpy as np
+
+from .frame import FAClientAnalyzer, FAServerAggregator
+
+
+# ---------------------------------------------------------------------------
+# average (fa/local_analyzer/avg.py)
+# ---------------------------------------------------------------------------
+
+class AvgClientAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, data, cfg):
+        return (float(np.sum(data)), int(np.size(data)))
+
+
+class AvgServerAggregator(FAServerAggregator):
+    def __init__(self, cfg=None):
+        super().__init__(cfg)
+        self.total, self.count = 0.0, 0
+
+    def aggregate(self, submissions):
+        for s, c in submissions:
+            self.total += s
+            self.count += c
+        self.server_data = self.total / max(self.count, 1)
+        return self.server_data
+
+
+# ---------------------------------------------------------------------------
+# frequency estimation (fa/local_analyzer/frequency_estimation.py)
+# ---------------------------------------------------------------------------
+
+class FrequencyClientAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, data, cfg):
+        vals, counts = np.unique(np.asarray(data), return_counts=True)
+        return dict(zip(vals.tolist(), counts.tolist()))
+
+
+class FrequencyServerAggregator(FAServerAggregator):
+    def __init__(self, cfg=None):
+        super().__init__(cfg)
+        self.freq: Counter = Counter()
+
+    def aggregate(self, submissions):
+        for sub in submissions:
+            self.freq.update(sub)
+        total = sum(self.freq.values())
+        self.server_data = {k: v / total for k, v in self.freq.items()}
+        return self.server_data
+
+
+# ---------------------------------------------------------------------------
+# heavy hitters — TrieHH (fa/local_analyzer/heavy_hitter_triehh.py)
+# ---------------------------------------------------------------------------
+
+class TrieHHClientAnalyzer(FAClientAnalyzer):
+    """Each round, a client votes for the (prefix + next char) extension of
+    its word if the prefix is already in the server trie."""
+
+    def local_analyze(self, data, cfg):
+        import zlib
+
+        trie = self.init_msg or {""}
+        votes = Counter()
+        words = [str(w) for w in np.ravel(data)]
+        # stable per-client seed (hash() is salted per interpreter)
+        rng = np.random.RandomState(zlib.crc32("|".join(words[:4]).encode()) % (2**31))
+        if not words:
+            return votes
+        w = words[rng.randint(len(words))]  # one word per client per round (DP)
+        for L in range(1, len(w) + 1):
+            if w[: L - 1] in trie:
+                votes[w[:L]] += 1
+        return votes
+
+
+class TrieHHServerAggregator(FAServerAggregator):
+    """Grow the trie with extensions voted >= theta times (DP threshold)."""
+
+    def __init__(self, cfg=None, theta: int = 2, max_len: int = 10):
+        super().__init__(cfg)
+        self.theta = theta
+        self.max_len = max_len
+        self.trie: set = {""}
+
+    def init_msg(self):
+        return set(self.trie)
+
+    def aggregate(self, submissions):
+        votes: Counter = Counter()
+        for sub in submissions:
+            votes.update(sub)
+        for prefix, c in votes.items():
+            if c >= self.theta and len(prefix) <= self.max_len:
+                self.trie.add(prefix)
+        self.server_data = self.trie
+        return self.trie
+
+    def heavy_hitters(self) -> set:
+        """Maximal trie entries (complete voted words/prefixes)."""
+        return {p for p in self.trie if p and not any(
+            q != p and q.startswith(p) for q in self.trie
+        )}
+
+
+# ---------------------------------------------------------------------------
+# intersection / union (fa/local_analyzer/intersection.py, union.py)
+# ---------------------------------------------------------------------------
+
+class IntersectionClientAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, data, cfg):
+        return set(np.unique(np.asarray(data)).tolist())
+
+
+class IntersectionServerAggregator(FAServerAggregator):
+    def aggregate(self, submissions):
+        for s in submissions:
+            self.server_data = set(s) if self.server_data is None else self.server_data & set(s)
+        return self.server_data
+
+
+class UnionServerAggregator(FAServerAggregator):
+    def aggregate(self, submissions):
+        for s in submissions:
+            self.server_data = set(s) if self.server_data is None else self.server_data | set(s)
+        return self.server_data
+
+
+# ---------------------------------------------------------------------------
+# k-percentile (fa/local_analyzer/k_percentile.py) — distributed quantile by
+# iterative bisection on candidate values (clients only report counts)
+# ---------------------------------------------------------------------------
+
+class KPercentileClientAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, data, cfg):
+        pivot = self.init_msg
+        arr = np.asarray(data, dtype=np.float64)
+        return (int(np.sum(arr <= pivot)), int(arr.size), float(arr.min()), float(arr.max()))
+
+
+class KPercentileServerAggregator(FAServerAggregator):
+    def __init__(self, cfg=None, k: float = 50.0, iters_done_eps: float = 1e-6):
+        super().__init__(cfg)
+        self.k = k
+        self.lo: Optional[float] = None
+        self.hi: Optional[float] = None
+        self.pivot: float = 0.0
+        self.eps = iters_done_eps
+
+    def init_msg(self):
+        if self.lo is None:
+            return self.pivot
+        self.pivot = 0.5 * (self.lo + self.hi)
+        return self.pivot
+
+    def aggregate(self, submissions):
+        below = sum(s[0] for s in submissions)
+        total = sum(s[1] for s in submissions)
+        lo = min(s[2] for s in submissions)
+        hi = max(s[3] for s in submissions)
+        if self.lo is None:
+            self.lo, self.hi = lo, hi
+            self.pivot = 0.5 * (lo + hi)
+            return self.pivot
+        frac = 100.0 * below / max(total, 1)
+        if frac < self.k:
+            self.lo = self.pivot
+        else:
+            self.hi = self.pivot
+        self.server_data = 0.5 * (self.lo + self.hi)
+        return self.server_data
+
+
+_ANALYZERS = {
+    "avg": (AvgClientAnalyzer, AvgServerAggregator),
+    "frequency_estimation": (FrequencyClientAnalyzer, FrequencyServerAggregator),
+    "heavy_hitter_triehh": (TrieHHClientAnalyzer, TrieHHServerAggregator),
+    "intersection": (IntersectionClientAnalyzer, IntersectionServerAggregator),
+    "union": (IntersectionClientAnalyzer, UnionServerAggregator),
+    "k_percentile": (KPercentileClientAnalyzer, KPercentileServerAggregator),
+}
+
+
+def create_analyzer_pair(task: str, cfg=None):
+    """Reference ``fa`` dispatch on the analytics task name."""
+    try:
+        ca, sa = _ANALYZERS[task]
+    except KeyError:
+        raise ValueError(f"unknown FA task {task!r}; known: {sorted(_ANALYZERS)}") from None
+    return ca(cfg), sa(cfg)

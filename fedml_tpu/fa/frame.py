@@ -1,0 +1,76 @@
+"""Federated analytics frame.
+
+Parity with ``fa/base_frame/`` (``FAClientAnalyzer``
+``client_analyzer.py:5``, ``FAServerAggregator`` ``server_aggregator.py:5``)
+and ``FARunner``/``FASimulatorSingleProcess`` (``fa/runner.py:5``,
+``fa/simulation/sp/simulator.py:9``): clients run a local analysis over their
+raw data, the server aggregates submissions — same round structure as FL but
+over analytics payloads instead of model weights.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..core import rng
+from ..obs.metrics import MetricsLogger
+
+
+class FAClientAnalyzer:
+    """Local analysis operator (reference ``client_analyzer.py``)."""
+
+    def __init__(self, cfg=None):
+        self.cfg = cfg
+        self.init_msg: Any = None
+
+    def set_init_msg(self, msg: Any) -> None:
+        self.init_msg = msg
+
+    def local_analyze(self, data: np.ndarray, cfg) -> Any:
+        raise NotImplementedError
+
+
+class FAServerAggregator:
+    """Server aggregation operator (reference ``server_aggregator.py``)."""
+
+    def __init__(self, cfg=None):
+        self.cfg = cfg
+        self.server_data: Any = None
+
+    def init_msg(self) -> Any:
+        return None
+
+    def aggregate(self, submissions: list) -> Any:
+        raise NotImplementedError
+
+    def result(self) -> Any:
+        return self.server_data
+
+
+class FASimulator:
+    """Single-process FA simulator (``FASimulatorSingleProcess``):
+    sample clients -> local_analyze -> aggregate, for comm_round rounds."""
+
+    def __init__(self, cfg, client_data: Sequence[np.ndarray],
+                 analyzer: FAClientAnalyzer, aggregator: FAServerAggregator,
+                 logger: Optional[MetricsLogger] = None):
+        self.cfg = cfg
+        self.client_data = list(client_data)
+        self.analyzer = analyzer
+        self.aggregator = aggregator
+        self.key = rng.root_key(cfg.random_seed)
+        self.logger = logger or MetricsLogger(stdout=False)
+
+    def run(self) -> Any:
+        n = len(self.client_data)
+        m = min(self.cfg.client_num_per_round, n)
+        for r in range(self.cfg.comm_round):
+            sampled = np.asarray(rng.sample_clients(self.key, r, n, m))
+            self.analyzer.set_init_msg(self.aggregator.init_msg())
+            subs = [self.analyzer.local_analyze(self.client_data[int(c)], self.cfg) for c in sampled]
+            self.aggregator.aggregate(subs)
+            self.logger.log({"round": r, "submissions": len(subs)})
+        return self.aggregator.result()

@@ -81,11 +81,19 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_leading_axis(tree, mesh: Mesh, axis: str = AXIS_CLIENTS):
-    """Place a stacked pytree with its leading dim sharded over ``axis``."""
-    sh = client_sharding(mesh, axis)
+    """Place a stacked pytree with its leading dim sharded over ``axis``.
+
+    Leading dims not divisible by the axis size are replicated instead —
+    correctness over parallelism for small client counts (XLA still shards
+    downstream vmapped compute as it sees fit).
+    """
+    size = mesh.shape[axis]
 
     def put(x):
-        spec = P(axis, *([None] * (x.ndim - 1)))
+        if x.ndim >= 1 and x.shape[0] % size == 0:
+            spec = P(axis, *([None] * (x.ndim - 1)))
+        else:
+            spec = P()
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(put, tree)

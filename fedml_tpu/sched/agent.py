@@ -1,0 +1,178 @@
+"""Worker agent — consume run packages, execute, monitor, report.
+
+Parity with the reference's slave/master agent runners
+(``computing/scheduler/slave/client_runner.py:62`` — download package, rewrite
+config, bootstrap, spawn the user job as a subprocess; status reporting to a
+job DB; ``comm_utils/job_monitor.py:48`` — liveness sweeps).  This build's
+compact agent keeps the exact pipeline over the local spool:
+
+  queue/*.zip -> unzip to runs/<id>/ -> bootstrap -> spawn subprocess
+  -> sqlite status DB (reference client_data_interface.py keeps sqlite too)
+  -> JobMonitor sweep marks dead processes FAILED / reaps zombies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sqlite3
+import subprocess
+import sys
+import threading
+import time
+import zipfile
+from pathlib import Path
+from typing import Optional
+
+
+class JobDB:
+    """sqlite job table (reference ``client_data_interface.py``)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with self._conn() as c:
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS jobs ("
+                "run_id TEXT PRIMARY KEY, status TEXT, pid INTEGER, "
+                "returncode INTEGER, started REAL, finished REAL, log_path TEXT)"
+            )
+
+    def _conn(self):
+        return sqlite3.connect(self.path)
+
+    def upsert(self, run_id: str, **fields) -> None:
+        with self._conn() as c:
+            cur = c.execute("SELECT run_id FROM jobs WHERE run_id=?", (run_id,))
+            if cur.fetchone() is None:
+                c.execute("INSERT INTO jobs (run_id, status) VALUES (?, 'QUEUED')", (run_id,))
+            sets = ", ".join(f"{k}=?" for k in fields)
+            c.execute(f"UPDATE jobs SET {sets} WHERE run_id=?", (*fields.values(), run_id))
+
+    def get(self, run_id: str) -> Optional[dict]:
+        with self._conn() as c:
+            c.row_factory = sqlite3.Row
+            row = c.execute("SELECT * FROM jobs WHERE run_id=?", (run_id,)).fetchone()
+            return dict(row) if row else None
+
+    def all_jobs(self) -> list[dict]:
+        with self._conn() as c:
+            c.row_factory = sqlite3.Row
+            return [dict(r) for r in c.execute("SELECT * FROM jobs")]
+
+
+class FedMLAgent:
+    """One worker agent bound to a spool directory."""
+
+    def __init__(self, spool_dir: str, env: Optional[dict] = None):
+        self.spool = Path(spool_dir)
+        self.queue = self.spool / "queue"
+        self.runs = self.spool / "runs"
+        self.queue.mkdir(parents=True, exist_ok=True)
+        self.runs.mkdir(parents=True, exist_ok=True)
+        self.db = JobDB(str(self.spool / "jobs.sqlite"))
+        self.env = env
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._running = False
+
+    # -- package pipeline (reference run_impl :480) --------------------------
+    def process_package(self, pkg: Path) -> str:
+        with zipfile.ZipFile(pkg) as z:
+            manifest = json.loads(z.read("__fedml_job__.json"))
+            run_id = manifest["run_id"]
+            run_dir = self.runs / run_id
+            run_dir.mkdir(parents=True, exist_ok=True)
+            z.extractall(run_dir)
+        pkg.unlink()  # claimed
+        log_path = str(run_dir / "job.log")
+        self.db.upsert(run_id, status="PROVISIONING", log_path=log_path)
+        logf = open(log_path, "ab")
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        env["FEDML_RUN_ID"] = run_id
+        # bootstrap (reference bootstrap :395)
+        if manifest.get("bootstrap"):
+            rc = subprocess.call(
+                manifest["bootstrap"], shell=True, cwd=run_dir, stdout=logf, stderr=logf, env=env
+            )
+            if rc != 0:
+                self.db.upsert(run_id, status="FAILED", returncode=rc, finished=time.time())
+                logf.close()
+                return run_id
+        proc = subprocess.Popen(
+            manifest["job"], shell=True, cwd=run_dir, stdout=logf, stderr=logf, env=env
+        )
+        self._procs[run_id] = proc
+        self.db.upsert(run_id, status="RUNNING", pid=proc.pid, started=time.time())
+        return run_id
+
+    def sweep_once(self) -> list[str]:
+        """One scheduling pass: claim queued packages + reap finished jobs
+        (the JobMonitor role, ``job_monitor.py:337``)."""
+        claimed = []
+        for pkg in sorted(self.queue.glob("*.zip")):
+            try:
+                claimed.append(self.process_package(pkg))
+            except FileNotFoundError:
+                continue  # another agent claimed it
+        for run_id, proc in list(self._procs.items()):
+            rc = proc.poll()
+            if rc is not None:
+                self.db.upsert(
+                    run_id,
+                    status="FINISHED" if rc == 0 else "FAILED",
+                    returncode=rc, finished=time.time(),
+                )
+                del self._procs[run_id]
+        return claimed
+
+    def run_forever(self, poll_s: float = 0.5) -> None:
+        self._running = True
+        while self._running:
+            self.sweep_once()
+            time.sleep(poll_s)
+
+    def run_in_thread(self, poll_s: float = 0.5) -> threading.Thread:
+        t = threading.Thread(target=self.run_forever, args=(poll_s,), daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._running = False
+        for run_id, proc in self._procs.items():
+            proc.terminate()
+            self.db.upsert(run_id, status="UNDETERMINED")
+
+    def wait_for(self, run_id: str, timeout: float = 120.0) -> dict:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self.sweep_once()
+            row = self.db.get(run_id)
+            if row and row["status"] in ("FINISHED", "FAILED"):
+                return row
+            time.sleep(0.2)
+        raise TimeoutError(f"job {run_id} did not finish in {timeout}s")
+
+    def logs(self, run_id: str) -> str:
+        row = self.db.get(run_id)
+        if not row or not row.get("log_path"):
+            return ""
+        p = Path(row["log_path"])
+        return p.read_text() if p.exists() else ""
+
+
+def match_resources(jobs: list[dict], agents: list[dict]) -> dict[str, str]:
+    """Minimal scheduler matcher (reference ``scheduler_matcher.py:6``): match
+    each job's requested device count against agents' free devices,
+    first-fit decreasing."""
+    assignment: dict[str, str] = {}
+    free = {a["id"]: int(a.get("num_devices", 1)) for a in agents}
+    for job in sorted(jobs, key=lambda j: -int(j.get("computing", {}).get("minimum_num_gpus", 1))):
+        need = int(job.get("computing", {}).get("minimum_num_gpus", 1))
+        for aid, avail in sorted(free.items(), key=lambda kv: -kv[1]):
+            if avail >= need:
+                assignment[job["run_id"]] = aid
+                free[aid] -= need
+                break
+    return assignment

@@ -1,0 +1,88 @@
+"""Launch manager — package a job workspace and submit it.
+
+Parity with ``computing/scheduler/scheduler_entry/launch_manager.py``
+(``FedMLLaunchManager``): parse a job YAML with the reference's section
+vocabulary (``workspace`` / ``job`` / ``bootstrap`` / ``computing``), build a
+run package (zip of the workspace), and create a run.  The reference uploads
+to S3 and dispatches over MQTT to agents; this build's transport is a local
+spool directory (the zero-egress "local cluster"), with the same artifact
+format — an agent on any shared filesystem consumes identical packages.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+import zipfile
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+import yaml
+
+
+@dataclass
+class JobSpec:
+    """Reference job.yaml schema (launch examples: workspace, job command,
+    bootstrap, computing resources)."""
+
+    workspace: str
+    job: str  # the entry command, e.g. "python main.py --cf fedml_config.yaml"
+    bootstrap: str = ""  # setup script run before the job
+    job_name: str = ""
+    computing: dict = field(default_factory=dict)  # minimum_num_gpus etc.
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "JobSpec":
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        missing = [k for k in ("workspace", "job") if k not in doc]
+        if missing:
+            raise ValueError(f"job yaml missing required keys {missing}")
+        return cls(
+            workspace=doc["workspace"],
+            job=doc["job"],
+            bootstrap=doc.get("bootstrap", ""),
+            job_name=doc.get("job_name", ""),
+            computing=doc.get("computing", {}) or {},
+        )
+
+
+class FedMLLaunchManager:
+    def __init__(self, spool_dir: str):
+        self.spool = Path(spool_dir)
+        (self.spool / "queue").mkdir(parents=True, exist_ok=True)
+        (self.spool / "runs").mkdir(parents=True, exist_ok=True)
+
+    def build_package(self, spec: JobSpec, base_dir: str = ".") -> Path:
+        """Zip the workspace + a manifest (the reference's run package)."""
+        ws = Path(base_dir) / spec.workspace
+        if not ws.is_dir():
+            raise FileNotFoundError(f"workspace {ws} not found")
+        run_id = f"run_{int(time.time())}_{uuid.uuid4().hex[:8]}"
+        pkg = self.spool / "queue" / f"{run_id}.zip"
+        with zipfile.ZipFile(pkg, "w", zipfile.ZIP_DEFLATED) as z:
+            for p in sorted(ws.rglob("*")):
+                if p.is_file():
+                    z.write(p, p.relative_to(ws))
+            manifest = {
+                "run_id": run_id,
+                "job": spec.job,
+                "bootstrap": spec.bootstrap,
+                "job_name": spec.job_name or run_id,
+                "computing": spec.computing,
+                "created": time.time(),
+            }
+            z.writestr("__fedml_job__.json", json.dumps(manifest))
+        return pkg
+
+    def launch_job(self, yaml_path: str) -> str:
+        """``fedml launch job.yaml`` — returns the run_id."""
+        spec = JobSpec.from_yaml(yaml_path)
+        pkg = self.build_package(spec, base_dir=str(Path(yaml_path).parent))
+        return pkg.stem
+
+    def list_queue(self) -> list[str]:
+        return sorted(p.stem for p in (self.spool / "queue").glob("*.zip"))

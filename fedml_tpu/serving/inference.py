@@ -1,0 +1,126 @@
+"""Model serving: predictor interface + HTTP inference runner.
+
+Parity with ``serving/fedml_predictor.py:4`` (user subclasses
+``FedMLPredictor`` with ``predict``/``ready``) and
+``serving/fedml_inference_runner.py:8`` (``FedMLInferenceRunner`` wraps it in
+``POST /predict`` + ``GET /ready``).  The reference uses FastAPI; this build
+serves the same routes from the stdlib ThreadingHTTPServer (FastAPI is not in
+the image), so the client-side contract — JSON in, JSON out, 200/503 ready
+semantics — is identical.
+
+TPU notes: ``JaxPredictor`` jits the model apply once and pads request
+batches to a fixed size so serving never retraces per request shape.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import numpy as np
+
+
+class FedMLPredictor:
+    """Reference API shape (``fedml_predictor.py``)."""
+
+    def predict(self, request: dict) -> Any:
+        raise NotImplementedError
+
+    def ready(self) -> bool:
+        return True
+
+
+class JaxPredictor(FedMLPredictor):
+    """Serve a flax model: request {"inputs": [[...], ...]} -> {"outputs": ...}.
+
+    Pads every batch to ``max_batch`` so one compiled program serves all
+    request sizes (no per-shape retrace).
+    """
+
+    def __init__(self, model, variables, max_batch: int = 32):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.variables = variables
+        self.max_batch = max_batch
+        self._apply = jax.jit(lambda v, x: model.apply(v, x, train=False))
+        self._jnp = jnp
+
+    def predict(self, request: dict) -> dict:
+        x = np.asarray(request["inputs"], dtype=np.float32)
+        n = x.shape[0]
+        if n > self.max_batch:
+            raise ValueError(f"batch {n} exceeds max_batch {self.max_batch}")
+        pad = self.max_batch - n
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        logits = self._apply(self.variables, self._jnp.asarray(x))
+        return {"outputs": np.asarray(logits)[:n].tolist()}
+
+
+class FedMLInferenceRunner:
+    """HTTP runner (``fedml_inference_runner.py``): POST /predict, GET /ready."""
+
+    def __init__(self, predictor: FedMLPredictor, host: str = "127.0.0.1", port: int = 2345):
+        self.predictor = predictor
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _make_handler(self):
+        predictor = self.predictor
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    if predictor.ready():
+                        self._json(200, {"status": "ready"})
+                    else:
+                        self._json(503, {"status": "not ready"})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    request = json.loads(self.rfile.read(length).decode())
+                    result = predictor.predict(request)
+                    self._json(200, result)
+                except Exception as e:  # surface the error to the caller
+                    self._json(400, {"error": f"{type(e).__name__}: {e}"})
+
+        return Handler
+
+    def run(self, block: bool = True) -> int:
+        """Start serving; returns the bound port (0 port -> ephemeral)."""
+        self._server = ThreadingHTTPServer((self.host, self.port), self._make_handler())
+        self.port = self._server.server_address[1]
+        if block:
+            self._server.serve_forever()
+        else:
+            self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+            self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()

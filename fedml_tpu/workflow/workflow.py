@@ -1,0 +1,114 @@
+"""Workflow engine — DAG of jobs.
+
+Parity with ``workflow/workflow.py:42`` (``Workflow``: topological execution,
+loop detection) and ``workflow/jobs.py:9,43`` (``Job``/``JobStatus``).  Jobs
+are arbitrary callables (the reference wraps ``fedml launch`` yaml runs —
+here a job may wrap a simulator run, a bench, a deploy, a shell step).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import time
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("fedml_tpu.workflow")
+
+
+class JobStatus(str, enum.Enum):
+    PROVISIONING = "PROVISIONING"
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+    UNDETERMINED = "UNDETERMINED"
+
+
+class Job:
+    """Reference ``Job`` shape: named unit with run/status/kill."""
+
+    def __init__(self, name: str, fn: Optional[Callable[..., Any]] = None):
+        self.name = name
+        self.fn = fn
+        self.status = JobStatus.PROVISIONING
+        self.output: Any = None
+        self.error: Optional[BaseException] = None
+        self.dependencies: list[str] = []
+
+    def run(self, **inputs) -> Any:
+        self.status = JobStatus.RUNNING
+        try:
+            self.output = self.fn(**inputs) if self.fn else None
+            self.status = JobStatus.FINISHED
+            return self.output
+        except BaseException as e:
+            self.status = JobStatus.FAILED
+            self.error = e
+            raise
+
+    def kill(self) -> None:
+        self.status = JobStatus.UNDETERMINED
+
+
+class Workflow:
+    """Reference ``Workflow``: add_job(job, dependencies=[...]), topological
+    run, loops forbidden."""
+
+    def __init__(self, name: str = "workflow"):
+        self.name = name
+        self.jobs: dict[str, Job] = {}
+        self._run_order: list[str] = []
+
+    def add_job(self, job: Job, dependencies: Optional[list] = None) -> None:
+        deps = [d.name if isinstance(d, Job) else str(d) for d in (dependencies or [])]
+        job.dependencies = deps
+        if job.name in self.jobs:
+            raise ValueError(f"duplicate job name {job.name!r}")
+        self.jobs[job.name] = job
+
+    def _toposort(self) -> list[str]:
+        for j in self.jobs.values():
+            for d in j.dependencies:
+                if d not in self.jobs:
+                    raise ValueError(f"job {j.name!r} depends on unknown job {d!r}")
+        order, seen, visiting = [], set(), set()
+
+        def visit(name: str):
+            if name in seen:
+                return
+            if name in visiting:
+                raise ValueError(f"workflow contains a cycle through {name!r}")
+            visiting.add(name)
+            for d in self.jobs[name].dependencies:
+                visit(d)
+            visiting.discard(name)
+            seen.add(name)
+            order.append(name)
+
+        for name in self.jobs:
+            visit(name)
+        return order
+
+    def run(self) -> dict[str, Any]:
+        """Execute jobs in dependency order; each job receives its
+        dependencies' outputs as kwargs keyed by job name."""
+        self._run_order = self._toposort()
+        outputs: dict[str, Any] = {}
+        for name in self._run_order:
+            job = self.jobs[name]
+            inputs = {d: outputs[d] for d in job.dependencies}
+            log.info("workflow %s: running job %s", self.name, name)
+            t0 = time.perf_counter()
+            outputs[name] = job.run(**inputs)
+            log.info("workflow %s: job %s finished in %.2fs", self.name, name, time.perf_counter() - t0)
+        return outputs
+
+    def get_workflow_status(self) -> JobStatus:
+        statuses = {j.status for j in self.jobs.values()}
+        if JobStatus.FAILED in statuses:
+            return JobStatus.FAILED
+        if JobStatus.RUNNING in statuses:
+            return JobStatus.RUNNING
+        if statuses == {JobStatus.FINISHED}:
+            return JobStatus.FINISHED
+        return JobStatus.PROVISIONING

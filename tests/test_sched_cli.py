@@ -1,0 +1,112 @@
+"""Scheduler vertical + CLI tests: package -> agent -> subprocess -> status DB
+-> logs, mirroring the reference launch pipeline (SURVEY.md §3.4) on the
+local spool transport."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+
+def _make_workspace(tmp_path: Path, body: str, job: str = "python main.py") -> Path:
+    ws = tmp_path / "workspace"
+    ws.mkdir()
+    (ws / "main.py").write_text(body)
+    job_yaml = tmp_path / "job.yaml"
+    job_yaml.write_text(
+        f"workspace: workspace\njob: \"{job}\"\n"
+        "bootstrap: \"echo bootstrap-ran\"\n"
+        "job_name: test_job\n"
+        "computing:\n  minimum_num_gpus: 1\n"
+    )
+    return job_yaml
+
+
+def test_launch_agent_pipeline(tmp_path):
+    from fedml_tpu.sched.agent import FedMLAgent
+    from fedml_tpu.sched.launch import FedMLLaunchManager
+
+    spool = tmp_path / "spool"
+    job_yaml = _make_workspace(tmp_path, "print('hello-from-job')\n")
+    mgr = FedMLLaunchManager(str(spool))
+    run_id = mgr.launch_job(str(job_yaml))
+    assert run_id in mgr.list_queue()
+
+    agent = FedMLAgent(str(spool))
+    row = agent.wait_for(run_id, timeout=60)
+    assert row["status"] == "FINISHED", row
+    logs = agent.logs(run_id)
+    assert "bootstrap-ran" in logs
+    assert "hello-from-job" in logs
+
+
+def test_agent_marks_failed_job(tmp_path):
+    from fedml_tpu.sched.agent import FedMLAgent
+    from fedml_tpu.sched.launch import FedMLLaunchManager
+
+    spool = tmp_path / "spool"
+    job_yaml = _make_workspace(tmp_path, "import sys; sys.exit(3)\n")
+    run_id = FedMLLaunchManager(str(spool)).launch_job(str(job_yaml))
+    agent = FedMLAgent(str(spool))
+    row = agent.wait_for(run_id, timeout=60)
+    assert row["status"] == "FAILED"
+    assert row["returncode"] == 3
+
+
+def test_resource_matcher():
+    from fedml_tpu.sched.agent import match_resources
+
+    jobs = [
+        {"run_id": "big", "computing": {"minimum_num_gpus": 4}},
+        {"run_id": "small", "computing": {"minimum_num_gpus": 1}},
+    ]
+    agents = [{"id": "a8", "num_devices": 8}, {"id": "a1", "num_devices": 1}]
+    asg = match_resources(jobs, agents)
+    assert asg["big"] == "a8"
+    assert asg["small"] in ("a8", "a1")
+
+
+def test_cli_env_version_and_launch(tmp_path):
+    from fedml_tpu import cli
+
+    rc = cli.main(["version"])
+    assert rc == 0
+    job_yaml = _make_workspace(tmp_path, "print('cli-job')\n")
+    spool = str(tmp_path / "spool")
+    rc = cli.main(["--spool", spool, "launch", str(job_yaml)])
+    assert rc == 0
+    rc = cli.main(["--spool", spool, "jobs"])
+    assert rc == 0
+
+
+def test_cli_run_subprocess(tmp_path):
+    """The reference CI pattern: run the tiny recipe via the CLI, assert exit
+    code 0 (SURVEY.md §4 'smoke_test_pip_cli_sp')."""
+    cfg = tmp_path / "fedml_config.yaml"
+    cfg.write_text(
+        "common_args:\n  federated_optimizer: FedAvg\n"
+        "data_args:\n  dataset: synthetic\n  partition_method: homo\n"
+        "  synthetic_train_size: 320\n  synthetic_test_size: 64\n"
+        "model_args:\n  model: lr\n"
+        "train_args:\n  client_num_in_total: 4\n  client_num_per_round: 2\n"
+        "  comm_round: 2\n  batch_size: 16\n  learning_rate: 0.3\n"
+        "device_args:\n  compute_dtype: float32\n"
+        "validation_args:\n  frequency_of_the_test: 2\n"
+    )
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "fedml_tpu.cli", "run", "--cf", str(cfg)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    last = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "test_acc" in last

@@ -1,0 +1,163 @@
+"""Serving (HTTP inference), federated analytics, workflow DAG tests."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+# ---------------------------------------------------------------------------
+# serving
+# ---------------------------------------------------------------------------
+
+def test_inference_runner_http(eight_devices):
+    import jax
+    from fedml_tpu.models.simple import LogisticRegression
+    from fedml_tpu.serving.inference import FedMLInferenceRunner, JaxPredictor
+
+    model = LogisticRegression(num_classes=3)
+    variables = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))
+    runner = FedMLInferenceRunner(JaxPredictor(model, variables, max_batch=8), port=0)
+    port = runner.run(block=False)
+    try:
+        # ready
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready") as r:
+            assert json.loads(r.read())["status"] == "ready"
+        # predict
+        req = json.dumps({"inputs": [[0.1] * 8, [0.2] * 8]}).encode()
+        q = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=req,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(q) as r:
+            out = json.loads(r.read())["outputs"]
+        assert np.asarray(out).shape == (2, 3)
+        # malformed request -> 400 with error body, server stays alive
+        bad = urllib.request.Request(f"http://127.0.0.1:{port}/predict", data=b"{}",
+                                     headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(bad)
+            assert False, "should have errored"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready") as r:
+            assert r.status == 200
+    finally:
+        runner.stop()
+
+
+# ---------------------------------------------------------------------------
+# federated analytics
+# ---------------------------------------------------------------------------
+
+def _fa_cfg(rounds=1, per_round=8):
+    return tiny_config(comm_round=rounds, client_num_per_round=per_round)
+
+
+def test_fa_avg_and_frequency():
+    from fedml_tpu.fa.analyzers import create_analyzer_pair
+    from fedml_tpu.fa.frame import FASimulator
+
+    rng = np.random.RandomState(0)
+    data = [rng.normal(5.0, 1.0, 100) for _ in range(8)]
+    ca, sa = create_analyzer_pair("avg")
+    result = FASimulator(_fa_cfg(), data, ca, sa).run()
+    expected = np.mean(np.concatenate(data))
+    assert abs(result - expected) < 1e-9
+
+    cat_data = [rng.randint(0, 4, 200) for _ in range(8)]
+    ca, sa = create_analyzer_pair("frequency_estimation")
+    freqs = FASimulator(_fa_cfg(), cat_data, ca, sa).run()
+    assert abs(sum(freqs.values()) - 1.0) < 1e-9
+    assert set(freqs) <= {0, 1, 2, 3}
+
+
+def test_fa_intersection_union_percentile():
+    from fedml_tpu.fa.analyzers import create_analyzer_pair
+    from fedml_tpu.fa.frame import FASimulator
+
+    sets = [np.array([1, 2, 3, 4]), np.array([2, 3, 4, 5]), np.array([3, 4, 6])] * 3
+    ca, sa = create_analyzer_pair("intersection")
+    inter = FASimulator(_fa_cfg(per_round=9), sets[:9], ca, sa).run()
+    assert inter == {3, 4}
+    ca, sa = create_analyzer_pair("union")
+    union = FASimulator(_fa_cfg(per_round=9), sets[:9], ca, sa).run()
+    assert union == {1, 2, 3, 4, 5, 6}
+
+    rng = np.random.RandomState(1)
+    data = [rng.uniform(0, 100, 500) for _ in range(8)]
+    ca, sa = create_analyzer_pair("k_percentile")
+    sa.k = 50.0
+    est = FASimulator(_fa_cfg(rounds=25), data, ca, sa).run()
+    true_median = np.percentile(np.concatenate(data), 50)
+    assert abs(est - true_median) < 2.0, (est, true_median)
+
+
+def test_fa_heavy_hitters():
+    from fedml_tpu.fa.analyzers import create_analyzer_pair
+    from fedml_tpu.fa.frame import FASimulator
+
+    # 30 clients mostly holding "the"/"cat"; a few unique words
+    rng = np.random.RandomState(2)
+    common = ["the", "cat"]
+    data = []
+    for i in range(30):
+        words = [common[i % 2]] * 5 + [f"rare{i}"]
+        data.append(np.array(words))
+    ca, sa = create_analyzer_pair("heavy_hitter_triehh")
+    sa.theta = 3
+    FASimulator(_fa_cfg(rounds=12, per_round=20), data, ca, sa).run()
+    hh = sa.heavy_hitters()
+    assert any(h.startswith("the"[:len(h)]) or h.startswith("cat"[:len(h)]) for h in hh), hh
+    assert not any(h.startswith("rare") and len(h) > 4 for h in hh), hh
+
+
+# ---------------------------------------------------------------------------
+# workflow
+# ---------------------------------------------------------------------------
+
+def test_workflow_dag_order_and_outputs():
+    from fedml_tpu.workflow.workflow import Job, JobStatus, Workflow
+
+    order = []
+
+    def make(name, result):
+        def fn(**inputs):
+            order.append(name)
+            return result + sum(v for v in inputs.values())
+
+        return fn
+
+    wf = Workflow("test")
+    a = Job("a", make("a", 1))
+    b = Job("b", make("b", 10))
+    c = Job("c", make("c", 100))
+    wf.add_job(a)
+    wf.add_job(b, dependencies=[a])
+    wf.add_job(c, dependencies=[a, b])
+    outputs = wf.run()
+    assert order.index("a") < order.index("b") < order.index("c")
+    assert outputs == {"a": 1, "b": 11, "c": 112}
+    assert wf.get_workflow_status() == JobStatus.FINISHED
+
+
+def test_workflow_rejects_cycles_and_failures():
+    from fedml_tpu.workflow.workflow import Job, JobStatus, Workflow
+
+    wf = Workflow()
+    a, b = Job("a", lambda **kw: 1), Job("b", lambda **kw: 2)
+    wf.add_job(a, dependencies=["b"])
+    wf.add_job(b, dependencies=["a"])
+    with pytest.raises(ValueError, match="cycle"):
+        wf.run()
+
+    wf2 = Workflow()
+    boom = Job("boom", lambda **kw: 1 / 0)
+    wf2.add_job(boom)
+    with pytest.raises(ZeroDivisionError):
+        wf2.run()
+    assert wf2.get_workflow_status() == JobStatus.FAILED
