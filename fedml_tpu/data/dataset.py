@@ -56,9 +56,12 @@ class StackedClientData:
     ``x``: (n_clients, capacity, *feat) — client shards padded to ``capacity``
     ``y``: (n_clients, capacity)
     ``counts``: (n_clients,) true sample counts (the FedAvg weights)
-    Padding samples are repeats of real samples; the mask (position < count is
-    not used — instead batches are drawn by modular indexing over the true
-    count, see ``sim.engine``), so no gradient correction is needed.
+    Padding slots are cyclic repeats of real samples, so every slot is valid
+    and ``fl.local_sgd`` draws batches from a per-epoch permutation of the FULL
+    padded capacity (static shapes).  For a client with count < capacity this
+    oversamples the cyclically-repeated low-index samples slightly relative to
+    the reference's exact per-epoch shuffle over ``count``; aggregation weights
+    use the true ``counts``, so the FedAvg weighting itself stays exact.
     """
 
     x: np.ndarray

@@ -56,15 +56,17 @@ class RMSNorm(nn.Module):
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary position embedding. x: (b, s, h, d)."""
+    """Rotary position embedding, HF-Llama half-split (rotate_half) convention
+    so pretrained Llama-2 checkpoints (the stated llama_7b target) load without
+    permuting wq/wk.  x: (b, s, h, d)."""
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # (b, s, 1, d/2)
     cos, sin = jnp.cos(angles), jnp.sin(angles)
-    x1, x2 = x[..., ::2], x[..., 1::2]
+    x1, x2 = x[..., : d // 2], x[..., d // 2 :]
     y1 = x1 * cos - x2 * sin
-    y2 = x1 * sin + x2 * cos
-    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+    y2 = x2 * cos + x1 * sin
+    return jnp.concatenate([y1, y2], axis=-1).astype(x.dtype)
 
 
 class Attention(nn.Module):

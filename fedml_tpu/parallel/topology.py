@@ -69,5 +69,19 @@ def asymmetric_topology(n: int, neighbor_num: int, seed: int = 0) -> np.ndarray:
     return A / A.sum(axis=1, keepdims=True)
 
 
+def column_stochastic(W: np.ndarray) -> np.ndarray:
+    """Renormalize a nonnegative mixing matrix so each column sums to 1.
+
+    PushSum requires column stochasticity: each source node's pushed mass
+    totals 1, so the weight column ``w' = W @ w`` evolves away from all-ones
+    and the de-biased ratio ``x / w`` converges to the *uniform* average on a
+    directed graph (row-stochastic W instead converges to the stationary-
+    distribution-weighted consensus).  Self-loops guarantee every column has a
+    nonzero entry.
+    """
+    col = W.sum(axis=0, keepdims=True)
+    return (W / np.where(col == 0, 1.0, col)).astype(np.float32)
+
+
 def fully_connected(n: int) -> np.ndarray:
     return np.full((n, n), 1.0 / n, dtype=np.float32)

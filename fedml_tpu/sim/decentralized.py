@@ -35,7 +35,8 @@ from ..parallel import mesh as meshlib, topology as topo
 
 
 class DecentralizedSimulator:
-    """DSGD (symmetric W) / PushSum (row-stochastic directed W)."""
+    """DSGD (symmetric row-stochastic W) / PushSum (column-stochastic directed
+    W, so the de-bias ratio x/w recovers the uniform average)."""
 
     def __init__(self, cfg: Config, dataset, model, mesh=None, mode: str = None):
         self.cfg = cfg
@@ -53,7 +54,11 @@ class DecentralizedSimulator:
 
         neighbor_num = int(getattr(cfg, "extra", {}).get("topology_neighbor_num", 2) or 2)
         if mode == "pushsum":
-            W = topo.asymmetric_topology(n, neighbor_num, seed=cfg.random_seed)
+            # column-stochastic so the push weights evolve and x/w recovers
+            # the uniform average (see topology.column_stochastic)
+            W = topo.column_stochastic(
+                topo.asymmetric_topology(n, neighbor_num, seed=cfg.random_seed)
+            )
         else:
             W = topo.symmetric_topology(n, neighbor_num, seed=cfg.random_seed)
         self.W = jnp.asarray(W)

@@ -78,18 +78,14 @@ class HierarchicalSimulator:
         G = self.group_num
         group_of = self.group_of
         sub_rounds = self.group_comm_round
-
-        def group_mean(stacked_tree, weights):
-            """Per-group sample-weighted mean via segment_sum (the silo
-            aggregation collective)."""
-            wsum = jax.ops.segment_sum(weights, group_of, num_segments=G)  # (G,)
-
-            def red(leaf):
-                wleaf = leaf.astype(jnp.float32) * weights.reshape((-1,) + (1,) * (leaf.ndim - 1))
-                s = jax.ops.segment_sum(wleaf, group_of, num_segments=G)
-                return s / jnp.maximum(wsum, 1e-12).reshape((-1,) + (1,) * (s.ndim - 1))
-
-            return jax.tree_util.tree_map(red, stacked_tree), wsum
+        n_total = int(self.dataset.n_clients)
+        # honor client_num_per_round: each sub-round samples m clients globally
+        # (the reference hierarchical_fl samples per group per round — a
+        # slightly different distribution: here a group can sit out a sub-round
+        # when none of its members are drawn, in which case it keeps its model);
+        # m == n_total short-circuits to the gather-free full-participation path
+        m = min(max(1, int(self.cfg.client_num_per_round)), n_total)
+        full = m == n_total
 
         def round_fn(global_vars, data_x, data_y, counts, round_idx, key):
             n = counts.shape[0]
@@ -102,13 +98,35 @@ class HierarchicalSimulator:
 
             def sub_round(group_vars, s):
                 skey = jax.random.fold_in(rkey, s)
-                keys = jax.vmap(lambda i: rng.client_key(skey, i))(jnp.arange(n))
-                # each client trains from ITS group's current model
-                my_model = pt.tree_take(group_vars, group_of)
+                if full:
+                    idx = jnp.arange(n)
+                    g_sel, w_sel = group_of, weights
+                    sel_x, sel_y, sel_c = data_x, data_y, counts
+                else:
+                    idx = rng.sample_clients(skey, s, n_total, m)
+                    g_sel = jnp.take(group_of, idx)
+                    w_sel = jnp.take(weights, idx)
+                    sel_x = jnp.take(data_x, idx, axis=0)
+                    sel_y = jnp.take(data_y, idx, axis=0)
+                    sel_c = jnp.take(counts, idx)
+                keys = jax.vmap(lambda i: rng.client_key(skey, i))(idx)
+                # each sampled client trains from ITS group's current model
+                my_model = pt.tree_take(group_vars, g_sel)
                 trained, metrics = jax.vmap(
                     lambda v, x, y, c, k: self._local_train(v, x, y, c, k, None)
-                )(my_model, data_x, data_y, counts, keys)
-                new_groups, _ = group_mean(trained, weights)
+                )(my_model, sel_x, sel_y, sel_c, keys)
+                # per-group sample-weighted mean over sampled members; a group
+                # with no sampled client keeps its current model
+                wsum = jax.ops.segment_sum(w_sel, g_sel, num_segments=G)
+
+                def red(leaf, old):
+                    wleaf = leaf.astype(jnp.float32) * w_sel.reshape((-1,) + (1,) * (leaf.ndim - 1))
+                    sgm = jax.ops.segment_sum(wleaf, g_sel, num_segments=G)
+                    mean = sgm / jnp.maximum(wsum, 1e-12).reshape((-1,) + (1,) * (sgm.ndim - 1))
+                    keep = (wsum > 0).reshape((-1,) + (1,) * (sgm.ndim - 1))
+                    return jnp.where(keep, mean, old.astype(jnp.float32)).astype(old.dtype)
+
+                new_groups = jax.tree_util.tree_map(red, trained, group_vars)
                 return new_groups, metrics
 
             group_vars, metrics = jax.lax.scan(sub_round, group_vars, jnp.arange(sub_rounds))

@@ -72,10 +72,10 @@ def unmask_sum(masked: dict[int, np.ndarray], self_seeds: dict[int, int],
         total = (total - pairwise_mask(b, d, p)) % p
     for (i, j), s in dropped_pair_seeds.items():
         m = pairwise_mask(s, d, p)
-        # dropped client i had added +m toward peers j>i, -m toward j<i;
-        # survivors j carry the complementary term: subtract its net effect
+        # survivor j's masked input carries the uncancelled half of the (i, j)
+        # pair mask: for j > i it added -m (peer i < j), for j < i it added +m
         if j > i:
-            total = (total - m) % p
-        else:
             total = (total + m) % p
+        else:
+            total = (total - m) % p
     return total

@@ -37,6 +37,39 @@ def test_decentralized_pushsum(eight_devices):
     assert accs[-1] > 0.3, accs
 
 
+def test_pushsum_mixing_recovers_uniform_average():
+    """Pure PushSum iteration on a directed (column-stochastic) topology must
+    converge to the UNIFORM average of the initial values — regression for the
+    row-stochastic matrix that degenerated to a stationary-weighted consensus."""
+    from fedml_tpu.parallel import topology as topo
+
+    n = 6
+    W = topo.column_stochastic(topo.asymmetric_topology(n, 2, seed=3))
+    np.testing.assert_allclose(W.sum(axis=0), np.ones(n), atol=1e-6)
+    x = np.arange(1.0, n + 1.0)  # distinct initial values
+    w = np.ones(n)
+    for _ in range(200):
+        x = W @ x
+        w = W @ w
+    ratio = x / w
+    np.testing.assert_allclose(ratio, np.full(n, np.mean(np.arange(1.0, n + 1.0))), atol=1e-5)
+
+
+def test_hierarchical_respects_client_num_per_round(eight_devices):
+    """client_num_per_round < n must still learn (sampled sub-rounds) and the
+    sampled trajectory must differ from full participation (regression:
+    client_num_per_round used to be silently ignored)."""
+    kw = dict(federated_optimizer="HierarchicalFL", comm_round=4, group_num=2,
+              group_comm_round=2, learning_rate=0.3, frequency_of_the_test=4)
+    h_sampled = _run(**kw, client_num_per_round=4)
+    accs = [m["test_acc"] for m in h_sampled if "test_acc" in m]
+    assert accs[-1] > 0.3, accs
+    h_full = _run(**kw, client_num_per_round=8)
+    sampled_losses = [m["train_loss"] for m in h_sampled]
+    full_losses = [m["train_loss"] for m in h_full]
+    assert sampled_losses != full_losses, "sampling had no effect on trajectory"
+
+
 def test_hierarchical_fl(eight_devices):
     h = _run(federated_optimizer="HierarchicalFL", comm_round=4, group_num=2,
              group_comm_round=2, learning_rate=0.3, frequency_of_the_test=2)

@@ -195,6 +195,46 @@ def test_shamir_roundtrip():
     assert shamir_reconstruct(shares[:2]) != secret
 
 
+def test_shamir_pairwise_mask_dropout_roundtrip():
+    """Full SecAgg masking equation with a dropped client: the recovered sum
+    must equal the survivors' plain field sum (regression for the unmask sign
+    inversion on dropped clients' pairwise masks)."""
+    from fedml_tpu.trust.secagg.field import DEFAULT_PRIME
+    from fedml_tpu.trust.secagg.shamir import masked_input, unmask_sum
+
+    p = DEFAULT_PRIME
+    rng = np.random.RandomState(7)
+    n, d = 4, 12
+    xs = {i: rng.randint(0, 1000, size=d).astype(np.int64) for i in range(n)}
+    self_seeds = {i: int(rng.randint(1, 2**30)) for i in range(n)}
+    pair_seeds = {}
+    for i in range(n):
+        for j in range(i + 1, n):
+            pair_seeds[(i, j)] = int(rng.randint(1, 2**30))
+
+    def peer_seeds_of(i):
+        return {j: pair_seeds[(min(i, j), max(i, j))] for j in range(n) if j != i}
+
+    masked = {i: masked_input(xs[i], i, peer_seeds_of(i), self_seeds[i]) for i in range(n)}
+
+    # no dropout: all pairwise masks cancel; only self-masks removed
+    full = unmask_sum(masked, self_seeds, {})
+    np.testing.assert_array_equal(full, sum(xs.values()) % p)
+
+    # client 1 drops AFTER peers computed their masked inputs: server removes
+    # survivors' self-masks and reconstructs client 1's pairwise seeds
+    for dropped in range(n):
+        survivors = {i: masked[i] for i in range(n) if i != dropped}
+        surv_self = {i: self_seeds[i] for i in survivors}
+        dropped_pairs = {
+            (dropped, j): pair_seeds[(min(dropped, j), max(dropped, j))]
+            for j in survivors
+        }
+        got = unmask_sum(survivors, surv_self, dropped_pairs)
+        expected = sum(xs[i] for i in survivors) % p
+        np.testing.assert_array_equal(got, expected)
+
+
 def test_lightsecagg_with_dropout():
     from fedml_tpu.trust.secagg.field import dequantize_from_field, quantize_to_field
     from fedml_tpu.trust.secagg.lightsecagg import LightSecAggProtocol, secure_aggregate
