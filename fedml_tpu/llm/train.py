@@ -81,7 +81,18 @@ class LLMTrainer:
         self.opt_state = jax.jit(self.opt.init)(self.params)
         self.data_sharding = sharding.batch_sharding(mesh, seq_axis=self.seq_axis)
         self.step_idx = 0
-        self._train_step = jax.jit(self._make_train_step(), donate_argnums=(0, 1))
+        # Pin output shardings to the input shardings: with donation and
+        # unspecified out_shardings, XLA may pick different layouts for the
+        # step's outputs, and the SECOND call then recompiles against the new
+        # input layouts (a silent ~80 s hit on real chips).
+        opt_shardings = jax.tree_util.tree_map(lambda x: x.sharding, self.opt_state)
+        scalar_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        self._train_step = jax.jit(
+            self._make_train_step(),
+            donate_argnums=(0, 1),
+            out_shardings=(self.param_shardings, opt_shardings,
+                           {"loss": scalar_sh, "ppl": scalar_sh}),
+        )
 
     def _make_train_step(self):
         model = self.model
@@ -125,17 +136,30 @@ class LLMTrainer:
             history.append(m)
         return history
 
+    def n_params(self) -> int:
+        return sum(x.size for x in jax.tree_util.tree_leaves(self.params))
+
     def token_throughput(self, steps: int = 5) -> float:
-        """tokens/sec on synthetic data (bench helper)."""
+        """tokens/sec on synthetic data (bench helper).
+
+        Two warmup steps (first compile + any layout settle), then ``steps``
+        back-to-back device steps with a single host sync at the end — the
+        per-step host round trip would otherwise dominate on tunneled chips.
+        """
         a = self.args
         key = jax.random.PRNGKey(0)
         tokens = jax.random.randint(key, (a.batch_size, a.seq_len), 0, self.cfg.vocab_size)
         targets = jnp.roll(tokens, -1, axis=1)
-        self.step(tokens, targets)  # compile
-        jax.block_until_ready(jax.tree_util.tree_leaves(self.params)[0])
+        tokens = jax.device_put(tokens, self.data_sharding)
+        targets = jax.device_put(targets, self.data_sharding)
+        params, opt_state = self.params, self.opt_state
+        for _ in range(2):  # warmup: compile + layout settle
+            params, opt_state, m = self._train_step(params, opt_state, tokens, targets)
+            float(m["loss"])
         t0 = time.perf_counter()
         for _ in range(steps):
-            self.step(tokens, targets)
-        jax.block_until_ready(jax.tree_util.tree_leaves(self.params)[0])
+            params, opt_state, m = self._train_step(params, opt_state, tokens, targets)
+        float(m["loss"])  # host sync
         dt = time.perf_counter() - t0
+        self.params, self.opt_state = params, opt_state
         return a.batch_size * a.seq_len * steps / dt

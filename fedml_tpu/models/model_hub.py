@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax.numpy as jnp
+
 from ..arguments import Config
 from . import resnet, rnn, simple
 
@@ -17,6 +19,9 @@ from . import resnet, rnn, simple
 def create(cfg: Config, output_dim: int) -> Any:
     name = cfg.model.lower()
     norm = getattr(cfg, "norm", "batch")
+    # compute dtype threads into the conv/matmul path (params stay f32);
+    # without this the whole CNN zoo silently runs f32 on the MXU's slow path
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     if name in ("lr", "logistic_regression"):
         return simple.LogisticRegression(num_classes=output_dim)
     if name in ("cnn", "cnn_dropout"):
@@ -27,16 +32,16 @@ def create(cfg: Config, output_dim: int) -> Any:
     if name == "mlp":
         return simple.MLP(num_classes=output_dim)
     if name == "resnet20":
-        return resnet.resnet20(output_dim, norm)
+        return resnet.resnet20(output_dim, norm, dtype)
     if name == "resnet32":
-        return resnet.resnet32(output_dim, norm)
+        return resnet.resnet32(output_dim, norm, dtype)
     if name == "resnet44":
-        return resnet.resnet44(output_dim, norm)
+        return resnet.resnet44(output_dim, norm, dtype)
     if name == "resnet56":
-        return resnet.resnet56(output_dim, norm)
+        return resnet.resnet56(output_dim, norm, dtype)
     if name in ("resnet18_gn", "resnet_gn"):
         # BN-free escape hatch (reference model/cv/resnet_gn.py)
-        return resnet.resnet20(output_dim, "group")
+        return resnet.resnet20(output_dim, "group", dtype)
     if name in ("rnn", "char_lstm", "rnn_originalfedavg"):
         return rnn.CharLSTM(vocab_size=output_dim)
     if name in ("rnn_stackoverflow", "word_lstm"):

@@ -27,15 +27,16 @@ class BasicBlock(nn.Module):
     filters: int
     stride: int = 1
     norm: str = "batch"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         residual = x
-        y = nn.Conv(self.filters, (3, 3), strides=(self.stride, self.stride), padding="SAME", use_bias=False)(x)
-        y = _norm_layer(self.norm, train)(y)
+        y = nn.Conv(self.filters, (3, 3), strides=(self.stride, self.stride), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = _norm_layer(self.norm, train, self.dtype)(y)
         y = nn.relu(y)
-        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
-        y = _norm_layer(self.norm, train)(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = _norm_layer(self.norm, train, self.dtype)(y)
         if residual.shape != y.shape:
             # Option-A shortcut (parameter-free, as in the reference's
             # LambdaLayer pad shortcut): stride-subsample + zero-pad channels.
@@ -45,10 +46,10 @@ class BasicBlock(nn.Module):
         return nn.relu(y + residual)
 
 
-def _norm_layer(norm: str, train: bool):
+def _norm_layer(norm: str, train: bool, dtype=jnp.float32):
     if norm == "group":
-        return nn.GroupNorm(num_groups=2)
-    return nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5)
+        return nn.GroupNorm(num_groups=2, dtype=dtype)
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5, dtype=dtype)
 
 
 class CifarResNet(nn.Module):
@@ -57,35 +58,37 @@ class CifarResNet(nn.Module):
     num_blocks: int  # n per stage
     num_classes: int = 10
     norm: str = "batch"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
-        x = _norm_layer(self.norm, train)(x)
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = _norm_layer(self.norm, train, self.dtype)(x)
         x = nn.relu(x)
         for stage, filters in enumerate((16, 32, 64)):
             for block in range(self.num_blocks):
                 stride = 2 if (stage > 0 and block == 0) else 1
-                x = BasicBlock(filters, stride, self.norm)(x, train=train)
+                x = BasicBlock(filters, stride, self.norm, self.dtype)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
-        x = nn.Dense(self.num_classes)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
         return x
 
 
-def resnet20(num_classes: int = 10, norm: str = "batch") -> CifarResNet:
-    return CifarResNet(num_blocks=3, num_classes=num_classes, norm=norm)
+def resnet20(num_classes: int = 10, norm: str = "batch", dtype=jnp.float32) -> CifarResNet:
+    return CifarResNet(num_blocks=3, num_classes=num_classes, norm=norm, dtype=dtype)
 
 
-def resnet32(num_classes: int = 10, norm: str = "batch") -> CifarResNet:
-    return CifarResNet(num_blocks=5, num_classes=num_classes, norm=norm)
+def resnet32(num_classes: int = 10, norm: str = "batch", dtype=jnp.float32) -> CifarResNet:
+    return CifarResNet(num_blocks=5, num_classes=num_classes, norm=norm, dtype=dtype)
 
 
-def resnet44(num_classes: int = 10, norm: str = "batch") -> CifarResNet:
-    return CifarResNet(num_blocks=7, num_classes=num_classes, norm=norm)
+def resnet44(num_classes: int = 10, norm: str = "batch", dtype=jnp.float32) -> CifarResNet:
+    return CifarResNet(num_blocks=7, num_classes=num_classes, norm=norm, dtype=dtype)
 
 
-def resnet56(num_classes: int = 10, norm: str = "batch") -> CifarResNet:
-    return CifarResNet(num_blocks=9, num_classes=num_classes, norm=norm)
+def resnet56(num_classes: int = 10, norm: str = "batch", dtype=jnp.float32) -> CifarResNet:
+    return CifarResNet(num_blocks=9, num_classes=num_classes, norm=norm, dtype=dtype)
 
 
 class SplitResNet56Client(nn.Module):

@@ -32,6 +32,13 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True  # jax.checkpoint each block (HBM <-> FLOPs trade)
+    # remat policy: "full" recomputes everything in bwd; "dots" saves matmul
+    # outputs and recomputes only cheap elementwise/norm ops (much less
+    # recompute FLOPs for a modest HBM cost — the right default for MFU)
+    remat_policy: str = "dots"
+    # lm_head matmul dtype; bf16 keeps the (tokens, vocab) projection on the
+    # MXU fast path (loss still upcasts logits to f32 for the softmax)
+    logits_dtype: Any = jnp.bfloat16
 
     @classmethod
     def tiny(cls, vocab_size: int = 1024):
@@ -146,9 +153,13 @@ class Transformer(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed")(tokens)
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
+            policy = (
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                if cfg.remat_policy == "dots" else None
+            )
+            block = nn.remat(Block, static_argnums=(), policy=policy)
         for i in range(cfg.n_layers):
             x = block(cfg, self.mesh, self.seq_axis, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.logits_dtype, name="lm_head")(x)
         return logits

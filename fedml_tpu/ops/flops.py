@@ -1,0 +1,81 @@
+"""Analytical FLOPs accounting + device peak lookup for MFU reporting.
+
+MFU = (model FLOPs per second) / (chip peak FLOPs): the *nominal* FLOPs of the
+training computation (fwd + bwd = 3x fwd for matmul-dominated nets), NOT the
+executed FLOPs — rematerialization recompute does not count as useful work.
+This is the PaLM-appendix convention the scaling literature uses; XLA's
+``cost_analysis()['flops']`` (executed work, including remat) is reported
+separately where available.
+
+The reference has no MFU accounting anywhere (its perf story is wall-clock CI
+budgets, SURVEY.md §6); BASELINE.md sets >=35% MFU as the target, so the
+accounting itself is a new obligation of the TPU build.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# bf16 peak FLOPs per chip by device_kind substring (first match wins).
+# Sources: public TPU spec sheets (v4 275, v5e 197, v5p 459, v6e 918 TFLOPS).
+_PEAK_TABLE = [
+    ("v6 lite", 918e12),
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Peak bf16 FLOPs/s of one chip, or None when unknown (e.g. CPU)."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    if getattr(device, "platform", "") not in ("tpu", "axon"):
+        return None
+    for needle, peak in _PEAK_TABLE:
+        if needle in kind:
+            return peak
+    return None
+
+
+def transformer_train_flops_per_token(
+    n_params: int, n_embed_params: int, n_layers: int, d_model: int, seq_len: int
+) -> float:
+    """Nominal train FLOPs per token: 6*(matmul params) + attention term.
+
+    ``n_embed_params`` (the gather-only embedding table) is excluded from the
+    6N term; the lm_head projection participates in matmuls and stays in.
+    The attention score/value matmuls add 12 * L * s * d (fwd 4*s*d per layer,
+    x3 for fwd+bwd; counted un-halved since the dense kernel computes the full
+    s^2 score matrix).
+    """
+    return 6.0 * (n_params - n_embed_params) + 12.0 * n_layers * seq_len * d_model
+
+
+def resnet20_cifar_train_flops_per_sample() -> float:
+    """ResNet-20 CIFAR-10 at 32x32: ~40.8M MACs fwd => 81.7 MFLOPs fwd,
+    x3 for fwd+bwd.  (Conv MACs from the standard He et al. arch: 3 stages x
+    3 blocks x 2 convs at 16/32/64 channels + stem + fc.)"""
+    fwd = 81.7e6
+    return 3.0 * fwd
+
+
+def xla_cost_flops(jitted_fn, *args) -> Optional[float]:
+    """Executed-FLOPs estimate from XLA's own cost model for a lowered+compiled
+    function; None when the backend doesn't expose it."""
+    try:
+        compiled = jitted_fn.lower(*args).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        return float(analysis.get("flops"))
+    except Exception:
+        return None

@@ -124,6 +124,10 @@ class MeshSimulator:
     # ------------------------------------------------------------------
     def _place_data(self, stacked: StackedClientData):
         x = jnp.asarray(stacked.x)
+        if self.hp.compute_dtype == "bfloat16" and jnp.issubdtype(x.dtype, jnp.floating):
+            # store device-resident shards in the compute dtype: halves HBM
+            # footprint AND the per-round sampled-client gather traffic
+            x = x.astype(jnp.bfloat16)
         y = jnp.asarray(stacked.y)
         if self.backend == C.SIMULATION_BACKEND_SP:
             return (x, y)
