@@ -116,9 +116,12 @@ def bench_llm(peak):
     flops_tok = flopslib.transformer_train_flops_per_token(
         n_params, n_embed, tcfg.n_layers, tcfg.d_model, args.seq_len
     )
-    mfu = (tps * flops_tok / peak) if peak else None
+    # token_throughput is GLOBAL tokens/s over the whole mesh; MFU must be
+    # per-chip throughput over one chip's peak
+    tps_chip = tps / len(jax.devices())
+    mfu = (tps_chip * flops_tok / peak) if peak else None
     return {
-        "tokens_per_sec_chip": round(tps / len(jax.devices()), 1),
+        "tokens_per_sec_chip": round(tps_chip, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "n_params_m": round(n_params / 1e6, 1),
         "seq_len": args.seq_len,
@@ -133,8 +136,11 @@ def _run_one(mode):
 
     from fedml_tpu.ops import flops as flopslib
 
-    peak = flopslib.device_peak_flops(jax.devices()[0])
+    dev = jax.devices()[0]
+    peak = flopslib.device_peak_flops(dev)
     result = bench_llm(peak) if mode == "llm" else bench_fedavg(peak)
+    result["device"] = str(getattr(dev, "device_kind", dev.platform))
+    result["chip_peak_tflops"] = round(peak / 1e12, 1) if peak else None
     print("BENCH_RESULT " + json.dumps(result))
 
 
@@ -163,14 +169,9 @@ def main():
     if os.environ.get("BENCH_MODE"):
         _run_one(os.environ["BENCH_MODE"])
         return
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    import jax
-
-    from fedml_tpu.ops import flops as flopslib
-
-    dev = jax.devices()[0]
-    peak = flopslib.device_peak_flops(dev)
-
+    # The parent must NOT import jax: initializing the TPU runtime here would
+    # hold the process-exclusive device lock and starve both child benches.
+    # Device identity/peak come back in the children's results.
     llm = _subprocess_bench("llm")
     fedavg = _subprocess_bench("fedavg")
 
@@ -182,8 +183,8 @@ def main():
         "unit": "MFU" if mfu is not None else "tokens/s/chip (MFU n/a off-TPU)",
         "vs_baseline": round(mfu / target, 3) if mfu is not None else 1.0,
         "detail": {
-            "device": str(getattr(dev, "device_kind", dev.platform)),
-            "chip_peak_tflops": round(peak / 1e12, 1) if peak else None,
+            "device": llm.get("device"),
+            "chip_peak_tflops": llm.get("chip_peak_tflops"),
             "llm": llm,
             "fedavg_cifar10_resnet20": fedavg,
         },

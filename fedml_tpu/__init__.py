@@ -33,6 +33,26 @@ def init(args: Optional[Config] = None, argv=None) -> Config:
         level=logging.INFO,
         format="[fedml_tpu] %(asctime)s %(levelname)s %(message)s",
     )
+    # MULTIPROCESS/MPI backend: bring up jax.distributed before any backend
+    # use so the mesh spans all hosts (reference: MPI rank discovery in
+    # fedml.init; here the coordination service replaces mpi4py).
+    from .parallel import multihost
+
+    requested = getattr(cfg, "backend_sim", "") in (
+        "MULTIPROCESS", constants.SIMULATION_BACKEND_MPI,
+    )
+    if requested or (getattr(cfg, "extra", {}) or {}).get("coordinator_address"):
+        up = multihost.ensure_initialized(cfg)
+        if requested and not up:
+            # an explicitly requested multi-process backend must never
+            # silently degrade to single-process (the other hosts would block
+            # forever in the coordination barrier)
+            raise ValueError(
+                "backend_sim=MULTIPROCESS requires coordinator config: set "
+                "cfg.extra coordinator_address/num_processes/process_id or "
+                "the JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID "
+                "environment variables on every host"
+            )
     return cfg
 
 

@@ -54,13 +54,28 @@ class _CrossSiloRunner:
 
     def run(self):
         cfg = self.cfg
+        secagg = bool(getattr(cfg, "enable_secagg", False))
         if cfg.role == "server" and cfg.backend in ("INPROC", "MESH", ""):
             # single-process orchestration (tests / local runs)
+            if secagg:
+                from .lightsecagg import run_lightsecagg_process_group
+
+                history, _ = run_lightsecagg_process_group(cfg, self.dataset, self.model)
+                return history
             return run_in_process_group(cfg, self.dataset, self.model)
         if cfg.role == "server":
+            if secagg:
+                from .lightsecagg import build_lsa_server
+
+                return build_lsa_server(cfg, self.dataset, self.model).run_until_done()
             server = build_server(cfg, self.dataset, self.model)
             return server.run_until_done()
-        client = build_client(cfg, self.dataset, self.model, rank=int(cfg.rank))
+        if secagg:
+            from .lightsecagg import build_lsa_client
+
+            client = build_lsa_client(cfg, self.dataset, self.model, rank=int(cfg.rank))
+        else:
+            client = build_client(cfg, self.dataset, self.model, rank=int(cfg.rank))
         thread = client.run_in_thread()
         client.done.wait()
         thread.join(timeout=5.0)

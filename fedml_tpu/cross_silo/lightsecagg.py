@@ -1,0 +1,406 @@
+"""LightSecAgg cross-silo protocol — masked aggregation over the wire.
+
+Parity with ``cross_silo/lightsecagg/lsa_fedml_server_manager.py:15`` /
+``lsa_fedml_client_manager.py:21`` / ``lsa_fedml_aggregator.py:19`` (~1.7k
+LoC in the reference).  The message flow (reference ``lsa_message_define.py``
+docstring) is:
+
+    INIT(global)                                  server -> all clients
+    ENCODED_MASK share for peer j                 client i -> server -> j
+    --- all N shares held: client trains ---
+    masked model  (field vector + z_i)            client -> server
+    ACTIVE_CLIENTS(first-round survivors)         server -> survivors
+    aggregate encoded mask over survivors         client -> server
+    --- >= U aggregates held: server decodes sum-of-masks, unmasks ---
+    SYNC(new global)                              server -> clients
+
+The server only ever sees ``quantize(x_i) + z_i  (mod p)`` — individual
+updates never appear unmasked; the sum of masks is reconstructed in ONE shot
+from any U survivors' Lagrange-coded aggregates (``trust/secagg/lightsecagg``,
+the math mirror of reference ``core/mpc/lightsecagg.py``).
+
+Design notes (TPU-world divergences, all documented):
+- Message-type integers extend this repo's ``message_define`` numbering
+  (10-13) instead of reusing the reference's overlapping LSA numbering —
+  one flat protocol namespace so a single comm manager can serve both.
+- The reference averages uniformly (``lsa_fedml_aggregator.py:164``:
+  ``w = 1/len(active_clients)``) because sample-weighted sums would leak
+  weights; we keep that semantic.
+- Masks are drawn fresh per round from the client's seeded field RNG; the
+  Lagrange encode/decode is int64 modular matmul (exact, no MXU needed —
+  bandwidth-bound host math, SURVEY.md §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+from typing import Optional
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm.message import Message
+from ..trust.secagg.field import DEFAULT_PRIME, dequantize_from_field, quantize_to_field
+from ..trust.secagg.lightsecagg import LightSecAggProtocol
+from . import message_define as md
+from .client import ClientMasterManager, FedMLTrainer
+from .server import FedMLAggregator, FedMLServerManager
+
+log = logging.getLogger("fedml_tpu.cross_silo.lightsecagg")
+
+# protocol constants — extend the flat cross-silo namespace (0-8 in
+# message_define.py); reference uses its own overlapping numbering
+MSG_TYPE_C2S_SEND_ENCODED_MASK = 10   # ref MSG_TYPE_C2S_SEND_ENCODED_MASK_TO_SERVER = 5
+MSG_TYPE_S2C_ENCODED_MASK = 11        # ref MSG_TYPE_S2C_ENCODED_MASK_TO_CLIENT = 2
+MSG_TYPE_S2C_ACTIVE_CLIENTS = 12      # ref MSG_TYPE_S2C_SEND_TO_ACTIVE_CLIENT = 4
+MSG_TYPE_C2S_SEND_AGG_MASK = 13       # ref MSG_TYPE_C2S_SEND_MASK_TO_SERVER = 7
+
+MSG_ARG_KEY_ENCODED_MASK = "encoded_mask"
+MSG_ARG_KEY_AGG_ENCODED_MASK = "aggregate_encoded_mask"
+MSG_ARG_KEY_MASK_SOURCE = "client_id"
+MSG_ARG_KEY_ACTIVE_CLIENTS = "active_clients"
+
+
+def secagg_params(cfg):
+    """(T, U, q_bits) from config — defaults follow the reference
+    (``lsa_fedml_aggregator.py:60``: T = floor(N/2); U = T + 1 is the
+    minimum reconstruction threshold)."""
+    n = cfg.client_num_in_total
+    extra = getattr(cfg, "extra", {}) or {}
+    t = int(extra.get("secagg_privacy_t", max(1, n // 2)))
+    u = int(extra.get("secagg_target_u", t + 1))
+    q_bits = int(extra.get("secagg_q_bits", 16))
+    if not (0 < t < u <= n):
+        raise ValueError(f"LightSecAgg needs 0 < T({t}) < U({u}) <= N({n})")
+    # trust features that inspect or transform individual updates cannot run
+    # on masked field vectors — refuse loudly instead of silently no-opping
+    # (the contract stated in runner._check_unimplemented_flags)
+    incompatible = [
+        f for f in ("enable_attack", "enable_defense", "enable_dp", "enable_contribution")
+        if getattr(cfg, f, False)
+    ]
+    if incompatible:
+        raise NotImplementedError(
+            f"trust features {incompatible} operate on individual client "
+            "updates, which LightSecAgg hides from the server by design; "
+            "disable them or disable enable_secagg"
+        )
+    if getattr(cfg, "federated_optimizer", "FedAvg") not in ("FedAvg", "fedavg", "FedAvg_seq"):
+        raise NotImplementedError(
+            "LightSecAgg reconstruction yields only the uniform mean of the "
+            "survivors' updates (reference lsa_fedml_aggregator.py:164); "
+            f"server optimizer {cfg.federated_optimizer!r} needs per-client "
+            "updates — use FedAvg with enable_secagg"
+        )
+    return t, u, q_bits
+
+
+class LSAAggregator(FedMLAggregator):
+    """Server-side LightSecAgg state: masked field vectors instead of
+    plaintext models; reconstruction replaces plaintext aggregation."""
+
+    def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
+        super().__init__(cfg, model, sample_x, test_arrays, trust=trust)
+        t, u, self.q_bits = secagg_params(cfg)
+        self.protocol = LightSecAggProtocol(cfg.client_num_in_total, t, u)
+        flat, self._unravel = jax.flatten_util.ravel_pytree(self.global_vars)
+        self.model_dim = int(flat.size)
+        self.d_pad = self.protocol.pad_len(self.model_dim)
+        self.agg_mask_dict: dict[int, np.ndarray] = {}
+
+    def add_local_trained_result(self, client_idx: int, masked_vec, sample_num: float) -> None:
+        vec = np.asarray(masked_vec, dtype=np.int64)
+        if vec.shape != (self.d_pad,):
+            raise ValueError(f"masked vector shape {vec.shape} != ({self.d_pad},)")
+        super().add_local_trained_result(client_idx, vec, sample_num)
+
+    def add_aggregate_encoded_mask(self, client_idx: int, agg_mask) -> None:
+        self.agg_mask_dict[client_idx] = np.asarray(agg_mask, dtype=np.int64)
+
+    def mask_count(self) -> int:
+        return len(self.agg_mask_dict)
+
+    def aggregate(self, round_idx: int):
+        """Reference ``aggregate_model_reconstruction`` (:132): field-sum the
+        survivors' masked vectors, decode the sum of their masks from the
+        aggregate encoded masks, subtract, dequantize, uniform-average."""
+        active = sorted(self.model_dict.keys())
+        p = self.protocol.p
+        total = np.zeros(self.d_pad, dtype=np.int64)
+        for i in active:
+            total = (total + self.model_dict[i]) % p
+        # aggregate encoded masks are indexed by 0-based client index
+        agg_shares = {cid - 1: v for cid, v in self.agg_mask_dict.items()}
+        mask_sum = self.protocol.decode_aggregate_mask(agg_shares, self.d_pad)
+        unmasked = (total - mask_sum) % p
+        avg = dequantize_from_field(unmasked[: self.model_dim], len(active), bits=self.q_bits)
+        avg = avg / max(len(active), 1)
+        self.global_vars = self._unravel(jnp.asarray(avg, jnp.float32))
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        self.flag_client_model_uploaded.clear()
+        self.agg_mask_dict.clear()
+        return self.global_vars
+
+
+class LSAServerManager(FedMLServerManager):
+    """Reference ``LightSecAggServerManager``: relays encoded-mask shares,
+    collects masked models, asks first-round survivors for aggregate masks,
+    reconstructs when >= U arrive."""
+
+    def __init__(self, cfg, aggregator: LSAAggregator, backend: Optional[str] = None, logger=None):
+        super().__init__(cfg, aggregator, backend=backend, logger=logger)
+        if self.per_round != len(self.client_ids):
+            raise ValueError(
+                "LightSecAgg requires full participation per round "
+                f"(client_num_per_round={self.per_round} != N={len(self.client_ids)}); "
+                "the mask-share topology is over all N clients"
+            )
+        self.active_first: list[int] = []
+        self._phase = "model"  # model -> mask
+
+    def register_message_receive_handlers(self) -> None:
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(MSG_TYPE_C2S_SEND_ENCODED_MASK, self.handle_message_encoded_mask)
+        self.register_message_receive_handler(MSG_TYPE_C2S_SEND_AGG_MASK, self.handle_message_agg_mask)
+
+    def handle_message_encoded_mask(self, msg: Message) -> None:
+        """Relay a mask share from its source client to its destination
+        (reference ``handle_message_receive_encoded_mask_from_client`` :131)."""
+        dest = int(msg.get(md.MSG_ARG_KEY_CLIENT_INDEX))
+        relay = Message(MSG_TYPE_S2C_ENCODED_MASK, 0, dest)
+        relay.add_params(MSG_ARG_KEY_ENCODED_MASK, msg.get(MSG_ARG_KEY_ENCODED_MASK))
+        relay.add_params(MSG_ARG_KEY_MASK_SOURCE, msg.get_sender_id())
+        relay.add_params(md.MSG_ARG_KEY_ROUND_INDEX, msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
+        self.send_message(relay)
+
+    def handle_message_receive_model(self, msg: Message) -> None:
+        with self._agg_lock:
+            if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) != self.round_idx or self._phase != "model":
+                return
+            self.aggregator.add_local_trained_result(
+                msg.get_sender_id(),
+                msg.get(md.MSG_ARG_KEY_MODEL_PARAMS),
+                float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES)),
+            )
+            if self.aggregator.check_whether_all_receive(len(self.selected)):
+                self._request_aggregate_masks()
+
+    def _request_aggregate_masks(self) -> None:
+        """All (or quorum of) masked models in: freeze the first-round active
+        set and ask those survivors for their aggregate encoded masks
+        (reference ``send_message_to_active_client`` :277). Caller holds
+        _agg_lock."""
+        if self._round_timer is not None:
+            self._round_timer.cancel()
+        self._phase = "mask"
+        self.active_first = sorted(self.aggregator.model_dict.keys())
+        for cid in self.active_first:
+            msg = Message(MSG_TYPE_S2C_ACTIVE_CLIENTS, 0, cid)
+            msg.add_params(MSG_ARG_KEY_ACTIVE_CLIENTS, [int(c) for c in self.active_first])
+            msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            self.send_message(msg)
+        self._arm_straggler_timer()
+
+    def handle_message_agg_mask(self, msg: Message) -> None:
+        with self._agg_lock:
+            if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) != self.round_idx or self._phase != "mask":
+                return
+            self.aggregator.add_aggregate_encoded_mask(
+                msg.get_sender_id(), msg.get(MSG_ARG_KEY_AGG_ENCODED_MASK)
+            )
+            if self.aggregator.mask_count() >= len(self.active_first):
+                self._phase = "model"
+                self._finish_round()
+
+    def _on_straggler_timeout(self) -> None:
+        """Bounded-wait in both phases: model phase advances with a quorum of
+        masked models; mask phase reconstructs as soon as >= U aggregates
+        arrived (U is the hard decode threshold)."""
+        with self._agg_lock:
+            if self._phase == "model":
+                need = max(
+                    self.aggregator.protocol.u,
+                    int(math.ceil(self.quorum_frac * len(self.selected))),
+                )
+                if self.aggregator.received_count() >= need:
+                    log.warning(
+                        "round %d: straggler timeout, proceeding with %d/%d masked models",
+                        self.round_idx, self.aggregator.received_count(), len(self.selected),
+                    )
+                    self._request_aggregate_masks()
+                    return
+            else:
+                if self.aggregator.mask_count() >= self.aggregator.protocol.u:
+                    log.warning(
+                        "round %d: mask-phase timeout, decoding from %d/%d aggregates",
+                        self.round_idx, self.aggregator.mask_count(), len(self.active_first),
+                    )
+                    self._phase = "model"
+                    self._finish_round()
+                    return
+            self._arm_straggler_timer()
+
+
+class LSAClientManager(ClientMasterManager):
+    """Reference ``LightSecAggClientManager``: offline mask exchange, then
+    train, then upload ``quantize(x) + z (mod p)``."""
+
+    def __init__(self, cfg, trainer: FedMLTrainer, rank: int, backend: Optional[str] = None):
+        super().__init__(cfg, trainer, rank=rank, backend=backend)
+        t, u, self.q_bits = secagg_params(cfg)
+        self.n = cfg.client_num_in_total
+        # Masks MUST come from OS entropy, never from the shared run config:
+        # a seed derivable from cfg lets the server replay the RNG stream and
+        # unmask individual updates, defeating the protocol.  256 bits so the
+        # seed space cannot be brute-forced (a 32-bit seed would be
+        # enumerable: regenerate z, subtract, keep the candidate that looks
+        # like a model update).  The masks cancel exactly in the aggregate,
+        # so non-determinism never affects results.
+        self.protocol = LightSecAggProtocol(
+            self.n, t, u, seed=int.from_bytes(os.urandom(32), "little")
+        )
+        self.encoded_mask_dict: dict[int, np.ndarray] = {}
+        self._early_shares: dict[tuple[int, int], np.ndarray] = {}  # (round, src)
+        self._share_round = -1
+        self._mask: Optional[np.ndarray] = None
+        self._pending_msg: Optional[Message] = None
+        self._lock = threading.Lock()
+
+    def register_message_receive_handlers(self) -> None:
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(MSG_TYPE_S2C_ENCODED_MASK, self.handle_message_encoded_mask)
+        self.register_message_receive_handler(MSG_TYPE_S2C_ACTIVE_CLIENTS, self.handle_message_active_clients)
+
+    # -- phase 1: offline mask exchange --------------------------------------
+    def _train_and_send(self, msg: Message) -> None:
+        """INIT/SYNC received: instead of training immediately (plaintext
+        path), enter the offline phase — draw z_i, Lagrange-encode, ship one
+        share per peer through the server (reference ``__offline`` :215)."""
+        round_idx = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
+        with self._lock:
+            self._pending_msg = msg
+            self._share_round = round_idx
+            self.encoded_mask_dict.clear()
+            # adopt shares that raced ahead of this INIT/SYNC (possible under
+            # reordering transports like MQTT); purge stale past-round shares
+            # so straggler-heavy long runs don't leak buffered vectors
+            for (r, src), v in list(self._early_shares.items()):
+                if r == round_idx:
+                    self.encoded_mask_dict[src] = v
+                    del self._early_shares[(r, src)]
+                elif r < round_idx:
+                    del self._early_shares[(r, src)]
+            params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
+            flat, _ = jax.flatten_util.ravel_pytree(params)
+            self._mask = self.protocol.gen_mask(int(flat.size))
+            encoded = self.protocol.encode_mask(self._mask)  # (N, s) row j -> peer j+1
+        for j in range(1, self.n + 1):
+            share = Message(MSG_TYPE_C2S_SEND_ENCODED_MASK, self.rank, 0)
+            share.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, j)  # destination rank
+            share.add_params(MSG_ARG_KEY_ENCODED_MASK, encoded[j - 1])
+            share.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+            self.send_message(share)
+
+    def handle_message_encoded_mask(self, msg: Message) -> None:
+        with self._lock:
+            src = int(msg.get(MSG_ARG_KEY_MASK_SOURCE))
+            share = np.asarray(msg.get(MSG_ARG_KEY_ENCODED_MASK), dtype=np.int64)
+            r = msg.get(md.MSG_ARG_KEY_ROUND_INDEX)
+            if r is not None and int(r) != self._share_round:
+                self._early_shares[(int(r), src)] = share
+                return
+            self.encoded_mask_dict[src] = share
+            ready = len(self.encoded_mask_dict) == self.n and self._pending_msg is not None
+        if ready:
+            self._train_masked()
+
+    # -- phase 2: train + masked upload --------------------------------------
+    def _train_masked(self) -> None:
+        with self._lock:
+            msg = self._pending_msg
+            self._pending_msg = None
+            mask = self._mask
+        if msg is None:
+            return
+        round_idx = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
+        params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
+        client_idx = int(msg.get(md.MSG_ARG_KEY_CLIENT_INDEX, self.rank - 1))
+        new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key, client_idx)
+        self.rounds_trained += 1
+        flat, _ = jax.flatten_util.ravel_pytree(new_vars)
+        field_vec = quantize_to_field(np.asarray(flat), bits=self.q_bits)
+        padded = np.zeros(self.protocol.pad_len(flat.size), dtype=np.int64)
+        padded[: flat.size] = field_vec
+        masked = (padded + mask) % self.protocol.p
+        reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, masked)
+        reply.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
+        reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+        self.send_message(reply)
+
+    # -- phase 3: one-shot aggregate mask ------------------------------------
+    def handle_message_active_clients(self, msg: Message) -> None:
+        """Sum the held encoded sub-masks of the surviving sources and send
+        ONE aggregate (reference ``handle_message_receive_active_from_server``
+        :132)."""
+        active = [int(c) for c in msg.get(MSG_ARG_KEY_ACTIVE_CLIENTS)]
+        with self._lock:
+            shares = [self.encoded_mask_dict[c] for c in active if c in self.encoded_mask_dict]
+        if len(shares) != len(active):
+            log.warning("client %d missing shares for active set %s", self.rank, active)
+            return
+        agg = LightSecAggProtocol.aggregate_encoded_masks(shares)
+        reply = Message(MSG_TYPE_C2S_SEND_AGG_MASK, self.rank, 0)
+        reply.add_params(MSG_ARG_KEY_AGG_ENCODED_MASK, agg)
+        reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX)))
+        self.send_message(reply)
+
+
+# -- builders (mirror cross_silo/__init__ plaintext builders) ----------------
+
+def build_lsa_server(cfg, dataset, model, backend: Optional[str] = None) -> LSAServerManager:
+    from ..data.dataset import pad_eval_set
+
+    eval_bs = min(256, max(32, cfg.test_batch_size))
+    test_arrays = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
+    aggregator = LSAAggregator(cfg, model, dataset.train_x[: cfg.batch_size], test_arrays)
+    return LSAServerManager(cfg, aggregator, backend=backend)
+
+
+def build_lsa_client(cfg, dataset, model, rank: int, backend: Optional[str] = None) -> LSAClientManager:
+    ix = dataset.client_idx[rank - 1]
+    trainer = FedMLTrainer(cfg, model, dataset.train_x[ix], dataset.train_y[ix])
+    return LSAClientManager(cfg, trainer, rank=rank, backend=backend)
+
+
+def run_lightsecagg_process_group(cfg, dataset, model, backend: str = "INPROC",
+                                  timeout: float = 600.0, drop_ranks: frozenset = frozenset()):
+    """1 server + N LSA clients on threads over the in-proc fabric.
+    ``drop_ranks`` simulates first-round dropouts: those clients complete the
+    mask exchange but never upload a model (the hard dropout case — their
+    masks are IN the other clients' share tables but their data is not in the
+    sum)."""
+    from ..comm.inproc import InProcRouter
+
+    InProcRouter.reset(str(getattr(cfg, "run_id", "0")))
+    clients = []
+    for r in range(1, cfg.client_num_in_total + 1):
+        c = build_lsa_client(cfg, dataset, model, rank=r, backend=backend)
+        if r in drop_ranks:
+            c._train_masked = lambda: None  # drops out before model upload
+        clients.append(c)
+    for c in clients:
+        c.run_in_thread()
+    server = build_lsa_server(cfg, dataset, model, backend=backend)
+    try:
+        history = server.run_until_done(timeout=timeout)
+    finally:
+        for c in clients:
+            c.finish()
+    return history, server

@@ -47,9 +47,15 @@ class FedMLAggregator:
 
     def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
         self.cfg = cfg
+        self._model = model
+        # provisional steps/epoch until real per-client sample counts arrive
+        # in the protocol (MSG_ARG_KEY_NUM_SAMPLES) — the config-derived guess
+        # only seeds round 0's server-side schedule; _calibrate_schedule
+        # replaces it with the protocol truth at first aggregation
         spe = max(1, math.ceil(getattr(cfg, "synthetic_train_size", 1024) / max(cfg.client_num_in_total, 1) / cfg.batch_size))
         self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
         self.algorithm = create_algorithm(cfg, self.hp).build(model)
+        self._schedule_calibrated = False
         k0 = rng.root_key(cfg.random_seed)
         self.global_vars = model.init(
             {"params": jax.random.fold_in(k0, 1), "dropout": jax.random.fold_in(k0, 2)},
@@ -76,7 +82,30 @@ class FedMLAggregator:
     def check_whether_all_receive(self, expected: int) -> bool:
         return self.received_count() >= expected
 
+    def _calibrate_schedule(self) -> None:
+        """Rebuild the server-side algorithm schedule from the ACTUAL sample
+        counts the clients reported in the protocol (the reference servers
+        receive them the same way); runs once, at first aggregation."""
+        if self._schedule_calibrated or not self.sample_num_dict:
+            return
+        self._schedule_calibrated = True
+        mean_samples = float(np.mean(list(self.sample_num_dict.values())))
+        spe = max(1, math.ceil(mean_samples / self.cfg.batch_size))
+        if spe == self.hp.steps_per_epoch:
+            return
+        self.hp = hparams_from_config(self.cfg, steps_per_epoch=spe)
+        old_state = self.server_state
+        self.algorithm = create_algorithm(self.cfg, self.hp).build(self._model)
+        fresh = self.algorithm.init_server_state(self.global_vars)
+        # keep accumulated state when the pytree shape is unchanged (it is —
+        # only the schedule constants differ); fall back to fresh otherwise
+        if jax.tree_util.tree_structure(old_state) == jax.tree_util.tree_structure(fresh):
+            self.server_state = old_state
+        else:
+            self.server_state = fresh
+
     def aggregate(self, round_idx: int):
+        self._calibrate_schedule()
         ids = sorted(self.model_dict.keys())
         trees = [jax.tree_util.tree_map(jnp.asarray, self.model_dict[i]) for i in ids]
         stacked = pt.tree_stack(trees)

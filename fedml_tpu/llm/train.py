@@ -77,15 +77,23 @@ class LLMTrainer:
             optax.clip_by_global_norm(args.grad_clip),
             optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=args.weight_decay),
         )
-        # optimizer moments inherit the param shardings via propagation
-        self.opt_state = jax.jit(self.opt.init)(self.params)
+        # Optimizer moments must NOT inherit shardings by propagation: optax
+        # init builds them as zeros with no data dependence on the params, so
+        # XLA places them on device 0 (SingleDeviceSharding) — a multi-device
+        # step then rejects the mixed device set.  The moment paths end with
+        # the param path ('...nu/layer_0/attn/wq/kernel'), so the same
+        # path-regex rules shard them like their params; scalars (count)
+        # fall through to the replicate-by-default rule.
+        opt_shardings = sharding.named_shardings(
+            jax.eval_shape(self.opt.init, self.params), mesh
+        )
+        self.opt_state = jax.jit(self.opt.init, out_shardings=opt_shardings)(self.params)
         self.data_sharding = sharding.batch_sharding(mesh, seq_axis=self.seq_axis)
         self.step_idx = 0
-        # Pin output shardings to the input shardings: with donation and
-        # unspecified out_shardings, XLA may pick different layouts for the
-        # step's outputs, and the SECOND call then recompiles against the new
-        # input layouts (a silent ~80 s hit on real chips).
-        opt_shardings = jax.tree_util.tree_map(lambda x: x.sharding, self.opt_state)
+        # Pin the step's output shardings to the input shardings: with
+        # donation and unspecified out_shardings, XLA may pick different
+        # layouts for the outputs, and the SECOND call then recompiles
+        # against the new input layouts (a silent ~80 s hit on real chips).
         scalar_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
         self._train_step = jax.jit(
             self._make_train_step(),

@@ -3,9 +3,8 @@
 MFU = (model FLOPs per second) / (chip peak FLOPs): the *nominal* FLOPs of the
 training computation (fwd + bwd = 3x fwd for matmul-dominated nets), NOT the
 executed FLOPs — rematerialization recompute does not count as useful work.
-This is the PaLM-appendix convention the scaling literature uses; XLA's
-``cost_analysis()['flops']`` (executed work, including remat) is reported
-separately where available.
+This is the PaLM-appendix convention the scaling literature uses (executed
+FLOPs from XLA's cost model would over-credit remat recompute).
 
 The reference has no MFU accounting anywhere (its perf story is wall-clock CI
 budgets, SURVEY.md §6); BASELINE.md sets >=35% MFU as the target, so the
@@ -66,16 +65,3 @@ def resnet20_cifar_train_flops_per_sample() -> float:
     3 blocks x 2 convs at 16/32/64 channels + stem + fc.)"""
     fwd = 81.7e6
     return 3.0 * fwd
-
-
-def xla_cost_flops(jitted_fn, *args) -> Optional[float]:
-    """Executed-FLOPs estimate from XLA's own cost model for a lowered+compiled
-    function; None when the backend doesn't expose it."""
-    try:
-        compiled = jitted_fn.lower(*args).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0]
-        return float(analysis.get("flops"))
-    except Exception:
-        return None

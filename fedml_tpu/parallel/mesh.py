@@ -80,25 +80,52 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_leading_axis(tree, mesh: Mesh, axis: str = AXIS_CLIENTS):
+def shard_leading_axis(tree, mesh: Mesh, axis: str = AXIS_CLIENTS, warn: bool = True):
     """Place a stacked pytree with its leading dim sharded over ``axis``.
 
     Leading dims not divisible by the axis size are replicated instead —
-    correctness over parallelism for small client counts (XLA still shards
-    downstream vmapped compute as it sees fit).
+    correctness over parallelism for small client counts — but LOUDLY: a
+    127-client stack on an 8-device axis silently losing all client
+    parallelism is a perf cliff, so each distinct undivisible leading dim
+    warns once per process.
+
+    Multi-process aware: when the mesh spans hosts, arrays are assembled via
+    make_array_from_callback (each host contributes its addressable shards).
     """
+    import warnings
+
+    from .multihost import make_global_array
+
     size = mesh.shape[axis]
 
     def put(x):
         if x.ndim >= 1 and x.shape[0] % size == 0:
             spec = P(axis, *([None] * (x.ndim - 1)))
         else:
+            if warn and x.ndim >= 1 and x.shape[0] > 1 and size > 1:
+                key = (int(x.shape[0]), int(size))
+                if key not in _undivisible_warned:
+                    _undivisible_warned.add(key)
+                    warnings.warn(
+                        f"shard_leading_axis: leading dim {x.shape[0]} is not "
+                        f"divisible by mesh axis {axis!r} size {size}; "
+                        "REPLICATING instead — all parallelism over this axis "
+                        "is lost for these arrays. Pad the client stack to a "
+                        f"multiple of {size} (e.g. round client_num_per_round "
+                        "up) to regain it.",
+                        stacklevel=3,
+                    )
             spec = P()
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return make_global_array(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(put, tree)
 
 
+_undivisible_warned: set = set()
+
+
 def replicate(tree, mesh: Mesh):
+    from .multihost import make_global_array
+
     rep = replicated(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), tree)
+    return jax.tree_util.tree_map(lambda x: make_global_array(x, rep), tree)

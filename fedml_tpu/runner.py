@@ -35,6 +35,7 @@ _IMPLEMENTED_TRUST_FLAGS: set = {
     "enable_defense",
     "enable_dp",
     "enable_contribution",
+    "enable_secagg",  # LightSecAgg masked aggregation (cross-silo platform)
 }
 
 
@@ -91,6 +92,13 @@ class FedMLRunner:
     }
 
     def _init_simulation_runner(self):
+        if getattr(self.cfg, "enable_secagg", False):
+            raise NotImplementedError(
+                "enable_secagg is a cross-silo protocol feature (masked "
+                "aggregation over the wire); the single-process simulator has "
+                "no adversarial server to hide updates from — set "
+                "training_type='cross_silo' to use LightSecAgg"
+            )
         opt = self.cfg.federated_optimizer
         if opt in self._SPECIAL_SIM_OPTIMIZERS:
             # trust flags must never be silent no-ops (see

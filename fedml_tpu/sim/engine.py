@@ -319,6 +319,13 @@ class MeshSimulator:
         cfg = self.cfg
         self.try_resume()
         for r in range(self.round_idx, cfg.comm_round):
+            if getattr(cfg, "enable_contribution", False) and r == cfg.comm_round - 1:
+                # retain the pre-round state so contribution is assessed on
+                # the ACTUAL last-round contributions (deterministic replay),
+                # not fresh updates from the post-round global — reference
+                # semantics (contribution_assessor_manager.py:9 assesses from
+                # Context state captured during the round)
+                self._contribution_snapshot = self._snapshot_pre_round(r)
             t0 = time.perf_counter()
             metrics = self.run_round()
             metrics["round_time_s"] = time.perf_counter() - t0
@@ -339,38 +346,71 @@ class MeshSimulator:
                 self.logger.log({f"contribution_c{i}": float(s) for i, s in enumerate(scores)})
         return history
 
-    def assess_contribution(self):
-        """Shapley contribution of the last round's sampled clients
-        (reference ``ServerAggregator.assess_contribution``
-        ``server_aggregator.py:105``): re-runs the last round's client updates
-        and scores coalitions by test accuracy."""
-        from ..trust.contribution import ContributionAssessorManager
-
-        mgr = ContributionAssessorManager(self.cfg)
-        if not mgr.enabled or self.round_idx == 0:
-            return None
-        r = self.round_idx - 1
+    def _snapshot_pre_round(self, r: int) -> dict:
+        # only the sampled clients' states are ever replayed (the sampled set
+        # is deterministic in (root_key, r)), so don't host-copy the full
+        # n_total stack — with SCAFFOLD-style per-client state that would be
+        # n_total/m times more RAM than needed
         n_total = self.dataset.n_clients
         m = min(self.cfg.client_num_per_round, n_total)
         sampled = np.asarray(rng.sample_clients(self.root_key, r, n_total, m))
-        # recompute the last round's contributions with the pre-round state is
-        # not retained; assess on fresh local updates from the current global
-        rkey = rng.round_key(self.root_key, r + 0x5A)
-        contribs, weights = [], []
-        fn = self._client_fn_sp or jax.jit(self._sp_client_update)
-        for ci in sampled:
-            cs = (
-                jax.tree_util.tree_map(lambda s: s[int(ci)], self.client_states)
+        return {
+            "round": r,
+            "global_vars": jax.device_get(self.global_vars),
+            "server_state": jax.device_get(self.server_state),
+            "client_states": (
+                {
+                    int(ci): jax.device_get(
+                        jax.tree_util.tree_map(lambda s: s[int(ci)], self.client_states)
+                    )
+                    for ci in sampled
+                }
                 if self.client_states is not None else None
-            )
+            ),
+        }
+
+    def last_round_contributions(self):
+        """Deterministically replay the last round's EXACT client
+        contributions from the retained pre-round snapshot: same sampled set,
+        same round key, same pre-round global/server/client states as the
+        round that was aggregated.  Returns (stacked, weights, sampled,
+        snapshot) or None when no snapshot was retained."""
+        snap = getattr(self, "_contribution_snapshot", None)
+        if snap is None:
+            return None
+        r = snap["round"]
+        n_total = self.dataset.n_clients
+        m = min(self.cfg.client_num_per_round, n_total)
+        sampled = np.asarray(rng.sample_clients(self.root_key, r, n_total, m))
+        rkey = rng.round_key(self.root_key, r)
+        fn = self._client_fn_sp or jax.jit(self._sp_client_update)
+        contribs, weights = [], []
+        for ci in sampled:
+            cs = snap["client_states"][int(ci)] if snap["client_states"] is not None else None
             contrib, _, _ = fn(
-                self.global_vars, cs, self.server_state,
+                snap["global_vars"], cs, snap["server_state"],
                 self._data[0][int(ci)], self._data[1][int(ci)],
                 self.counts[int(ci)], rng.client_key(rkey, int(ci)),
             )
             contribs.append(contrib)
             weights.append(float(self.counts[int(ci)]))
-        stacked = pt.tree_stack(contribs)
+        return pt.tree_stack(contribs), weights, sampled, snap
+
+    def assess_contribution(self):
+        """Shapley contribution of the last round's sampled clients
+        (reference ``ServerAggregator.assess_contribution``
+        ``server_aggregator.py:105``): scores the coalitions of the ACTUAL
+        last-round contributions (replayed from the pre-round snapshot) by
+        test accuracy."""
+        from ..trust.contribution import ContributionAssessorManager
+
+        mgr = ContributionAssessorManager(self.cfg)
+        if not mgr.enabled or self.round_idx == 0:
+            return None
+        replay = self.last_round_contributions()
+        if replay is None:
+            return None
+        stacked, weights, sampled, snap = replay
         one = jax.tree_util.tree_map(lambda x: x[0], stacked)
         if jax.tree_util.tree_structure(one) != jax.tree_util.tree_structure(self.global_vars):
             return None  # contribution defined on weight-style contributions
@@ -378,4 +418,4 @@ class MeshSimulator:
         def eval_fn(agg_vars):
             return self._eval_fn(agg_vars, *self._test)["test_acc"]
 
-        return mgr.assess(stacked, np.asarray(weights), eval_fn, empty_model=self.global_vars)
+        return mgr.assess(stacked, np.asarray(weights), eval_fn, empty_model=snap["global_vars"])

@@ -28,7 +28,10 @@ class LightSecAggProtocol:
         self.t = privacy_t
         self.u = target_u
         self.p = p
-        self.rng = np.random.RandomState(seed)
+        # SeedSequence accepts arbitrarily large entropy ints (the protocol
+        # layer feeds 256-bit OS entropy so mask streams can't be
+        # brute-forced); RandomState alone caps seeds at 2^32
+        self.rng = np.random.RandomState(np.random.SeedSequence(seed).generate_state(8))
         # evaluation points: alpha_j for interpolation targets (U-T + T chunks),
         # beta_i for the N clients — all distinct, nonzero.
         self.alphas = np.arange(1, self.u + 1, dtype=np.int64)

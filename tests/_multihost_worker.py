@@ -1,0 +1,79 @@
+"""Worker entry for the 2-process multi-host test (spawned by
+tests/test_multihost.py).  Usage:
+
+    python tests/_multihost_worker.py <process_id> <num_processes> <port>
+
+Each process backs 4 virtual CPU devices; the global mesh is 8 devices over
+2 processes.  Prints the final global-parameter checksum and last-round
+metrics as one JSON line tagged MULTIHOST_RESULT.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+
+    cfg = Config(
+        dataset="synthetic",
+        model="lr",
+        client_num_in_total=8,
+        client_num_per_round=8,
+        comm_round=2,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.1,
+        synthetic_train_size=640,
+        synthetic_test_size=160,
+        partition_method="homo",
+        frequency_of_the_test=1,
+        compute_dtype="float32",
+        random_seed=0,
+        backend_sim="MULTIPROCESS",
+        extra={
+            "coordinator_address": f"localhost:{port}",
+            "num_processes": nproc,
+            "process_id": pid,
+        },
+    )
+    fedml_tpu.init(cfg)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 4 * nproc, len(jax.devices())
+
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.sim.engine import MeshSimulator
+
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    sim = MeshSimulator(cfg, ds, model)
+    history = sim.run()
+
+    import numpy as np
+
+    flat = np.concatenate([
+        np.asarray(x, dtype=np.float64).ravel()
+        for x in jax.tree_util.tree_leaves(jax.device_get(sim.global_vars))
+    ])
+    print("MULTIHOST_RESULT " + json.dumps({
+        "pid": pid,
+        "checksum": float(flat.sum()),
+        "l2": float(np.sqrt((flat ** 2).sum())),
+        "test_acc": history[-1].get("test_acc"),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

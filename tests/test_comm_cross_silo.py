@@ -194,6 +194,36 @@ def test_cross_silo_via_runner(eight_devices):
     assert history and history[-1]["round"] == 2
 
 
+def test_server_schedule_calibrates_from_protocol_counts(eight_devices):
+    """VERDICT 'what's weak' #5: the server must derive steps_per_epoch from
+    the sample counts clients report in the protocol, not from the
+    synthetic_train_size config guess."""
+    import jax
+    import fedml_tpu
+    from fedml_tpu.cross_silo.server import FedMLAggregator
+    from fedml_tpu.data import loader
+    from fedml_tpu.data.dataset import pad_eval_set
+    from fedml_tpu.models import model_hub
+
+    # config claims 10000 samples/client; clients will report 64
+    cfg = tiny_config(synthetic_train_size=640, batch_size=16)
+    cfg.extra = dict(cfg.extra or {})
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    test_arrays = pad_eval_set(ds.test_x, ds.test_y, 32)
+    cfg.synthetic_train_size = 160000  # mislead the provisional guess
+    agg = FedMLAggregator(cfg, model, ds.train_x[: cfg.batch_size], test_arrays)
+    provisional = agg.hp.steps_per_epoch
+    assert provisional == 160000 // 8 // 16  # the wrong guess
+
+    params = jax.device_get(agg.global_vars)
+    for cid in (1, 2):
+        agg.add_local_trained_result(cid, params, 64.0)
+    agg.aggregate(0)
+    assert agg.hp.steps_per_epoch == 4  # ceil(64 / 16): the protocol truth
+
+
 def test_cross_silo_straggler_bounded_wait(eight_devices):
     """A dead client must NOT stall the round when bounded wait is on —
     the mid-round straggler gap called out in SURVEY.md §5."""

@@ -21,7 +21,12 @@ def test_transformer_forward_and_loss(eight_devices):
     params = model.init({"params": jax.random.PRNGKey(0)}, tokens)["params"]
     logits = model.apply({"params": params}, tokens)
     assert logits.shape == (2, 64, 256)
-    assert logits.dtype == jnp.float32
+    # lm_head runs in cfg.logits_dtype (bf16 default keeps the vocab matmul
+    # on the MXU fast path); f32 must still be selectable for eval paths
+    assert logits.dtype == cfg.logits_dtype
+    f32_cfg = type(cfg)(**{**cfg.__dict__, "logits_dtype": jnp.float32})
+    l32 = Transformer(f32_cfg).apply({"params": params}, tokens)
+    assert l32.dtype == jnp.float32
 
 
 def test_causality(eight_devices):
@@ -67,7 +72,8 @@ def test_transformer_with_ring_attention(eight_devices):
     from fedml_tpu.parallel.mesh import make_mesh
 
     cfg = TransformerConfig.tiny(vocab_size=128)
-    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False})
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32, "remat": False,
+                       "logits_dtype": jnp.float32})
     mesh = make_mesh(("sp",), (8,))
     tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 128), 0, 128)
     plain = Transformer(cfg)

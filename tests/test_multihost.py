@@ -1,0 +1,107 @@
+"""Multi-host (MULTIPROCESS backend) tests — VERDICT.md item 4.
+
+A 2-process CPU run (gloo collectives, 4 virtual devices per process, one
+8-device global mesh) must produce numerics identical to the single-process
+8-device mesh run: the jitted round is the same SPMD program either way.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_reference():
+    """Same config on this process's own 8-device mesh."""
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.sim.engine import MeshSimulator
+
+    from .conftest import tiny_config
+
+    cfg = tiny_config(client_num_per_round=8)
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    sim = MeshSimulator(cfg, ds, model)
+    history = sim.run()
+    flat = np.concatenate([
+        np.asarray(x, dtype=np.float64).ravel()
+        for x in jax.tree_util.tree_leaves(jax.device_get(sim.global_vars))
+    ])
+    return float(flat.sum()), float(np.sqrt((flat ** 2).sum())), history[-1].get("test_acc")
+
+
+def test_two_process_mesh_equals_single_process(eight_devices):
+    port = _free_port()
+    worker = os.path.join(_REPO, "tests", "_multihost_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_PLATFORM_NAME")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", str(port)],
+            env=env, cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out[-3000:]
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("MULTIHOST_RESULT "):
+                r = json.loads(line[len("MULTIHOST_RESULT "):])
+                results[r["pid"]] = r
+    assert set(results) == {0, 1}, outs[0][-2000:]
+    # both processes hold the identical replicated global model
+    assert results[0]["checksum"] == pytest.approx(results[1]["checksum"], abs=1e-9)
+    assert results[0]["l2"] == pytest.approx(results[1]["l2"], abs=1e-9)
+
+    ref_sum, ref_l2, ref_acc = _single_process_reference()
+    # the 2-process global mesh runs the same SPMD program as the 1-process
+    # 8-device mesh — numerics must match to float tolerance
+    assert results[0]["checksum"] == pytest.approx(ref_sum, rel=1e-5, abs=1e-5)
+    assert results[0]["l2"] == pytest.approx(ref_l2, rel=1e-5, abs=1e-5)
+    assert results[0]["test_acc"] == pytest.approx(ref_acc, abs=1e-6)
+
+
+def test_shard_leading_axis_warns_on_undivisible(eight_devices):
+    """VERDICT 'what's weak' #3: silent replication is a perf cliff — it must
+    warn."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from fedml_tpu.parallel import mesh as meshlib
+
+    m = meshlib.make_mesh((meshlib.AXIS_CLIENTS,), (8,))
+    meshlib._undivisible_warned.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        meshlib.shard_leading_axis(jnp.zeros((127, 4)), m)
+    assert any("127" in str(x.message) and "REPLICATING" in str(x.message) for x in w), [
+        str(x.message) for x in w
+    ]
+    # divisible dims stay silent
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        meshlib.shard_leading_axis(jnp.zeros((128, 4)), m)
+    assert not w, [str(x.message) for x in w]

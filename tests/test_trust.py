@@ -304,6 +304,37 @@ def test_contribution_assessment(eight_devices):
     assert np.isfinite(scores).all()
 
 
+def test_contribution_assesses_actual_round_contributions(eight_devices):
+    """VERDICT 'what's weak' #6: the assessed coalitions must be the EXACT
+    contributions that were aggregated last round — their FedAvg aggregate
+    must reproduce the post-round global, bit-for-bit up to float tolerance."""
+    import jax
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(
+        comm_round=2, client_num_per_round=4, enable_contribution=True,
+        contribution_method="leave_one_out",
+    )
+    fedml_tpu.init(cfg)
+    sim = FedMLRunner(cfg).runner
+    sim.run()
+    replay = sim.last_round_contributions()
+    assert replay is not None
+    stacked, weights, sampled, snap = replay
+    import jax.numpy as jnp
+
+    agg = sim.algorithm.aggregate(stacked, jnp.asarray(weights, jnp.float32))
+    new_global, _ = sim.algorithm.server_update(
+        jax.tree_util.tree_map(jnp.asarray, snap["global_vars"]),
+        jax.tree_util.tree_map(jnp.asarray, snap["server_state"]),
+        agg, snap["round"],
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(new_global),
+                    jax.tree_util.tree_leaves(sim.global_vars)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5)
+
+
 def test_label_flipping_end_to_end(eight_devices):
     """Data-poisoning attacks must actually poison the stacked dataset."""
     import fedml_tpu
