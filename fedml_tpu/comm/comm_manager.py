@@ -79,7 +79,16 @@ class FedMLCommManager(Observer):
             from .mqtt_s3 import MqttS3CommManager
 
             return MqttS3CommManager(getattr(self.cfg, "run_id", "0"), self.rank)
+        if b == C.COMM_BACKEND_TCP:
+            from .tcp_backend import TCPCommManager
+
+            base_port = int((getattr(self.cfg, "extra", {}) or {}).get("tcp_base_port", 9690))
+            ip_config = (getattr(self.cfg, "extra", {}) or {}).get("tcp_ip_config", {})
+            return TCPCommManager(
+                "0.0.0.0", base_port + self.rank, self.rank,
+                ip_config=ip_config, base_port=base_port,
+            )
         raise ValueError(
             f"unknown comm backend {b!r}; known: "
-            f"{[C.COMM_BACKEND_INPROC, C.COMM_BACKEND_GRPC, C.COMM_BACKEND_MQTT_S3]}"
+            f"{[C.COMM_BACKEND_INPROC, C.COMM_BACKEND_GRPC, C.COMM_BACKEND_MQTT_S3, C.COMM_BACKEND_TCP]}"
         )

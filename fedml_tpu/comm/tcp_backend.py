@@ -1,0 +1,109 @@
+"""Plain-TCP communication backend — the polyglot transport.
+
+Purpose (SURVEY.md §2.13, VERDICT item 5): the reference's cross-device
+platform drives non-Python phone clients (C++ MobileNN,
+``android/fedmlsdk/MobileNN/src/train/FedMLMNNTrainer.cpp``) over MQTT; the
+TPU build's equivalent is a second-language client speaking the pytree wire
+format.  gRPC C++ isn't available in the build image, so the polyglot
+transport is the simplest thing both sides can speak exactly: one listening
+socket per endpoint, one short-lived connection per message (the same
+unary-per-message shape as the gRPC backend), frames of
+
+    [8-byte LE frame length][Message bytes]
+
+where Message bytes are ``comm.message.Message.encode()`` — 4-byte LE control
+length + control JSON + pytree wire blob.  A C client needs only sockets and
+a JSON parser (``native/`` holds the C++ implementation).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import struct
+import threading
+from typing import Optional
+
+from .base import BaseCommunicationManager, ObserverLoopMixin
+from .message import Message
+
+log = logging.getLogger("fedml_tpu.comm.tcp")
+
+FRAME_HEADER = struct.Struct("<Q")
+MAX_FRAME_BYTES = 1 << 30  # 1 GB, matching the gRPC backend cap
+
+
+def read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError(f"peer closed mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(FRAME_HEADER.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (n,) = FRAME_HEADER.unpack(read_exact(sock, FRAME_HEADER.size))
+    if n > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {n} bytes exceeds {MAX_FRAME_BYTES}")
+    return read_exact(sock, n)
+
+
+class TCPCommManager(ObserverLoopMixin, BaseCommunicationManager):
+    """Endpoint i listens on base_port + i; send opens a connection to
+    base_port + receiver_id on the receiver's host (ip_config, default
+    loopback)."""
+
+    def __init__(self, host: str, port: int, rank: int,
+                 ip_config: Optional[dict] = None, base_port: int = 9690):
+        self._init_observer_loop()
+        self.rank = rank
+        self.base_port = base_port
+        self.ip_config = {int(k): v for k, v in (ip_config or {}).items()}
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while True:
+                    self._inbox.put(recv_frame(conn))
+        except (ConnectionError, OSError):
+            pass  # per-message connections close after one frame
+        except ValueError as e:
+            # oversized/corrupt frame: drop the connection but NEVER die
+            # silently — the sender sees success, so this log line is the
+            # only trace of the lost message
+            log.error("rank %d dropping connection: %s", self.rank, e)
+
+    def send_message(self, msg: Message) -> None:
+        rid = msg.get_receiver_id()
+        host = self.ip_config.get(rid, "127.0.0.1")
+        payload = msg.encode()
+        with socket.create_connection((host, self.base_port + rid), timeout=30.0) as s:
+            send_frame(s, payload)
+
+    def stop_receive_message(self) -> None:
+        super().stop_receive_message()
+        try:
+            self._listener.close()
+        except OSError:
+            pass

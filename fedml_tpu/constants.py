@@ -52,6 +52,7 @@ FEDERATED_OPTIMIZER_FEDNAS = "FedNAS"
 COMM_BACKEND_INPROC = "INPROC"  # loopback fake for tests (new; SURVEY.md §4)
 COMM_BACKEND_GRPC = "GRPC"
 COMM_BACKEND_MQTT_S3 = "MQTT_S3"
+COMM_BACKEND_TCP = "TCP"  # polyglot frame transport (native/ C++ client)
 COMM_BACKEND_TRPC = "TRPC"
 COMM_BACKEND_MPI = "MPI"
 

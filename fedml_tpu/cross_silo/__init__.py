@@ -52,30 +52,30 @@ class _CrossSiloRunner:
         self.dataset = dataset
         self.model = model
 
+    def _builders(self):
+        """(run_group, build_srv, build_cli) for the configured privacy mode."""
+        cfg = self.cfg
+        if getattr(cfg, "enable_secagg", False):
+            from .lightsecagg import build_lsa_client, build_lsa_server, run_lightsecagg_process_group
+
+            return (lambda *a, **k: run_lightsecagg_process_group(*a, **k)[0],
+                    build_lsa_server, build_lsa_client)
+        if getattr(cfg, "enable_fhe", False):
+            from .fhe import build_fhe_client, build_fhe_server, run_fhe_process_group
+
+            return (lambda *a, **k: run_fhe_process_group(*a, **k)[0],
+                    build_fhe_server, build_fhe_client)
+        return run_in_process_group, build_server, build_client
+
     def run(self):
         cfg = self.cfg
-        secagg = bool(getattr(cfg, "enable_secagg", False))
+        run_group, build_srv, build_cli = self._builders()
         if cfg.role == "server" and cfg.backend in ("INPROC", "MESH", ""):
             # single-process orchestration (tests / local runs)
-            if secagg:
-                from .lightsecagg import run_lightsecagg_process_group
-
-                history, _ = run_lightsecagg_process_group(cfg, self.dataset, self.model)
-                return history
-            return run_in_process_group(cfg, self.dataset, self.model)
+            return run_group(cfg, self.dataset, self.model)
         if cfg.role == "server":
-            if secagg:
-                from .lightsecagg import build_lsa_server
-
-                return build_lsa_server(cfg, self.dataset, self.model).run_until_done()
-            server = build_server(cfg, self.dataset, self.model)
-            return server.run_until_done()
-        if secagg:
-            from .lightsecagg import build_lsa_client
-
-            client = build_lsa_client(cfg, self.dataset, self.model, rank=int(cfg.rank))
-        else:
-            client = build_client(cfg, self.dataset, self.model, rank=int(cfg.rank))
+            return build_srv(cfg, self.dataset, self.model).run_until_done()
+        client = build_cli(cfg, self.dataset, self.model, rank=int(cfg.rank))
         thread = client.run_in_thread()
         client.done.wait()
         thread.join(timeout=5.0)

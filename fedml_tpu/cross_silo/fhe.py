@@ -1,0 +1,180 @@
+"""FHE-encrypted cross-silo aggregation.
+
+Parity with ``core/fhe/fhe_agg.py:10`` (FedML-HE): clients encrypt their
+1/n-scaled updates under a shared RLWE context (``trust/fhe/rlwe.py``; the
+reference ships a shared TenSEAL CKKS context the same way), the server adds
+ciphertexts — it never sees an individual plaintext update — and decrypts
+only the AGGREGATE for eval + broadcast.  Message flow is the plain FedAvg
+protocol; only the model payload changes representation:
+
+    INIT(plaintext global)           server -> clients
+    enc(update_i / n)                client -> server     (ciphertext blocks)
+    SYNC(plaintext mean)             server -> clients
+
+Key provisioning: ``cfg.extra['fhe_key_seed']`` (out-of-band in production,
+exactly like the reference's ``context.pickle``; defaults to a
+random_seed-derived value for hermetic tests — the privacy statement is
+"server sees only aggregates", matching the reference's shared-context
+threat model, NOT server-blind decryption).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+from ..trust.fhe.rlwe import RLWECipher, RLWEParams, add_ciphertexts
+from ..comm.message import Message
+from . import message_define as md
+from .client import ClientMasterManager, FedMLTrainer
+from .server import FedMLAggregator, FedMLServerManager
+
+log = logging.getLogger("fedml_tpu.cross_silo.fhe")
+
+MSG_ARG_KEY_FHE_LEN = "fhe_len"
+
+
+def fhe_cipher(cfg) -> RLWECipher:
+    extra = getattr(cfg, "extra", {}) or {}
+    key_seed = int(extra.get("fhe_key_seed", cfg.random_seed * 7919 + 17))
+    params = RLWEParams(
+        n=int(extra.get("fhe_ring_dim", 1024)),
+        frac_bits=int(extra.get("fhe_frac_bits", 16)),
+    )
+    return RLWECipher(params, key_seed=key_seed)
+
+
+def check_fhe_compatible(cfg) -> None:
+    incompatible = [
+        f for f in ("enable_attack", "enable_defense", "enable_dp",
+                    "enable_contribution", "enable_secagg")
+        if getattr(cfg, f, False)
+    ]
+    if incompatible:
+        raise NotImplementedError(
+            f"trust features {incompatible} need individual client updates, "
+            "which FHE aggregation hides from the server; disable them or "
+            "disable enable_fhe"
+        )
+    if getattr(cfg, "federated_optimizer", "FedAvg") not in ("FedAvg", "fedavg", "FedAvg_seq"):
+        raise NotImplementedError(
+            "FHE aggregation yields only the uniform mean of updates "
+            "(reference fhe_agg.py scales by 1/n before encryption); server "
+            f"optimizer {cfg.federated_optimizer!r} needs plaintext updates"
+        )
+
+
+class FHEAggregator(FedMLAggregator):
+    """Stores ciphertext block stacks; aggregation = homomorphic addition +
+    aggregate-only decryption."""
+
+    def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
+        check_fhe_compatible(cfg)
+        super().__init__(cfg, model, sample_x, test_arrays, trust=None)
+        self.cipher = fhe_cipher(cfg)
+        flat, self._unravel = jax.flatten_util.ravel_pytree(self.global_vars)
+        self.model_dim = int(flat.size)
+
+    def add_local_trained_result(self, client_idx: int, blocks, sample_num: float) -> None:
+        arr = np.asarray(blocks, dtype=np.int64)  # (B, 2, N)
+        if arr.ndim != 3 or arr.shape[1] != 2 or arr.shape[2] != self.cipher.params.n:
+            raise ValueError(f"bad ciphertext stack shape {arr.shape}")
+        self.model_dict[client_idx] = arr
+        self.sample_num_dict[client_idx] = sample_num
+        self.flag_client_model_uploaded[client_idx] = True
+
+    def aggregate(self, round_idx: int):
+        ids = sorted(self.model_dict.keys())
+        blocks_list = [list(self.model_dict[i]) for i in ids]
+        summed = add_ciphertexts(blocks_list, self.cipher.params.q)
+        mean = self.cipher.decrypt_vector(summed, self.model_dim)
+        # Clients pre-scale by 1/n assuming FULL participation; when the
+        # straggler-quorum path aggregates only k < n survivors the decrypted
+        # value is sum(x_i)/n — rescale (in plaintext, post-decryption) to
+        # the survivor mean sum(x_i)/k.
+        n = self.cfg.client_num_in_total
+        if len(ids) != n:
+            log.warning("FHE round %d: %d/%d survivors, rescaling by n/k", round_idx, len(ids), n)
+            mean = mean * (n / max(len(ids), 1))
+        self.global_vars = self._unravel(jnp.asarray(mean, jnp.float32))
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        self.flag_client_model_uploaded.clear()
+        return self.global_vars
+
+
+class FHEServerManager(FedMLServerManager):
+    def __init__(self, cfg, aggregator: FHEAggregator, backend: Optional[str] = None, logger=None):
+        super().__init__(cfg, aggregator, backend=backend, logger=logger)
+        if self.per_round != len(self.client_ids):
+            raise ValueError(
+                "FHE aggregation requires full participation per round: the "
+                "1/n scaling clients apply before encryption assumes all "
+                f"n={len(self.client_ids)} contribute "
+                f"(client_num_per_round={self.per_round})"
+            )
+
+
+class FHEClientManager(ClientMasterManager):
+    def __init__(self, cfg, trainer: FedMLTrainer, rank: int, backend: Optional[str] = None):
+        check_fhe_compatible(cfg)
+        super().__init__(cfg, trainer, rank=rank, backend=backend)
+        self.cipher = fhe_cipher(cfg)
+        self.n = cfg.client_num_in_total
+
+    def _train_and_send(self, msg: Message) -> None:
+        round_idx = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
+        params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
+        client_idx = int(msg.get(md.MSG_ARG_KEY_CLIENT_INDEX, self.rank - 1))
+        new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key, client_idx)
+        self.rounds_trained += 1
+        flat, _ = jax.flatten_util.ravel_pytree(new_vars)
+        # 1/n scaling BEFORE encryption (reference fhe_enc weight_factors):
+        # the server's ciphertext sum then decrypts directly to the mean
+        blocks = self.cipher.encrypt_vector(np.asarray(flat, np.float64) / self.n)
+        reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, np.stack(blocks))
+        reply.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
+        reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+        self.send_message(reply)
+
+
+# -- builders ----------------------------------------------------------------
+
+def build_fhe_server(cfg, dataset, model, backend: Optional[str] = None) -> FHEServerManager:
+    from ..data.dataset import pad_eval_set
+
+    eval_bs = min(256, max(32, cfg.test_batch_size))
+    test_arrays = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
+    aggregator = FHEAggregator(cfg, model, dataset.train_x[: cfg.batch_size], test_arrays)
+    return FHEServerManager(cfg, aggregator, backend=backend)
+
+
+def build_fhe_client(cfg, dataset, model, rank: int, backend: Optional[str] = None) -> FHEClientManager:
+    ix = dataset.client_idx[rank - 1]
+    trainer = FedMLTrainer(cfg, model, dataset.train_x[ix], dataset.train_y[ix])
+    return FHEClientManager(cfg, trainer, rank=rank, backend=backend)
+
+
+def run_fhe_process_group(cfg, dataset, model, backend: str = "INPROC", timeout: float = 600.0):
+    from ..comm.inproc import InProcRouter
+
+    InProcRouter.reset(str(getattr(cfg, "run_id", "0")))
+    clients = [
+        build_fhe_client(cfg, dataset, model, rank=r, backend=backend)
+        for r in range(1, cfg.client_num_in_total + 1)
+    ]
+    for c in clients:
+        c.run_in_thread()
+    server = build_fhe_server(cfg, dataset, model, backend=backend)
+    try:
+        history = server.run_until_done(timeout=timeout)
+    finally:
+        for c in clients:
+            c.finish()
+    return history, server

@@ -81,7 +81,7 @@ def secagg_params(cfg):
     # on masked field vectors — refuse loudly instead of silently no-opping
     # (the contract stated in runner._check_unimplemented_flags)
     incompatible = [
-        f for f in ("enable_attack", "enable_defense", "enable_dp", "enable_contribution")
+        f for f in ("enable_attack", "enable_defense", "enable_dp", "enable_contribution", "enable_fhe")
         if getattr(cfg, f, False)
     ]
     if incompatible:

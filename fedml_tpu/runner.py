@@ -36,6 +36,7 @@ _IMPLEMENTED_TRUST_FLAGS: set = {
     "enable_dp",
     "enable_contribution",
     "enable_secagg",  # LightSecAgg masked aggregation (cross-silo platform)
+    "enable_fhe",  # RLWE homomorphic aggregation (cross-silo platform)
 }
 
 
@@ -92,13 +93,14 @@ class FedMLRunner:
     }
 
     def _init_simulation_runner(self):
-        if getattr(self.cfg, "enable_secagg", False):
-            raise NotImplementedError(
-                "enable_secagg is a cross-silo protocol feature (masked "
-                "aggregation over the wire); the single-process simulator has "
-                "no adversarial server to hide updates from — set "
-                "training_type='cross_silo' to use LightSecAgg"
-            )
+        for flag, feature in (("enable_secagg", "LightSecAgg"), ("enable_fhe", "FHE aggregation")):
+            if getattr(self.cfg, flag, False):
+                raise NotImplementedError(
+                    f"{flag} is a cross-silo protocol feature ({feature} over "
+                    "the wire); the single-process simulator has no "
+                    "adversarial server to hide updates from — set "
+                    "training_type='cross_silo'"
+                )
         opt = self.cfg.federated_optimizer
         if opt in self._SPECIAL_SIM_OPTIMIZERS:
             # trust flags must never be silent no-ops (see

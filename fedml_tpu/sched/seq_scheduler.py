@@ -19,10 +19,12 @@ TPU-native redesign:
   per device (heterogeneous pools) or shared (uniform pools), with the mean
   relative fit error reported like the reference's ``fit_error``.
 
-Used by the mesh engine's FedAvg_seq path to pick WHICH clients share a
-device shard when the sampled set is larger than the clients axis: balancing
-total samples per shard keeps the vmapped local-SGD scan's trip count (set
-by the slowest co-located client) minimal.
+Used by the hierarchical simulator (``sim/hierarchical.py``) to balance
+total samples across client groups (the default ``group_assignment:
+balanced`` mode); the flat mesh engine pads clients to a uniform capacity so
+its jitted path is placement-invariant and needs no scheduling.
+``RuntimeEstimator``/``balanced_client_order`` are public API for host-loop
+and cross-silo placement planning.
 """
 
 from __future__ import annotations

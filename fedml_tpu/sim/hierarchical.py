@@ -45,10 +45,24 @@ class HierarchicalSimulator:
         n = dataset.n_clients
         self.group_num = max(1, int(cfg.group_num))
         self.group_comm_round = max(1, int(cfg.group_comm_round))
-        # round-robin group assignment (reference partitions client list evenly)
-        self.group_of = jnp.asarray(np.arange(n) % self.group_num, jnp.int32)
 
         stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
+        # Group assignment: "balanced" (default) uses the fedavg_seq
+        # min-makespan scheduler to equalize total samples per group — with
+        # ragged Dirichlet shards, round-robin groups can differ by 10x in
+        # total work.  "round_robin" keeps the reference's even partition of
+        # the client list (hierarchical_fl trainer.py:10).
+        assignment_mode = (getattr(cfg, "extra", {}) or {}).get("group_assignment", "balanced")
+        if assignment_mode == "balanced":
+            from ..sched.seq_scheduler import SeqTrainScheduler
+
+            sched = SeqTrainScheduler(np.asarray(stacked.counts, np.float64), self.group_num).schedule_lpt()
+            group_of = np.empty(n, np.int32)
+            for g, members in enumerate(sched.assignment):
+                group_of[np.asarray(members, np.int64)] = g
+            self.group_of = jnp.asarray(group_of)
+        else:
+            self.group_of = jnp.asarray(np.arange(n) % self.group_num, jnp.int32)
         spe = max(1, math.ceil(stacked.capacity / cfg.batch_size))
         self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
         self._local_train = make_local_train_fn(model, self.hp)

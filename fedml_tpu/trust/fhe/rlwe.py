@@ -1,0 +1,148 @@
+"""Additively-homomorphic RLWE encryption for federated aggregation.
+
+Capability parity with ``core/fhe/fhe_agg.py:10`` (reference: TenSEAL CKKS
+vectors, shared context, ciphertext addition on the server).  The build image
+has no FHE library, so this is a self-contained BFV-style scheme over
+R_q = Z_q[x]/(x^N + 1):
+
+    keygen:   s <- {-1, 0, 1}^N (ternary secret)
+    encrypt:  a <- U(Z_q^N);  e <- small noise
+              ct = (c0, c1) = (-(a*s) + e + delta * m,  a)      delta = q // t
+    add:      component-wise mod q  (noise adds linearly)
+    scale:    integer plaintext scalar w: (w*c0, w*c1)  (noise grows by w)
+    decrypt:  m = round_t((c0 + c1 * s mod q, centered) / delta)
+
+Fixed-point encoding mirrors the SecAgg quantizer (field.py): floats scale by
+2^frac_bits into Z_t, negatives wrap.  Exact integer arithmetic uses numpy
+object arrays (coefficients reach q^2*N ~ 2^110 during convolution); wire
+form is int64 (q < 2^62).  This is deliberately additive-only — FedAvg
+aggregation needs nothing else, and avoiding relinearization keeps the
+implementation auditable.
+
+Threat model (same as the reference's shared-context design): every client
+holds the context (with secret key); the SERVER aggregates ciphertexts and
+only ever decrypts the aggregate, never an individual update.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RLWEParams:
+    n: int = 1024               # ring dimension (power of two)
+    q: int = 1 << 50            # ciphertext modulus
+    t: int = 1 << 30            # plaintext modulus
+    noise_bound: int = 4        # uniform noise in [-b, b]
+    frac_bits: int = 16         # fixed-point fraction bits
+
+    @property
+    def delta(self) -> int:
+        return self.q // self.t
+
+
+def _poly_mul_negacyclic(a: np.ndarray, b: np.ndarray, q: int) -> np.ndarray:
+    """Exact (a * b) mod (x^N + 1, q) via object-int convolution."""
+    n = len(a)
+    full = np.convolve(a.astype(object), b.astype(object))
+    out = full[:n].copy()
+    out[: len(full) - n] -= full[n:]  # x^N = -1
+    return np.mod(out, q)
+
+
+def keygen(params: RLWEParams, rng: np.random.RandomState) -> np.ndarray:
+    return rng.randint(-1, 2, size=params.n).astype(object)
+
+
+@dataclass
+class Ciphertext:
+    c0: np.ndarray  # object ints mod q
+    c1: np.ndarray
+
+    def to_int64(self) -> np.ndarray:
+        """(2, N) int64 wire form (q < 2^62)."""
+        return np.stack([self.c0.astype(np.int64), self.c1.astype(np.int64)])
+
+    @classmethod
+    def from_int64(cls, arr: np.ndarray) -> "Ciphertext":
+        return cls(arr[0].astype(object), arr[1].astype(object))
+
+
+class RLWECipher:
+    """Shared-context cipher: everyone constructing with the same seed holds
+    the same secret key (the reference ships a pickled TenSEAL context the
+    same way)."""
+
+    def __init__(self, params: RLWEParams = RLWEParams(), key_seed: int = 0):
+        self.params = params
+        self._s = keygen(params, np.random.RandomState(np.random.SeedSequence(key_seed).generate_state(8)))
+        # encryption randomness must NOT be shared — fresh OS entropy
+        self._rng = np.random.RandomState(np.random.SeedSequence().generate_state(8))
+
+    # -- fixed-point codec ---------------------------------------------------
+    def encode(self, x: np.ndarray) -> np.ndarray:
+        p = self.params
+        q = np.round(np.asarray(x, dtype=np.float64) * (1 << p.frac_bits)).astype(object)
+        return np.mod(q, p.t)
+
+    def decode(self, m: np.ndarray) -> np.ndarray:
+        p = self.params
+        m = np.mod(m.astype(object), p.t)
+        half = p.t // 2
+        signed = np.where(m > half, m - p.t, m)
+        return signed.astype(np.float64) / (1 << p.frac_bits)
+
+    # -- core ops ------------------------------------------------------------
+    def encrypt_poly(self, m: np.ndarray) -> Ciphertext:
+        p = self.params
+        a = self._rng.randint(0, 1 << 62, size=p.n).astype(object) % p.q
+        e = self._rng.randint(-p.noise_bound, p.noise_bound + 1, size=p.n).astype(object)
+        c0 = np.mod(-_poly_mul_negacyclic(a, self._s, p.q) + e + p.delta * m, p.q)
+        return Ciphertext(c0, a)
+
+    def decrypt_poly(self, ct: Ciphertext) -> np.ndarray:
+        p = self.params
+        raw = np.mod(ct.c0 + _poly_mul_negacyclic(ct.c1, self._s, p.q), p.q)
+        centered = np.where(raw > p.q // 2, raw - p.q, raw)
+        # exact rounding division on object ints (float64 loses bits at 2^50)
+        d = p.delta
+        m = np.array([(int(v) + d // 2) // d for v in centered], dtype=object)
+        return np.mod(m, p.t)
+
+    # -- vector API (the fhe_enc/fhe_dec shape of the reference) -------------
+    def encrypt_vector(self, x: np.ndarray) -> List[np.ndarray]:
+        """float vector -> list of (2, N) int64 ciphertext blocks."""
+        p = self.params
+        m = self.encode(x)
+        pad = (-len(m)) % p.n
+        m = np.concatenate([m, np.zeros(pad, dtype=object)])
+        return [
+            self.encrypt_poly(m[i : i + p.n]).to_int64()
+            for i in range(0, len(m), p.n)
+        ]
+
+    def decrypt_vector(self, blocks: List[np.ndarray], length: int) -> np.ndarray:
+        out = np.concatenate([self.decrypt_poly(Ciphertext.from_int64(b)) for b in blocks])
+        return self.decode(out[:length])
+
+
+def add_ciphertexts(blocks_list: List[List[np.ndarray]], q: int) -> List[np.ndarray]:
+    """Server-side: component-wise sum of clients' ciphertext block lists —
+    the only operation the aggregator performs (no key needed)."""
+    n_blocks = len(blocks_list[0])
+    out = []
+    for b in range(n_blocks):
+        acc = np.zeros_like(blocks_list[0][b], dtype=object)
+        for blocks in blocks_list:
+            acc = acc + blocks[b].astype(object)
+        out.append(np.mod(acc, q).astype(np.int64))
+    return out
+
+
+def scale_ciphertext(blocks: List[np.ndarray], w: int, q: int) -> List[np.ndarray]:
+    """Integer plaintext scalar multiply (for integer-weighted aggregation)."""
+    return [np.mod(b.astype(object) * int(w), q).astype(np.int64) for b in blocks]

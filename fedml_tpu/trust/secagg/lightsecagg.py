@@ -44,12 +44,17 @@ class LightSecAggProtocol:
     def gen_mask(self, d: int) -> np.ndarray:
         return self.rng.randint(0, self.p, size=self.pad_len(d), dtype=np.int64)
 
-    def encode_mask(self, mask: np.ndarray) -> np.ndarray:
+    def encode_mask(self, mask: np.ndarray, noise: np.ndarray = None) -> np.ndarray:
         """(N, d'/(U-T)) encoded sub-masks, one row per receiving client —
-        reference ``mask_encoding``."""
+        reference ``mask_encoding``.  ``noise`` (the T privacy chunks) is
+        drawn from the protocol RNG unless given explicitly (the C++ kernel
+        conformance tests inject it to make the encode deterministic)."""
         k = self.u - self.t
         chunks = mask.reshape(k, -1)  # (U-T, s)
-        noise = self.rng.randint(0, self.p, size=(self.t, chunks.shape[1]), dtype=np.int64)
+        if noise is None:
+            noise = self.rng.randint(0, self.p, size=(self.t, chunks.shape[1]), dtype=np.int64)
+        else:
+            noise = np.asarray(noise, dtype=np.int64).reshape(self.t, chunks.shape[1])
         extended = np.concatenate([chunks, noise], axis=0)  # (U, s)
         W = gen_lagrange_coeffs(self.betas, self.alphas, self.p)  # (N, U)
         # int64 modular matmul: accumulate mod p chunk-wise to avoid overflow

@@ -1,0 +1,310 @@
+// fedml_native — C++ federated-learning client + field-kernel CLI.
+//
+// Capability parity with the reference's mobile C++ client
+// (android/fedmlsdk/MobileNN/src/train/FedMLMNNTrainer.cpp: on-device
+// training driven by a Python server) translated to TPU-world terms
+// (SURVEY.md §2.13): a non-Python process that speaks the pytree wire format
+// over the TCP transport, joins the cross-silo FedAvg protocol, trains a
+// softmax-regression model on its local shard with plain C++ loops, and
+// uploads weights + sample count.  Message-type integers match
+// fedml_tpu/cross_silo/message_define.py.
+//
+// Modes:
+//   fedml_native client --rank R --base-port P --data FILE
+//       [--host H --lr 0.1 --epochs 1 --batch 16]
+//   fedml_native fieldtest N T U S   (LightSecAgg kernel conformance; reads
+//       mask/noise ints on stdin, prints COEFFS/SHARES/DECODED — compared
+//       bit-exactly against trust/secagg by tests/test_native_client.py)
+//
+// Build: make -C native   (g++ -O2 -std=c++17, no external deps)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <random>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "lightsecagg.hpp"
+#include "wire.hpp"
+
+// message_define.py parity
+enum MsgType {
+  kInitConfig = 1,
+  kSyncModel = 2,
+  kSendModel = 3,
+  kClientStatus = 5,
+  kCheckStatus = 6,
+  kFinish = 7,
+  kFinished = 8,
+};
+
+// ---------------------------------------------------------------------------
+// TCP framing (comm/tcp_backend.py: [8B LE length][Message bytes])
+// ---------------------------------------------------------------------------
+static bool read_exact(int fd, uint8_t* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = read(fd, buf + got, n - got);
+    if (r <= 0) return false;
+    got += (size_t)r;
+  }
+  return true;
+}
+
+static bool read_frame(int fd, std::vector<uint8_t>* out) {
+  uint64_t len = 0;
+  if (!read_exact(fd, (uint8_t*)&len, 8)) return false;
+  out->resize(len);
+  return read_exact(fd, out->data(), len);
+}
+
+static void send_frame_to(const std::string& host, int port, const std::vector<uint8_t>& payload) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { perror("socket"); exit(1); }
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) { perror("connect"); exit(1); }
+  uint64_t len = payload.size();
+  std::vector<uint8_t> framed(8 + payload.size());
+  memcpy(framed.data(), &len, 8);
+  memcpy(framed.data() + 8, payload.data(), payload.size());
+  size_t sent = 0;
+  while (sent < framed.size()) {
+    ssize_t w = write(fd, framed.data() + sent, framed.size() - sent);
+    if (w <= 0) { perror("write"); exit(1); }
+    sent += (size_t)w;
+  }
+  close(fd);
+}
+
+// ---------------------------------------------------------------------------
+// Local shard: [u32 n][u32 d][u32 c][f32 x n*d][i32 y n]
+// ---------------------------------------------------------------------------
+struct Shard {
+  uint32_t n = 0, d = 0, c = 0;
+  std::vector<float> x;
+  std::vector<int32_t> y;
+};
+
+static Shard load_shard(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) { fprintf(stderr, "cannot open %s\n", path.c_str()); exit(1); }
+  Shard s;
+  f.read((char*)&s.n, 4); f.read((char*)&s.d, 4); f.read((char*)&s.c, 4);
+  s.x.resize((size_t)s.n * s.d);
+  s.y.resize(s.n);
+  f.read((char*)s.x.data(), (std::streamsize)s.x.size() * 4);
+  f.read((char*)s.y.data(), (std::streamsize)s.y.size() * 4);
+  if (!f) { fprintf(stderr, "short shard file %s\n", path.c_str()); exit(1); }
+  return s;
+}
+
+// ---------------------------------------------------------------------------
+// Softmax-regression local SGD (the on-device trainer role of
+// FedMLMNNTrainer.cpp, for the lr model: kernel (d, c) + bias (c))
+// ---------------------------------------------------------------------------
+static void train_softmax(const Shard& s, float* kernel, float* bias,
+                          float lr, int epochs, int batch, uint32_t seed) {
+  const uint32_t n = s.n, d = s.d, c = s.c;
+  std::mt19937 rng(seed);
+  std::vector<uint32_t> order(n);
+  for (uint32_t i = 0; i < n; ++i) order[i] = i;
+  std::vector<float> logits(c), probs(c), gk((size_t)d * c), gb(c);
+  for (int e = 0; e < epochs; ++e) {
+    std::shuffle(order.begin(), order.end(), rng);
+    for (uint32_t start = 0; start < n; start += (uint32_t)batch) {
+      const uint32_t end = std::min(n, start + (uint32_t)batch);
+      const float inv_b = 1.0f / (float)(end - start);
+      std::fill(gk.begin(), gk.end(), 0.0f);
+      std::fill(gb.begin(), gb.end(), 0.0f);
+      for (uint32_t bi = start; bi < end; ++bi) {
+        const float* xi = &s.x[(size_t)order[bi] * d];
+        const int32_t yi = s.y[order[bi]];
+        for (uint32_t j = 0; j < c; ++j) {
+          float acc = bias[j];
+          for (uint32_t k = 0; k < d; ++k) acc += xi[k] * kernel[(size_t)k * c + j];
+          logits[j] = acc;
+        }
+        float mx = logits[0];
+        for (uint32_t j = 1; j < c; ++j) mx = std::max(mx, logits[j]);
+        float z = 0.0f;
+        for (uint32_t j = 0; j < c; ++j) { probs[j] = std::exp(logits[j] - mx); z += probs[j]; }
+        for (uint32_t j = 0; j < c; ++j) {
+          const float g = probs[j] / z - (j == (uint32_t)yi ? 1.0f : 0.0f);
+          gb[j] += g;
+          for (uint32_t k = 0; k < d; ++k) gk[(size_t)k * c + j] += g * xi[k];
+        }
+      }
+      for (size_t i = 0; i < gk.size(); ++i) kernel[i] -= lr * inv_b * gk[i];
+      for (uint32_t j = 0; j < c; ++j) bias[j] -= lr * inv_b * gb[j];
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Client protocol
+// ---------------------------------------------------------------------------
+struct Args {
+  int rank = 1;
+  int base_port = 9690;
+  std::string host = "127.0.0.1";
+  std::string data;
+  float lr = 0.1f;
+  int epochs = 1;
+  int batch = 16;
+};
+
+static std::string control_json(int msg_type, int sender, int receiver,
+                                const std::string& extra_fields) {
+  std::ostringstream os;
+  os << "{\"msg_type\":" << msg_type << ",\"sender\":" << sender
+     << ",\"receiver\":" << receiver;
+  if (!extra_fields.empty()) os << "," << extra_fields;
+  os << "}";
+  return os.str();
+}
+
+static const std::string kEmptyBlobHeader =
+    "{\"version\":1,\"treedef\":{\"d\":{}},\"leaves\":[]}";
+
+static int run_client(const Args& a) {
+  Shard shard = load_shard(a.data);
+  // listen on base_port + rank
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons((uint16_t)(a.base_port + a.rank));
+  if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0) { perror("bind"); return 1; }
+  listen(lfd, 16);
+  fprintf(stderr, "[native-client %d] listening on %d\n", a.rank, a.base_port + a.rank);
+
+  bool done = false;
+  while (!done) {
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) break;
+    std::vector<uint8_t> frame;
+    while (read_frame(cfd, &frame)) {
+      wire::DecodedMessage msg = wire::decode_message(frame);
+      const int msg_type = (int)msg.control.at("msg_type").as_int();
+      if (msg_type == kCheckStatus) {
+        auto reply = wire::encode_message(
+            control_json(kClientStatus, a.rank, 0,
+                         "\"client_status\":\"ONLINE\",\"client_os\":\"cpp\""),
+            kEmptyBlobHeader, {});
+        send_frame_to(a.host, a.base_port + 0, reply);
+      } else if (msg_type == kInitConfig || msg_type == kSyncModel) {
+        const int64_t round_idx = msg.control.at("round_idx").as_int();
+        // locate the lr model's leaves generically: 2-D f32 -> kernel,
+        // 1-D f32 -> bias (shape validated against the shard)
+        float* kernel = nullptr;
+        float* bias = nullptr;
+        for (const wire::Leaf& leaf : msg.leaves) {
+          if (leaf.dtype != "<f4") continue;
+          float* buf = (float*)(msg.buffers.data() + leaf.offset);
+          if (leaf.shape.size() == 2 && leaf.shape[0] == (int64_t)shard.d &&
+              leaf.shape[1] == (int64_t)shard.c) kernel = buf;
+          if (leaf.shape.size() == 1 && leaf.shape[0] == (int64_t)shard.c) bias = buf;
+        }
+        if (!kernel || !bias) { fprintf(stderr, "model shape mismatch\n"); return 1; }
+        train_softmax(shard, kernel, bias, a.lr, a.epochs, a.batch,
+                      (uint32_t)(round_idx * 1000 + a.rank));
+        std::ostringstream extra;
+        extra << "\"num_samples\":" << shard.n << ",\"round_idx\":" << round_idx;
+        auto reply = wire::encode_message(
+            control_json(kSendModel, a.rank, 0, extra.str()),
+            msg.header_json, msg.buffers);  // same skeleton, trained buffers
+        send_frame_to(a.host, a.base_port + 0, reply);
+        fprintf(stderr, "[native-client %d] trained round %lld (n=%u)\n",
+                a.rank, (long long)round_idx, shard.n);
+      } else if (msg_type == kFinish) {
+        auto reply = wire::encode_message(
+            control_json(kFinished, a.rank, 0, ""), kEmptyBlobHeader, {});
+        send_frame_to(a.host, a.base_port + 0, reply);
+        done = true;
+        break;
+      }
+    }
+    close(cfd);
+  }
+  close(lfd);
+  fprintf(stderr, "[native-client %d] finished\n", a.rank);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// fieldtest: LightSecAgg kernel conformance (deterministic, no RNG)
+// ---------------------------------------------------------------------------
+static int run_fieldtest(int n, int t, int u, int s) {
+  const int k = u - t;
+  std::vector<int64_t> mask((size_t)k * s), noise((size_t)t * s);
+  for (auto& v : mask) std::cin >> v;
+  for (auto& v : noise) std::cin >> v;
+
+  std::vector<int64_t> alphas(u), betas(n);
+  for (int i = 0; i < u; ++i) alphas[i] = i + 1;
+  for (int i = 0; i < n; ++i) betas[i] = u + 1 + i;
+  auto W = lsa::gen_lagrange_coeffs(betas, alphas);
+  printf("COEFFS\n");
+  for (auto& row : W) {
+    for (size_t j = 0; j < row.size(); ++j) printf("%lld%c", (long long)row[j], j + 1 == row.size() ? '\n' : ' ');
+  }
+
+  auto shares = lsa::encode_mask(mask, noise, n, t, u);
+  printf("SHARES\n");
+  for (auto& row : shares) {
+    for (size_t j = 0; j < row.size(); ++j) printf("%lld%c", (long long)row[j], j + 1 == row.size() ? '\n' : ' ');
+  }
+
+  // single-mask scenario: survivors 0..u-1 aggregate just this mask's shares;
+  // decoding must reproduce the mask
+  std::vector<int> survivors(u);
+  for (int i = 0; i < u; ++i) survivors[i] = i;
+  std::vector<std::vector<int64_t>> agg;
+  for (int i = 0; i < u; ++i) agg.push_back(shares[i]);
+  auto decoded = lsa::decode_aggregate_mask(survivors, agg, t, u, mask.size());
+  printf("DECODED\n");
+  for (size_t j = 0; j < decoded.size(); ++j) printf("%lld%c", (long long)decoded[j], j + 1 == decoded.size() ? '\n' : ' ');
+  // also print a mod-inverse table for spot conformance
+  printf("INVERSES\n");
+  for (int64_t v : {int64_t{2}, int64_t{3}, int64_t{65537}, int64_t{123456789}, lsa::kPrime - 1}) {
+    printf("%lld %lld\n", (long long)v, (long long)lsa::mod_inverse(v));
+  }
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) { fprintf(stderr, "usage: %s client|fieldtest ...\n", argv[0]); return 2; }
+  std::string mode = argv[1];
+  if (mode == "fieldtest") {
+    if (argc != 6) { fprintf(stderr, "fieldtest N T U S\n"); return 2; }
+    return run_fieldtest(atoi(argv[2]), atoi(argv[3]), atoi(argv[4]), atoi(argv[5]));
+  }
+  Args a;
+  for (int i = 2; i + 1 < argc; i += 2) {
+    std::string k = argv[i], v = argv[i + 1];
+    if (k == "--rank") a.rank = atoi(v.c_str());
+    else if (k == "--base-port") a.base_port = atoi(v.c_str());
+    else if (k == "--host") a.host = v;
+    else if (k == "--data") a.data = v;
+    else if (k == "--lr") a.lr = (float)atof(v.c_str());
+    else if (k == "--epochs") a.epochs = atoi(v.c_str());
+    else if (k == "--batch") a.batch = atoi(v.c_str());
+  }
+  if (a.data.empty()) { fprintf(stderr, "--data required\n"); return 2; }
+  return run_client(a);
+}

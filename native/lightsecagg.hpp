@@ -1,0 +1,133 @@
+// LightSecAgg finite-field kernels — C++ mirror of the Python field math.
+//
+// Capability parity with the reference's only real native compute,
+// android/fedmlsdk/MobileNN/src/security/LightSecAgg.cpp:1-134 (modInverse,
+// modDivide, gen_Lagrange_coeffs, mask encode/decode), re-derived for the
+// fedml_tpu field layout (trust/secagg/field.py): prime M31 = 2^31 - 1,
+// int64 arithmetic so products never overflow, Fermat inverses.
+//
+// Conformance is asserted against the Python implementation by
+// tests/test_native_client.py (same alphas/betas/mask/noise in, same
+// coefficients / encoded shares / decoded mask out).
+
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <vector>
+
+namespace lsa {
+
+constexpr int64_t kPrime = (int64_t{1} << 31) - 1;  // M31, matches field.py
+
+inline int64_t mod_pow(int64_t base, int64_t exp, int64_t p = kPrime) {
+  int64_t result = 1;
+  base %= p;
+  if (base < 0) base += p;
+  while (exp > 0) {
+    if (exp & 1) result = (__int128)result * base % p;
+    base = (__int128)base * base % p;
+    exp >>= 1;
+  }
+  return result;
+}
+
+// Fermat inverse (p prime) — reference modInverse uses extended Euclid on
+// 32-bit ints; Fermat keeps the code branch-free and matches field.py.
+inline int64_t mod_inverse(int64_t a, int64_t p = kPrime) {
+  a %= p;
+  if (a < 0) a += p;
+  if (a == 0) throw std::domain_error("mod_inverse(0)");
+  return mod_pow(a, p - 2, p);
+}
+
+// (len(eval), len(interp)) Lagrange basis coefficients over F_p —
+// coeff[i][j] = prod_{k != j} (e_i - t_k) / (t_j - t_k)  (mod p).
+// Mirrors field.py gen_lagrange_coeffs / reference gen_Lagrange_coeffs.
+inline std::vector<std::vector<int64_t>> gen_lagrange_coeffs(
+    const std::vector<int64_t>& eval_points,
+    const std::vector<int64_t>& interp_points, int64_t p = kPrime) {
+  const size_t ne = eval_points.size(), nt = interp_points.size();
+  std::vector<std::vector<int64_t>> out(ne, std::vector<int64_t>(nt, 0));
+  for (size_t j = 0; j < nt; ++j) {
+    int64_t den = 1;
+    for (size_t k = 0; k < nt; ++k) {
+      if (k == j) continue;
+      int64_t d = (interp_points[j] - interp_points[k]) % p;
+      if (d < 0) d += p;
+      den = (__int128)den * d % p;
+    }
+    const int64_t den_inv = mod_inverse(den, p);
+    for (size_t i = 0; i < ne; ++i) {
+      int64_t num = 1;
+      for (size_t k = 0; k < nt; ++k) {
+        if (k == j) continue;
+        int64_t d = (eval_points[i] - interp_points[k]) % p;
+        if (d < 0) d += p;
+        num = (__int128)num * d % p;
+      }
+      out[i][j] = (__int128)num * den_inv % p;
+    }
+  }
+  return out;
+}
+
+// Encode a padded mask (length divisible by (u - t)) plus t noise chunks into
+// n per-client shares: shares = W @ [chunks; noise] (mod p), W the (n, u)
+// Lagrange matrix from betas to alphas.  Noise is an explicit argument (the
+// Python side draws it from its own RNG) so the kernel is deterministic and
+// conformance-testable.
+inline std::vector<std::vector<int64_t>> encode_mask(
+    const std::vector<int64_t>& mask, const std::vector<int64_t>& noise,
+    int n, int t, int u, int64_t p = kPrime) {
+  const int k = u - t;
+  if (mask.size() % k != 0) throw std::invalid_argument("mask not padded to u-t");
+  const size_t s = mask.size() / k;
+  if (noise.size() != (size_t)t * s) throw std::invalid_argument("noise must be t*s");
+  std::vector<int64_t> alphas(u), betas(n);
+  for (int i = 0; i < u; ++i) alphas[i] = i + 1;
+  for (int i = 0; i < n; ++i) betas[i] = u + 1 + i;
+  auto W = gen_lagrange_coeffs(betas, alphas, p);  // (n, u)
+  std::vector<std::vector<int64_t>> out(n, std::vector<int64_t>(s, 0));
+  for (int row = 0; row < n; ++row) {
+    for (int j = 0; j < u; ++j) {
+      const int64_t w = W[row][j];
+      const int64_t* chunk = (j < k) ? &mask[(size_t)j * s] : &noise[(size_t)(j - k) * s];
+      for (size_t c = 0; c < s; ++c) {
+        out[row][c] = (out[row][c] + (__int128)w * chunk[c]) % p;
+      }
+    }
+  }
+  return out;
+}
+
+// Server-side one-shot decode: interpolate the sum of masks from >= u
+// survivors' aggregated shares.  survivors are 0-based client indices;
+// agg_shares[i] is survivor i's aggregate (length s).  Returns d_pad values.
+inline std::vector<int64_t> decode_aggregate_mask(
+    const std::vector<int>& survivors,
+    const std::vector<std::vector<int64_t>>& agg_shares,
+    int t, int u, size_t d_pad, int64_t p = kPrime) {
+  if ((int)survivors.size() < u) throw std::invalid_argument("need >= u survivors");
+  const int k = u - t;
+  const size_t s = agg_shares.at(0).size();
+  std::vector<int64_t> alphas(k), eval_pts(u);
+  for (int i = 0; i < k; ++i) alphas[i] = i + 1;
+  for (int i = 0; i < u; ++i) eval_pts[i] = u + 1 + survivors[i];
+  auto W = gen_lagrange_coeffs(alphas, eval_pts, p);  // (k, u)
+  std::vector<int64_t> out((size_t)k * s, 0);
+  for (int row = 0; row < k; ++row) {
+    for (int col = 0; col < u; ++col) {
+      const int64_t w = W[row][col];
+      const auto& share = agg_shares[col];
+      for (size_t c = 0; c < s; ++c) {
+        int64_t& o = out[(size_t)row * s + c];
+        o = (o + (__int128)w * share[c]) % p;
+      }
+    }
+  }
+  out.resize(d_pad);
+  return out;
+}
+
+}  // namespace lsa

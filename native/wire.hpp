@@ -1,0 +1,221 @@
+// Pytree wire format + cross-silo Message framing — C++ side.
+//
+// Speaks exactly the bytes of fedml_tpu/comm/wire.py and comm/message.py:
+//
+//   Message  = [4B LE control_len][control JSON][pytree blob]
+//   blob     = [4B LE header_len][header JSON][raw LE buffers...]
+//   header   = {"version":1, "treedef":skel, "leaves":[{dtype,shape,nbytes}]}
+//
+// and the TCP transport framing of comm/tcp_backend.py:
+//
+//   frame    = [8B LE frame_len][Message bytes]
+//
+// Capability parity: the reference's C++ mobile client serializes models with
+// MNN buffers + MQTT (android/fedmlsdk/MobileNN/src/train/FedMLMNNTrainer.cpp);
+// here the contract is the language-neutral pytree layout, designed for this
+// exact purpose (SURVEY.md §7 hard part 6).
+//
+// The client never rebuilds the treedef: replies carry the SAME tensor
+// skeleton as the incoming global model, so the received header JSON is
+// reused verbatim and only leaf buffers are swapped.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace wire {
+
+// ---------------------------------------------------------------------------
+// Minimal JSON parser (objects/arrays/strings/numbers/bools/null) — enough
+// for the wire headers, which Python emits with json.dumps(separators=(",",":"))
+// ---------------------------------------------------------------------------
+struct Json {
+  enum Type { Null, Bool, Int, Dbl, Str, Arr, Obj } type = Null;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  const Json& at(const std::string& key) const {
+    auto it = obj.find(key);
+    if (it == obj.end()) throw std::out_of_range("json key: " + key);
+    return it->second;
+  }
+  bool has(const std::string& key) const { return obj.count(key) > 0; }
+  int64_t as_int() const { return type == Dbl ? (int64_t)d : i; }
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& text) : t_(text) {}
+  Json parse() {
+    Json v = value();
+    ws();
+    if (pos_ != t_.size()) throw std::runtime_error("trailing json");
+    return v;
+  }
+
+ private:
+  const std::string& t_;
+  size_t pos_ = 0;
+
+  void ws() { while (pos_ < t_.size() && isspace((unsigned char)t_[pos_])) ++pos_; }
+  char peek() { ws(); if (pos_ >= t_.size()) throw std::runtime_error("eof"); return t_[pos_]; }
+  char next() { char c = peek(); ++pos_; return c; }
+  void expect(char c) { if (next() != c) throw std::runtime_error(std::string("expected ") + c); }
+
+  Json value() {
+    switch (peek()) {
+      case '{': return object();
+      case '[': return array();
+      case '"': { Json v; v.type = Json::Str; v.s = string(); return v; }
+      case 't': lit("true"); { Json v; v.type = Json::Bool; v.b = true; return v; }
+      case 'f': lit("false"); { Json v; v.type = Json::Bool; v.b = false; return v; }
+      case 'n': lit("null"); return Json{};
+      default: return number();
+    }
+  }
+  void lit(const char* w) { ws(); size_t n = strlen(w);
+    if (t_.compare(pos_, n, w) != 0) throw std::runtime_error("bad literal");
+    pos_ += n; }
+  Json object() {
+    expect('{'); Json v; v.type = Json::Obj;
+    if (peek() == '}') { ++pos_; return v; }
+    while (true) {
+      std::string k = string();
+      expect(':');
+      v.obj[k] = value();
+      char c = next();
+      if (c == '}') return v;
+      if (c != ',') throw std::runtime_error("bad object");
+    }
+  }
+  Json array() {
+    expect('['); Json v; v.type = Json::Arr;
+    if (peek() == ']') { ++pos_; return v; }
+    while (true) {
+      v.arr.push_back(value());
+      char c = next();
+      if (c == ']') return v;
+      if (c != ',') throw std::runtime_error("bad array");
+    }
+  }
+  std::string string() {
+    expect('"'); std::string out;
+    while (true) {
+      if (pos_ >= t_.size()) throw std::runtime_error("eof in string");
+      char c = t_[pos_++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = t_[pos_++];
+        switch (e) {
+          case 'n': out += '\n'; break; case 't': out += '\t'; break;
+          case 'r': out += '\r'; break; case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break; case '/': out += '/'; break;
+          case '"': out += '"'; break; case '\\': out += '\\'; break;
+          case 'u': { // basic BMP escape
+            unsigned cp = std::stoul(t_.substr(pos_, 4), nullptr, 16); pos_ += 4;
+            if (cp < 0x80) out += (char)cp;
+            else if (cp < 0x800) { out += (char)(0xC0 | (cp >> 6)); out += (char)(0x80 | (cp & 0x3F)); }
+            else { out += (char)(0xE0 | (cp >> 12)); out += (char)(0x80 | ((cp >> 6) & 0x3F)); out += (char)(0x80 | (cp & 0x3F)); }
+            break; }
+          default: throw std::runtime_error("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+  Json number() {
+    ws();
+    size_t start = pos_;
+    if (t_[pos_] == '-') ++pos_;
+    bool is_int = true;
+    while (pos_ < t_.size() && (isdigit((unsigned char)t_[pos_]) || strchr(".eE+-", t_[pos_]))) {
+      if (t_[pos_] == '.' || t_[pos_] == 'e' || t_[pos_] == 'E') is_int = false;
+      ++pos_;
+    }
+    Json v;
+    std::string tok = t_.substr(start, pos_ - start);
+    if (is_int) { v.type = Json::Int; v.i = std::stoll(tok); }
+    else { v.type = Json::Dbl; v.d = std::stod(tok); }
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Message frame codec
+// ---------------------------------------------------------------------------
+struct Leaf {
+  std::string dtype;        // numpy dtype str, e.g. "<f4"
+  std::vector<int64_t> shape;
+  size_t nbytes = 0;
+  size_t offset = 0;        // into the original frame buffer region
+};
+
+struct DecodedMessage {
+  Json control;              // msg_type/sender/receiver/round_idx/...
+  std::string header_json;   // the blob header, verbatim (reused in replies)
+  std::vector<Leaf> leaves;
+  std::vector<uint8_t> buffers;  // concatenated raw leaf bytes
+};
+
+inline uint32_t read_u32(const uint8_t* p) {
+  uint32_t v; memcpy(&v, p, 4); return v;  // little-endian hosts only
+}
+
+inline DecodedMessage decode_message(const std::vector<uint8_t>& frame) {
+  if (frame.size() < 4) throw std::runtime_error("short frame");
+  const uint32_t clen = read_u32(frame.data());
+  std::string control_json(frame.begin() + 4, frame.begin() + 4 + clen);
+  size_t off = 4 + clen;
+  const uint32_t hlen = read_u32(frame.data() + off);
+  std::string header_json(frame.begin() + off + 4, frame.begin() + off + 4 + hlen);
+  off += 4 + hlen;
+
+  DecodedMessage out;
+  out.control = JsonParser(control_json).parse();
+  out.header_json = header_json;
+  Json header = JsonParser(header_json).parse();
+  if (header.at("version").as_int() != 1) throw std::runtime_error("wire version");
+  size_t rel = 0;
+  for (const Json& spec : header.at("leaves").arr) {
+    Leaf leaf;
+    leaf.dtype = spec.at("dtype").s;
+    for (const Json& dim : spec.at("shape").arr) leaf.shape.push_back(dim.as_int());
+    leaf.nbytes = (size_t)spec.at("nbytes").as_int();
+    leaf.offset = rel;
+    rel += leaf.nbytes;
+    out.leaves.push_back(std::move(leaf));
+  }
+  out.buffers.assign(frame.begin() + off, frame.end());
+  if (out.buffers.size() != rel) throw std::runtime_error("buffer size mismatch");
+  return out;
+}
+
+// Build a reply whose tensor skeleton equals the incoming one (header JSON
+// reused verbatim); control is a flat JSON object the caller provides.
+inline std::vector<uint8_t> encode_message(const std::string& control_json,
+                                           const std::string& header_json,
+                                           const std::vector<uint8_t>& buffers) {
+  std::vector<uint8_t> out;
+  auto put_u32 = [&out](uint32_t v) {
+    uint8_t b[4]; memcpy(b, &v, 4); out.insert(out.end(), b, b + 4);
+  };
+  put_u32((uint32_t)control_json.size());
+  out.insert(out.end(), control_json.begin(), control_json.end());
+  put_u32((uint32_t)header_json.size());
+  out.insert(out.end(), header_json.begin(), header_json.end());
+  out.insert(out.end(), buffers.begin(), buffers.end());
+  return out;
+}
+
+}  // namespace wire

@@ -1,0 +1,149 @@
+"""C++ polyglot client + native LightSecAgg kernel conformance (VERDICT
+item 5, SURVEY.md §2.13).
+
+The native binary (``native/fedml_native``) must
+1. reproduce the Python finite-field kernels bit-exactly, and
+2. complete a real multi-round FedAvg run against the Python cross-silo
+   server over the TCP transport, training softmax regression in C++.
+"""
+
+import os
+import shutil
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO, "native")
+_BINARY = os.path.join(_NATIVE_DIR, "fedml_native")
+
+
+@pytest.fixture(scope="module")
+def native_binary():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in environment")
+    res = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert os.path.exists(_BINARY)
+    return _BINARY
+
+
+def test_field_kernel_conformance(native_binary):
+    """COEFFS/SHARES/DECODED/INVERSES from C++ == trust/secagg math."""
+    from fedml_tpu.trust.secagg.field import DEFAULT_PRIME, gen_lagrange_coeffs, mod_inverse
+    from fedml_tpu.trust.secagg.lightsecagg import LightSecAggProtocol
+
+    n, t, u, s = 5, 2, 3, 4
+    k = u - t
+    rng = np.random.RandomState(7)
+    mask = rng.randint(0, DEFAULT_PRIME, size=k * s, dtype=np.int64)
+    noise = rng.randint(0, DEFAULT_PRIME, size=t * s, dtype=np.int64)
+
+    stdin = " ".join(map(str, mask.tolist() + noise.tolist()))
+    res = subprocess.run(
+        [native_binary, "fieldtest", str(n), str(t), str(u), str(s)],
+        input=stdin, capture_output=True, text=True, timeout=60,
+    )
+    assert res.returncode == 0, res.stderr
+    sections: dict[str, list[list[int]]] = {}
+    current = None
+    for line in res.stdout.splitlines():
+        if line in ("COEFFS", "SHARES", "DECODED", "INVERSES"):
+            current = line
+            sections[current] = []
+        elif line.strip():
+            sections[current].append([int(v) for v in line.split()])
+
+    proto = LightSecAggProtocol(n, t, u)
+    W_py = gen_lagrange_coeffs(proto.betas, proto.alphas)
+    np.testing.assert_array_equal(np.array(sections["COEFFS"]), W_py)
+
+    shares_py = proto.encode_mask(mask, noise=noise)
+    np.testing.assert_array_equal(np.array(sections["SHARES"]), shares_py)
+
+    # single-mask decode must return the mask itself (both languages)
+    decoded_cpp = np.array(sections["DECODED"]).ravel()
+    np.testing.assert_array_equal(decoded_cpp, mask)
+    agg = {i: shares_py[i] for i in range(u)}
+    decoded_py = proto.decode_aggregate_mask(agg, len(mask))
+    np.testing.assert_array_equal(decoded_cpp, decoded_py)
+
+    for v, inv in sections["INVERSES"]:
+        assert inv == mod_inverse(v), v
+
+
+def _write_shard(path, x, y):
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    y = np.ascontiguousarray(y, dtype=np.int32)
+    c = int(y.max()) + 1
+    with open(path, "wb") as f:
+        f.write(struct.pack("<III", x.shape[0], x.shape[1], max(c, 10)))
+        f.write(x.tobytes())
+        f.write(y.tobytes())
+
+
+def _wait_listening(port, timeout=15.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        with socket.socket() as s:
+            s.settimeout(0.2)
+            try:
+                s.connect(("127.0.0.1", port))
+                return True
+            except OSError:
+                time.sleep(0.1)
+    return False
+
+
+def test_cpp_client_completes_fedavg_rounds(native_binary, tmp_path, eight_devices):
+    """Two C++ clients + the Python server complete a 3-round FedAvg run over
+    TCP; accuracy improves, proving the C++ side genuinely trains."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo import build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    base_port = 21690
+    cfg = tiny_config(
+        client_num_in_total=2, client_num_per_round=2, comm_round=3,
+        batch_size=16, synthetic_train_size=320, synthetic_test_size=160,
+        frequency_of_the_test=1,
+        extra={"tcp_base_port": base_port},
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+
+    procs = []
+    try:
+        for rank in (1, 2):
+            shard_path = tmp_path / f"shard_{rank}.bin"
+            ix = ds.client_idx[rank - 1]
+            _write_shard(shard_path, ds.train_x[ix].reshape(len(ix), -1), ds.train_y[ix])
+            procs.append(subprocess.Popen(
+                [native_binary, "client", "--rank", str(rank),
+                 "--base-port", str(base_port), "--data", str(shard_path),
+                 "--lr", "0.3", "--epochs", "1", "--batch", "16"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+        for rank in (1, 2):
+            assert _wait_listening(base_port + rank), f"client {rank} never bound"
+
+        server = build_server(cfg, ds, model, backend="TCP")
+        history = server.run_until_done(timeout=120.0)
+        assert len(history) == 3
+        accs = [h["test_acc"] for h in history if "test_acc" in h]
+        assert accs[-1] > 0.35, accs  # C++ SGD genuinely learned
+        for p in procs:
+            assert p.wait(timeout=20) == 0, p.stderr.read()[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
