@@ -47,6 +47,7 @@ FEDERATED_OPTIMIZER_VERTICAL_FL = "vertical_fl"
 FEDERATED_OPTIMIZER_SPLIT_NN = "split_nn"
 FEDERATED_OPTIMIZER_FEDGKT = "FedGKT"
 FEDERATED_OPTIMIZER_FEDNAS = "FedNAS"
+FEDERATED_OPTIMIZER_FEDSEG = "FedSeg"
 
 # Communication backends (reference: fedml_comm_manager.py:133-207)
 COMM_BACKEND_INPROC = "INPROC"  # loopback fake for tests (new; SURVEY.md §4)
@@ -60,6 +61,8 @@ COMM_BACKEND_MPI = "MPI"
 ENGINE_JAX = "jax"
 
 # Dataset names understood by fedml_tpu.data.load (reference data_loader.py:262-530)
-DATASETS_IMAGE = ("mnist", "femnist", "cifar10", "cifar100", "cinic10", "fashionmnist")
-DATASETS_TEXT = ("shakespeare", "fed_shakespeare", "stackoverflow_lr", "stackoverflow_nwp")
+DATASETS_IMAGE = ("mnist", "femnist", "cifar10", "cifar100", "cinic10", "fashionmnist",
+                  "gld23k", "gld160k")
+DATASETS_TEXT = ("shakespeare", "fed_shakespeare", "stackoverflow_nwp", "reddit")
+DATASETS_VECTOR = ("stackoverflow_lr", "lending_club")
 DATASET_SYNTHETIC = "synthetic"

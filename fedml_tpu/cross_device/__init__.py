@@ -1,0 +1,77 @@
+"""Cross-device platform ("BeeHive" in the reference) — the server side of
+phone-fleet FL.
+
+Parity with ``cross_device/server_mnn/fedml_server_manager.py:14``: a Python
+server drives NON-Python device clients.  The reference serializes the
+global model to MNN files (``write_tensor_dict_to_mnn``) and talks MQTT to
+Android's C++ MobileNN trainer; the TPU build's devices speak the pytree
+wire format over the TCP transport, and the reference's C++ trainer role is
+filled by ``native/fedml_client.cpp`` (proven in CI by
+tests/test_native_client.py + tests/test_cross_device.py).
+
+The round protocol is the shared cross-silo one (message_define.py) — the
+reference's cross-device server duplicates the cross-silo flow with MNN
+serialization bolted on; here one server implementation serves both
+platforms and only the transport/client language differ.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+from ..cross_silo import build_aggregator
+from ..cross_silo.server import FedMLServerManager
+
+
+class ServerMNN(FedMLServerManager):
+    """Cross-device server: cross-silo protocol + per-round global-model
+    artifact dump (the reference's ``global_model_file_path`` MNN file,
+    here the wire format every client language reads)."""
+
+    def __init__(self, cfg, aggregator, backend: Optional[str] = None, logger=None):
+        super().__init__(cfg, aggregator, backend=backend, logger=logger)
+        extra = getattr(cfg, "extra", {}) or {}
+        self.global_model_file_path = extra.get("global_model_file_path", "")
+
+    def _write_model_artifact(self) -> None:
+        if not self.global_model_file_path:
+            return
+        import jax
+
+        from ..comm import wire
+
+        path = Path(self.global_model_file_path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(wire.encode_pytree(jax.device_get(self.aggregator.global_vars)))
+
+    def _broadcast_model(self, msg_type: int) -> None:
+        self._write_model_artifact()
+        super()._broadcast_model(msg_type)
+
+
+def build_cross_device_server(cfg, dataset, model, backend: Optional[str] = None) -> ServerMNN:
+    """TCP is the default device transport (phones connect as wire-speaking
+    native clients)."""
+    aggregator = build_aggregator(cfg, dataset, model)
+    return ServerMNN(cfg, aggregator, backend=backend or "TCP")
+
+
+class _CrossDeviceRunner:
+    def __init__(self, cfg, dataset, model):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+
+    def run(self):
+        # simulation-default backends ('', MESH, INPROC) have no meaning for
+        # a device fleet — fall through to the TCP device transport
+        backend = self.cfg.backend if self.cfg.backend not in ("", "MESH", "INPROC") else None
+        server = build_cross_device_server(self.cfg, self.dataset, self.model,
+                                           backend=backend)
+        timeout = float((getattr(self.cfg, "extra", {}) or {}).get("cross_device_timeout_s", 600.0))
+        return server.run_until_done(timeout=timeout)
+
+
+def create_cross_device_runner(cfg, dataset, model):
+    return _CrossDeviceRunner(cfg, dataset, model)

@@ -28,7 +28,7 @@ def _client_shard(dataset, client_idx: int):
     return dataset.train_x[ix], dataset.train_y[ix]
 
 
-def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None) -> FedMLServerManager:
+def build_aggregator(cfg, dataset, model, trust=None) -> FedMLAggregator:
     eval_bs = min(256, max(32, cfg.test_batch_size))
     test_arrays = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
     sample_x = dataset.train_x[: cfg.batch_size]
@@ -36,7 +36,11 @@ def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None)
         from ..trust.pipeline import build_trust_pipeline
 
         trust = build_trust_pipeline(cfg)
-    aggregator = FedMLAggregator(cfg, model, sample_x, test_arrays, trust=trust)
+    return FedMLAggregator(cfg, model, sample_x, test_arrays, trust=trust)
+
+
+def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None) -> FedMLServerManager:
+    aggregator = build_aggregator(cfg, dataset, model, trust=trust)
     return FedMLServerManager(cfg, aggregator, backend=backend)
 
 

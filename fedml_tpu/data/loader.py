@@ -15,12 +15,15 @@ Returns a :class:`~fedml_tpu.data.dataset.FederatedDataset`; use
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
 import zlib
 from pathlib import Path
 
 import numpy as np
+
+log = logging.getLogger("fedml_tpu.data.loader")
 
 from ..arguments import Config
 from . import partition as part
@@ -35,6 +38,15 @@ _DATASET_SPECS = {
     "cifar100": ((32, 32, 3), 100, 50000, 10000),
     "cinic10": ((32, 32, 3), 10, 90000, 90000),
     "synthetic": ((60,), 10, 20000, 4000),
+    # federated Google Landmarks (reference data/fed_gld/data_loader.py):
+    # 23k/160k images over 203/2028 landmark classes, resized 96x96
+    "gld23k": ((96, 96, 3), 203, 23080, 2316),
+    "gld160k": ((96, 96, 3), 2028, 164172, 14663),
+    # StackOverflow tag prediction as bag-of-words logistic regression
+    # (reference data/stackoverflow_lr/data_loader.py: 10k vocab, 500 tags)
+    "stackoverflow_lr": ((10000,), 500, 50000, 10000),
+    # Lending Club loan-status table (reference VFL finance example)
+    "lending_club": ((200,), 2, 50000, 10000),
 }
 
 _TEXT_SPECS = {
@@ -42,7 +54,18 @@ _TEXT_SPECS = {
     "shakespeare": (80, 90),
     "fed_shakespeare": (80, 90),
     "stackoverflow_nwp": (20, 10004),
+    # reddit next-word prediction (reference data/reddit/data_loader.py)
+    "reddit": (20, 10000),
 }
+
+
+def dataset_spec(name: str):
+    """Public accessor for a dense dataset's (feat_shape, classes, n_train,
+    n_test) spec, applying the same name normalization as :func:`load`;
+    None for text/unknown datasets.  Consumers (model_hub's small-input stem
+    selection) must use this, not the private table, so the normalization
+    contract lives in one place."""
+    return _DATASET_SPECS.get(name.lower())
 
 
 def load(cfg: Config) -> FederatedDataset:
@@ -69,6 +92,20 @@ def _load_image_like(cfg: Config, name: str) -> FederatedDataset:
             raise FileNotFoundError(f"{name} not found under {cache} and synthetic_fallback=False")
         n_train = cfg.synthetic_train_size or n_train
         n_test = cfg.synthetic_test_size or n_test
+        # cap the stand-in at ~2e8 float32 elements (~800 MB): gld160k's
+        # real-size default (164k x 96x96x3 ≈ 18 GB + temporaries) would OOM
+        # the host, and a synthetic stand-in gains nothing from that scale
+        feat_elems = int(np.prod(feat))
+        cap = max(1, int(2e8) // max(feat_elems, 1))
+        if n_train > cap:
+            log.warning("%s synthetic fallback capped at %d samples (was %d)", name, cap, n_train)
+            n_train = cap
+        # test set capped independently (a spec-default test set can be the
+        # OOM source even when the train size was set small explicitly)
+        test_cap = max(cap // 5, 1)
+        if n_test > test_cap:
+            log.warning("%s synthetic test set capped at %d samples (was %d)", name, test_cap, n_test)
+            n_test = test_cap
         arrays = _synthetic_classification(name, feat, classes, n_train, n_test, cfg.random_seed)
     train_x, train_y, test_x, test_y = arrays
     idx_map = part.partition(
@@ -162,7 +199,7 @@ def _synthetic_classification(name, feat, classes, n_train, n_test, seed):
 def _load_text_like(cfg: Config, name: str) -> FederatedDataset:
     seq_len, vocab = _TEXT_SPECS[name]
     cache = Path(os.path.expanduser(cfg.data_cache_dir))
-    leaf = _try_load_leaf_text(name, cache, seq_len)
+    leaf = _try_load_leaf_text(name, cache, seq_len, vocab)
     if leaf is not None:
         train_x, train_y, test_x, test_y, client_idx = leaf
     else:
@@ -198,14 +235,24 @@ def _load_text_like(cfg: Config, name: str) -> FederatedDataset:
     )
 
 
-def _try_load_leaf_text(name: str, cache: Path, seq_len: int):
-    """LEAF json reader (reference ``data/fed_shakespeare`` format):
-    ``{"users": [...], "user_data": {user: {"x": [...], "y": [...]}}}``."""
+def _try_load_leaf_text(name: str, cache: Path, seq_len: int, vocab: int = 0):
+    """LEAF json reader (``{"users": [...], "user_data": {user: {"x": ...,
+    "y": ...}}}``).  Two encodings by task type:
+
+    - char-level (shakespeare family, reference ``data/fed_shakespeare``):
+      fixed character table, next-char targets;
+    - word-level (reddit / stackoverflow_nwp, reference ``data/reddit``):
+      whitespace tokens hash-bucketed into [1, vocab) (a fixed hashing
+      vocabulary instead of the reference's shipped vocab file — zero-egress
+      equivalent), next-word targets.  The char table CANNOT represent a 10k
+      vocab, so word datasets must never take the char path.
+    """
     d = cache / name
     train_file = next(iter(sorted((d / "train").glob("*.json"))), None) if d.is_dir() else None
     test_file = next(iter(sorted((d / "test").glob("*.json"))), None) if d.is_dir() else None
     if train_file is None or test_file is None:
         return None
+    word_level = name in ("reddit", "stackoverflow_nwp")
     CHARS = sorted(set(
         "\n !\"&'(),-.0123456789:;>?ABCDEFGHIJKLMNOPQRSTUVWXYZ[]abcdefghijklmnopqrstuvwxyz}"
     ))
@@ -217,6 +264,24 @@ def _try_load_leaf_text(name: str, cache: Path, seq_len: int):
             arr[i] = table.get(c, 0)
         return arr
 
+    def word_id(tok: str) -> int:
+        return 1 + (zlib.crc32(tok.encode()) % (vocab - 1))
+
+    def encode_words(tokens):
+        arr = np.zeros(seq_len, np.int32)
+        for i, t in enumerate(tokens[:seq_len]):
+            arr[i] = word_id(t)
+        return arr
+
+    def _tokens(sample):
+        # LEAF reddit x is a list of token lists (sentences) or a string
+        if isinstance(sample, str):
+            return sample.split()
+        flat = []
+        for part_ in sample:
+            flat.extend(part_ if isinstance(part_, list) else str(part_).split())
+        return flat
+
     def load_split(path):
         with open(path) as f:
             data = json.load(f)
@@ -224,8 +289,14 @@ def _try_load_leaf_text(name: str, cache: Path, seq_len: int):
         for u in data["users"]:
             ud = data["user_data"][u]
             for sx, sy in zip(ud["x"], ud["y"]):
-                xs.append(encode(sx))
-                ys.append(encode(sx[1:] + sy))
+                if word_level:
+                    tx = _tokens(sx)
+                    ty = _tokens(sy) if sy else []
+                    xs.append(encode_words(tx))
+                    ys.append(encode_words(tx[1:] + ty[:1]))  # next-word shift
+                else:
+                    xs.append(encode(sx))
+                    ys.append(encode(sx[1:] + sy))
                 users.append(u)
         return np.stack(xs), np.stack(ys), users
 

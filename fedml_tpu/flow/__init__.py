@@ -1,0 +1,3 @@
+from .flow import FedMLAlgorithmFlow, FedMLExecutor, Params
+
+__all__ = ["FedMLAlgorithmFlow", "FedMLExecutor", "Params"]

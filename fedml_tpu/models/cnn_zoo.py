@@ -1,0 +1,222 @@
+"""CNN zoo breadth: MobileNet v1/v3, EfficientNet, VGG.
+
+Parity targets from the reference model hub (``model/model_hub.py:66-73``:
+``mobilenet`` -> ``model/cv/mobilenet.py``, ``mobilenet_v3`` ->
+``mobilenet_v3.py``, ``efficientnet`` -> ``efficientnet.py``; VGG from
+``model/cv/vgg.py``) re-derived in flax from the published architectures.
+
+TPU notes: convs run in the configured compute dtype (bf16 by default via
+model_hub) so the MXU sees bf16 systolic matmuls; normalization statistics
+stay f32 inside flax's BatchNorm/GroupNorm.  Small-input datasets (CIFAR
+32x32) use stride-1 stems — the standard CIFAR adaptation — selected by
+``small_input``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _norm(norm: str, dtype, train: bool):
+    """Norm factory; BatchNorm follows resnet.py's convention
+    (use_running_average=not train — stats update during training, the
+    batch_stats collection is mutable in the trainer)."""
+    if norm == "group":
+        return lambda name=None: nn.GroupNorm(num_groups=8, dtype=dtype, name=name)
+    return lambda name=None: nn.BatchNorm(
+        use_running_average=not train, momentum=0.9, dtype=dtype, name=name)
+
+
+class DepthwiseSeparable(nn.Module):
+    """MobileNetV1 block: 3x3 depthwise + 1x1 pointwise (Howard et al.)."""
+
+    features: int
+    stride: int
+    norm: str
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        make_norm = _norm(self.norm, self.dtype, train)
+        c_in = x.shape[-1]
+        x = nn.Conv(c_in, (3, 3), strides=self.stride, padding="SAME",
+                    feature_group_count=c_in, use_bias=False, dtype=self.dtype)(x)
+        x = make_norm()(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = make_norm()(x)
+        return nn.relu(x)
+
+
+class MobileNetV1(nn.Module):
+    """Reference ``model/cv/mobilenet.py`` (width 1.0)."""
+
+    num_classes: int
+    norm: str = "batch"
+    dtype: Any = jnp.float32
+    small_input: bool = True  # CIFAR stem
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        make_norm = _norm(self.norm, self.dtype, train)
+        x = x.astype(self.dtype)
+        stem_stride = 1 if self.small_input else 2
+        x = nn.Conv(32, (3, 3), strides=stem_stride, padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(make_norm()(x))
+        plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2)] \
+            + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
+        for feats, stride in plan:
+            x = DepthwiseSeparable(feats, stride, self.norm, self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(x.shape[-1] // self.reduce, 4), dtype=self.dtype)(s))
+        s = nn.sigmoid(nn.Dense(x.shape[-1], dtype=self.dtype)(s))
+        return x * s[:, None, None, :]
+
+
+class MBConv(nn.Module):
+    """Inverted residual with optional SE — the shared block of MobileNetV3
+    and EfficientNet (Sandler et al. / Tan & Le)."""
+
+    features: int
+    expand: int
+    kernel: int
+    stride: int
+    use_se: bool
+    norm: str
+    dtype: Any
+    activation: str = "relu"  # "relu" | "hswish" | "swish"
+
+    def _act(self, x):
+        if self.activation == "hswish":
+            return x * nn.relu6(x + 3.0) / 6.0
+        if self.activation == "swish":
+            return nn.swish(x)
+        return nn.relu(x)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        make_norm = _norm(self.norm, self.dtype, train)
+        c_in = x.shape[-1]
+        h = x
+        mid = c_in * self.expand
+        if self.expand != 1:
+            h = self._act(make_norm()(nn.Conv(mid, (1, 1), use_bias=False, dtype=self.dtype)(h)))
+        h = nn.Conv(mid, (self.kernel, self.kernel), strides=self.stride, padding="SAME",
+                    feature_group_count=mid, use_bias=False, dtype=self.dtype)(h)
+        h = self._act(make_norm()(h))
+        if self.use_se:
+            h = SqueezeExcite(4, self.dtype)(h)
+        h = make_norm()(nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(h))
+        if self.stride == 1 and c_in == self.features:
+            h = h + x
+        return h
+
+
+class MobileNetV3Small(nn.Module):
+    """Reference ``model/cv/mobilenet_v3.py`` ('small' profile)."""
+
+    num_classes: int
+    norm: str = "batch"
+    dtype: Any = jnp.float32
+    small_input: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        make_norm = _norm(self.norm, self.dtype, train)
+        x = x.astype(self.dtype)
+        stem_stride = 1 if self.small_input else 2
+        x = nn.Conv(16, (3, 3), strides=stem_stride, padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = make_norm()(x)
+        x = x * nn.relu6(x + 3.0) / 6.0
+        # (features, expand, kernel, stride, se, act)
+        plan = [
+            (16, 1, 3, 2, True, "relu"),
+            (24, 4, 3, 2, False, "relu"),
+            (24, 3, 3, 1, False, "relu"),
+            (40, 3, 5, 2, True, "hswish"),
+            (40, 3, 5, 1, True, "hswish"),
+            (48, 3, 5, 1, True, "hswish"),
+            (96, 6, 5, 2, True, "hswish"),
+            (96, 6, 5, 1, True, "hswish"),
+        ]
+        for feats, expand, kernel, stride, se, act in plan:
+            x = MBConv(feats, expand, kernel, stride, se, self.norm, self.dtype, act)(x, train)
+        x = nn.Conv(576, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = make_norm()(x)
+        x = x * nn.relu6(x + 3.0) / 6.0
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(1024, dtype=self.dtype)(x)
+        x = x * nn.relu6(x + 3.0) / 6.0
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class EfficientNetB0(nn.Module):
+    """Reference ``model/cv/efficientnet.py`` (B0 profile, MBConv + SE +
+    swish)."""
+
+    num_classes: int
+    norm: str = "batch"
+    dtype: Any = jnp.float32
+    small_input: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        make_norm = _norm(self.norm, self.dtype, train)
+        x = x.astype(self.dtype)
+        stem_stride = 1 if self.small_input else 2
+        x = nn.swish(make_norm()(nn.Conv(32, (3, 3), strides=stem_stride, padding="SAME",
+                                         use_bias=False, dtype=self.dtype)(x)))
+        # (features, expand, kernel, stride, repeats)
+        plan = [
+            (16, 1, 3, 1, 1), (24, 6, 3, 2, 2), (40, 6, 5, 2, 2),
+            (80, 6, 3, 2, 3), (112, 6, 5, 1, 3), (192, 6, 5, 2, 4), (320, 6, 3, 1, 1),
+        ]
+        for feats, expand, kernel, stride, repeats in plan:
+            for r in range(repeats):
+                x = MBConv(feats, expand, kernel, stride if r == 0 else 1, True,
+                           self.norm, self.dtype, "swish")(x, train)
+        x = nn.swish(make_norm()(nn.Conv(1280, (1, 1), use_bias=False, dtype=self.dtype)(x)))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+_VGG_PLANS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    """VGG-11/16 with norm (reference ``model/cv/vgg.py`` capability)."""
+
+    num_classes: int
+    depth: int = 11
+    norm: str = "batch"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        make_norm = _norm(self.norm, self.dtype, train)
+        x = x.astype(self.dtype)
+        for step in _VGG_PLANS[self.depth]:
+            if step == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(step), (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+                x = nn.relu(make_norm()(x))
+        x = jnp.mean(x, axis=(1, 2))  # adaptive pool -> classifier
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

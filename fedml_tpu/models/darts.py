@@ -1,0 +1,81 @@
+"""DARTS-style differentiable supernet for FedNAS.
+
+Parity target: ``model/cv/darts/model_search.py`` (``Network``) driving the
+FedNAS algorithm (``simulation/mpi/fednas/FedNASAggregator.py:9`` aggregates
+model weights AND architecture alphas).  The reference search space is the
+full 8-op DARTS cell; this supernet keeps the DARTS mechanics — MixedOp =
+softmax(alpha)-weighted op sum, cells stacked, alphas as a separate
+parameter collection — over a compact 4-op space sized for federated rounds
+(the search dynamics, alternating w/alpha updates, and genotype derivation
+are what FedNAS exercises; op-menu breadth is config).
+
+TPU notes: every candidate op runs every step (dense weighted sum — no
+data-dependent branching), which is exactly what the MXU wants; alphas live
+in the ``arch`` collection so the optimizer/aggregator can treat them
+separately from weights (flax mutable collections).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+OPS = ("conv3", "conv5", "skip", "zero")
+
+
+class MixedOp(nn.Module):
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, alpha):
+        """alpha: (n_ops,) logits for THIS edge."""
+        w = nn.softmax(alpha)
+        c3 = nn.relu(nn.Conv(self.features, (3, 3), padding="SAME", dtype=self.dtype)(x))
+        c5 = nn.relu(nn.Conv(self.features, (5, 5), padding="SAME", dtype=self.dtype)(x))
+        skip = x if x.shape[-1] == self.features else nn.Conv(self.features, (1, 1), dtype=self.dtype)(x)
+        zero = jnp.zeros_like(c3)
+        return w[0] * c3 + w[1] * c5 + w[2] * skip + w[3] * zero
+
+
+class DARTSSuperNet(nn.Module):
+    """n_cells cells of two MixedOp edges each; alphas: (n_cells, 2, n_ops)
+    stored in the 'arch' param collection."""
+
+    num_classes: int
+    n_cells: int = 2
+    features: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        alphas = self.param(
+            "alphas", lambda k: jnp.zeros((self.n_cells, 2, len(OPS)), jnp.float32)
+        )
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(self.features, (3, 3), padding="SAME", dtype=self.dtype)(x))
+        for c in range(self.n_cells):
+            h1 = MixedOp(self.features, self.dtype, name=f"cell{c}_op0")(x, alphas[c, 0])
+            h2 = MixedOp(self.features, self.dtype, name=f"cell{c}_op1")(h1, alphas[c, 1])
+            x = h2
+            if c < self.n_cells - 1:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def split_arch_params(params):
+    """(weights, alphas) partition of the supernet param tree — FedNAS
+    aggregates them separately (reference __aggregate_weight/__update_arch)."""
+    weights = {k: v for k, v in params.items() if k != "alphas"}
+    return weights, params["alphas"]
+
+
+def derive_genotype(alphas) -> list[list[str]]:
+    """argmax op per edge (reference genotype derivation, minus the zero op
+    which encodes 'prune this edge')."""
+    picks = jnp.argmax(alphas[..., : len(OPS) - 1], axis=-1)  # exclude zero
+    return [[OPS[int(op)] for op in cell] for cell in picks]

@@ -1,0 +1,42 @@
+"""GAN pair for FedGAN (reference ``model/gan/`` + ``simulation/mpi/fedgan/
+gan_trainer.py:11`` — netd/netg trained per client, both aggregated)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class Generator(nn.Module):
+    """z -> flat image in [-1, 1] (MLP-DCGAN hybrid scaled for 28x28/32x32)."""
+
+    out_shape: Sequence[int] = (28, 28, 1)
+    z_dim: int = 64
+    hidden: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        out_dim = 1
+        for d in self.out_shape:
+            out_dim *= d
+        h = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(z))
+        h = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(h))
+        x = nn.tanh(nn.Dense(out_dim, dtype=jnp.float32)(h))
+        return x.reshape((z.shape[0],) + tuple(self.out_shape))
+
+
+class Discriminator(nn.Module):
+    """image -> real/fake logit."""
+
+    hidden: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        h = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        h = nn.leaky_relu(nn.Dense(self.hidden, dtype=self.dtype)(h), 0.2)
+        h = nn.leaky_relu(nn.Dense(self.hidden // 2, dtype=self.dtype)(h), 0.2)
+        return nn.Dense(1, dtype=jnp.float32)(h)[:, 0]

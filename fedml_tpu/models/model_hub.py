@@ -13,7 +13,7 @@ from typing import Any
 import jax.numpy as jnp
 
 from ..arguments import Config
-from . import resnet, rnn, simple
+from . import cnn_zoo, resnet, rnn, simple
 
 
 def create(cfg: Config, output_dim: int) -> Any:
@@ -46,4 +46,22 @@ def create(cfg: Config, output_dim: int) -> Any:
         return rnn.CharLSTM(vocab_size=output_dim)
     if name in ("rnn_stackoverflow", "word_lstm"):
         return rnn.WordLSTM(vocab_size=output_dim)
+    # CNN zoo breadth (reference model_hub.py:66-73 + model/cv/vgg.py);
+    # small_input picks the CIFAR stride-1 stem for small images — derived
+    # from the dataset's spec shape (public accessor applies the loader's
+    # name normalization) so the knowledge lives in ONE place
+    from ..data.loader import dataset_spec
+
+    spec = dataset_spec(cfg.dataset)
+    small = spec is not None and len(spec[0]) == 3 and spec[0][0] <= 36
+    if name == "mobilenet":
+        return cnn_zoo.MobileNetV1(num_classes=output_dim, norm=norm, dtype=dtype, small_input=small)
+    if name in ("mobilenet_v3", "mobilenetv3"):
+        return cnn_zoo.MobileNetV3Small(num_classes=output_dim, norm=norm, dtype=dtype, small_input=small)
+    if name in ("efficientnet", "efficientnet_b0"):
+        return cnn_zoo.EfficientNetB0(num_classes=output_dim, norm=norm, dtype=dtype, small_input=small)
+    if name in ("vgg11", "vgg"):
+        return cnn_zoo.VGG(num_classes=output_dim, depth=11, norm=norm, dtype=dtype)
+    if name == "vgg16":
+        return cnn_zoo.VGG(num_classes=output_dim, depth=16, norm=norm, dtype=dtype)
     raise ValueError(f"unknown model {cfg.model!r} (dataset {cfg.dataset!r})")

@@ -1,0 +1,63 @@
+"""Compact UNet for FedSeg (reference ``simulation/mpi/fedseg`` trains
+DeepLabV3+/UNet on pascal-style data; ``utils.py:56`` tracks accuracy /
+per-class accuracy / mIoU / FWIoU)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class _ConvBlock(nn.Module):
+    features: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.GroupNorm(num_groups=4, dtype=self.dtype)(
+            nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)))
+        x = nn.relu(nn.GroupNorm(num_groups=4, dtype=self.dtype)(
+            nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)))
+        return x
+
+
+class UNet(nn.Module):
+    """2-level UNet: per-pixel class logits (B, H, W, num_classes)."""
+
+    num_classes: int
+    base: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        d1 = _ConvBlock(self.base, self.dtype)(x)
+        p1 = nn.max_pool(d1, (2, 2), strides=(2, 2))
+        d2 = _ConvBlock(self.base * 2, self.dtype)(p1)
+        p2 = nn.max_pool(d2, (2, 2), strides=(2, 2))
+        mid = _ConvBlock(self.base * 4, self.dtype)(p2)
+        u2 = nn.ConvTranspose(self.base * 2, (2, 2), strides=(2, 2), dtype=self.dtype)(mid)
+        u2 = _ConvBlock(self.base * 2, self.dtype)(jnp.concatenate([u2, d2], axis=-1))
+        u1 = nn.ConvTranspose(self.base, (2, 2), strides=(2, 2), dtype=self.dtype)(u2)
+        u1 = _ConvBlock(self.base, self.dtype)(jnp.concatenate([u1, d1], axis=-1))
+        return nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32)(u1)
+
+
+def segmentation_metrics(logits, labels, num_classes: int):
+    """pixel accuracy, mIoU, FWIoU — reference ``EvaluationMetricsKeeper``
+    (fedseg/utils.py:56) computed from the confusion matrix."""
+    preds = jnp.argmax(logits, axis=-1).reshape(-1)
+    labels = labels.reshape(-1)
+    conf = jnp.zeros((num_classes, num_classes), jnp.float32).at[labels, preds].add(1.0)
+    tp = jnp.diag(conf)
+    union = conf.sum(0) + conf.sum(1) - tp
+    iou = tp / jnp.maximum(union, 1.0)
+    present = (conf.sum(1) > 0).astype(jnp.float32)
+    freq = conf.sum(1) / jnp.maximum(conf.sum(), 1.0)
+    return {
+        "pixel_acc": tp.sum() / jnp.maximum(conf.sum(), 1.0),
+        "miou": (iou * present).sum() / jnp.maximum(present.sum(), 1.0),
+        "fwiou": (freq * iou).sum(),
+    }

@@ -59,6 +59,8 @@ class FedMLRunner:
             self.runner = self._init_simulation_runner()
         elif cfg.training_type == C.TRAINING_PLATFORM_CROSS_SILO:
             self.runner = self._init_cross_silo_runner()
+        elif cfg.training_type == C.TRAINING_PLATFORM_CROSS_DEVICE:
+            self.runner = self._init_cross_device_runner()
         elif cfg.training_type == C.TRAINING_PLATFORM_CENTRALIZED:
             self.runner = self._init_centralized_runner()
         else:
@@ -84,12 +86,19 @@ class FedMLRunner:
         C.FEDERATED_OPTIMIZER_SPLIT_NN,
         C.FEDERATED_OPTIMIZER_FEDGKT,
         C.FEDERATED_OPTIMIZER_VERTICAL_FL,
+        C.FEDERATED_OPTIMIZER_FEDGAN,
+        C.FEDERATED_OPTIMIZER_FEDNAS,
+        C.FEDERATED_OPTIMIZER_FEDSEG,
+        C.FEDERATED_OPTIMIZER_TURBO_AGGREGATE,
     }
     # these build their own model pair internally; model_hub model is unused
     _OWN_MODEL_OPTIMIZERS = {
         C.FEDERATED_OPTIMIZER_SPLIT_NN,
         C.FEDERATED_OPTIMIZER_FEDGKT,
         C.FEDERATED_OPTIMIZER_VERTICAL_FL,
+        C.FEDERATED_OPTIMIZER_FEDGAN,
+        C.FEDERATED_OPTIMIZER_FEDNAS,
+        C.FEDERATED_OPTIMIZER_FEDSEG,
     }
 
     def _init_simulation_runner(self):
@@ -154,6 +163,22 @@ class FedMLRunner:
             from .sim.vertical import VFLSimulator
 
             return VFLSimulator(self.cfg, dataset)
+        if opt == C.FEDERATED_OPTIMIZER_FEDGAN:
+            from .sim.fedgan import FedGANSimulator
+
+            return FedGANSimulator(self.cfg, dataset)
+        if opt == C.FEDERATED_OPTIMIZER_FEDNAS:
+            from .sim.fednas import FedNASSimulator
+
+            return FedNASSimulator(self.cfg, dataset)
+        if opt == C.FEDERATED_OPTIMIZER_FEDSEG:
+            from .sim.fedseg import FedSegSimulator
+
+            return FedSegSimulator(self.cfg, dataset)
+        if opt == C.FEDERATED_OPTIMIZER_TURBO_AGGREGATE:
+            from .sim.turboaggregate import TurboAggregateSimulator
+
+            return TurboAggregateSimulator(self.cfg, dataset, model)
         from .sim.engine import MeshSimulator
 
         return MeshSimulator(self.cfg, dataset, model, algorithm=self.client_trainer)
@@ -167,6 +192,12 @@ class FedMLRunner:
                 "cross_silo platform is not yet available in this build"
             ) from e
         return create_cross_silo_runner(self.cfg, dataset, model)
+
+    def _init_cross_device_runner(self):
+        dataset, model = self._load_data_model()
+        from .cross_device import create_cross_device_runner
+
+        return create_cross_device_runner(self.cfg, dataset, model)
 
     def _init_centralized_runner(self):
         dataset, model = self._load_data_model()

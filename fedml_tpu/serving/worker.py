@@ -1,0 +1,74 @@
+"""Inference replica worker — one process per replica.
+
+The process the deploy scheduler spawns (reference: the per-replica inference
+container started by ``device_model_deployment.py:start_deployment``; here a
+plain process, container-free by design).  Loads a model-hub model + a
+pytree-wire parameter file and serves predict/ready over HTTP
+(``serving/inference.py``).
+
+Usage: python -m fedml_tpu.serving.worker --model lr --classes 10 \
+           --params /path/params.wire --port 2500 [--feature-dim 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def load_params(path: str):
+    from ..comm import wire
+
+    with open(path, "rb") as f:
+        return wire.decode_pytree(f.read())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--params", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--max-batch", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    from ..arguments import Config
+    from ..models import model_hub
+    from .inference import FedMLInferenceRunner, JaxPredictor
+
+    cfg = Config(model=args.model, dataset="synthetic")
+    model = model_hub.create(cfg, args.classes)
+    variables = load_params(args.params)
+    predictor = JaxPredictor(model, variables, max_batch=args.max_batch)
+    # Warm up BEFORE serving: readiness must mean "can answer within SLO",
+    # and the first jit compile can take tens of seconds on a loaded host —
+    # a /ready that predates compilation makes the gateway time out.
+    feat_shape = _infer_feature_shape(variables)
+    if feat_shape is not None:
+        predictor.predict({"inputs": [[0.0] * feat_shape[0]]})
+    runner = FedMLInferenceRunner(predictor, host=args.host, port=args.port)
+    runner.run(block=True)
+    return 0
+
+
+def _infer_feature_shape(variables):
+    """Best-effort input shape from the first kernel leaf (LR/MLP: (d, c) ->
+    (d,)); None when unknown (conv models warm up on first request)."""
+    import numpy as np
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                if k == "kernel" and getattr(v, "ndim", 0) == 2:
+                    return (int(np.asarray(v).shape[0]),)
+                got = walk(v)
+                if got is not None:
+                    return got
+        return None
+
+    return walk(variables)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

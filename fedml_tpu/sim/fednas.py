@@ -1,0 +1,151 @@
+"""FedNAS — federated neural architecture search.
+
+Reference: ``simulation/mpi/fednas`` (``FedNASAggregator.py:9``: clients
+alternate DARTS updates — model weights on the train split, architecture
+alphas on the search split — and the server aggregates weights (sample-
+weighted) and alphas (uniform ``__update_arch``) separately each round;
+after ``comm_round`` rounds the argmax genotype is derived).
+
+TPU-native form: the supernet (``models/darts.py``) keeps alphas inside the
+param tree, so one vmapped jitted client function runs both alternating
+updates as a scan; aggregation splits the stacked tree into (weights,
+alphas) and applies the reference's two rules.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..arguments import Config
+from ..core import rng
+from ..models.darts import DARTSSuperNet, derive_genotype
+from ..obs.metrics import MetricsLogger
+
+
+class FedNASSimulator:
+    def __init__(self, cfg: Config, dataset, mesh=None):
+        self.cfg = cfg
+        self.dataset = dataset
+        extra = getattr(cfg, "extra", {}) or {}
+        self.model = DARTSSuperNet(
+            num_classes=dataset.class_num,
+            n_cells=int(extra.get("nas_cells", 2)),
+            features=int(extra.get("nas_features", 16)),
+        )
+        self.arch_lr = float(extra.get("nas_arch_lr", 3e-3))
+        k0 = rng.root_key(cfg.random_seed)
+        x0 = jnp.zeros((2,) + tuple(dataset.train_x.shape[1:]), jnp.float32)
+        self.variables = self.model.init({"params": k0}, x0)
+        self.root_key = k0
+        self.round_idx = 0
+        self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
+
+        # stack clients; each client's shard is split train/search half-half
+        # (the reference gives each client a train and a validation loader)
+        counts = np.array([len(ix) for ix in dataset.client_idx])
+        cap = int(((counts.max() + cfg.batch_size - 1) // cfg.batch_size) * cfg.batch_size)
+        feat = dataset.train_x.shape[1:]
+        xs = np.zeros((dataset.n_clients, cap) + feat, np.float32)
+        ys = np.zeros((dataset.n_clients, cap), np.int32)
+        for i, ix in enumerate(dataset.client_idx):
+            reps = np.resize(np.asarray(ix), cap)
+            xs[i], ys[i] = dataset.train_x[reps], dataset.train_y[reps]
+        self._x, self._y = jnp.asarray(xs), jnp.asarray(ys)
+        self.counts = jnp.asarray(counts, jnp.float32)
+        self._client_fn = jax.jit(jax.vmap(self._local_search, in_axes=(None, 0, 0, 0)))
+
+        tx = jnp.asarray(dataset.test_x[: 512])
+        ty = jnp.asarray(dataset.test_y[: 512])
+        self._eval = jax.jit(lambda v: jnp.mean(
+            (jnp.argmax(self.model.apply(v, tx, train=False), -1) == ty).astype(jnp.float32)
+        ))
+
+    def _split_wa(self, params):
+        w = {k: v for k, v in params["params"].items() if k != "alphas"}
+        return w, params["params"]["alphas"]
+
+    def _local_search(self, variables, x, y, key):
+        """Alternating DARTS updates: weight step on the first half batches,
+        alpha step on the second half (first-order DARTS)."""
+        cfg = self.cfg
+        bs = cfg.batch_size
+        half = x.shape[0] // 2
+        steps = max(1, half // bs) * max(1, cfg.epochs)
+        w_opt = optax.sgd(cfg.learning_rate, momentum=0.9)
+        a_opt = optax.adam(self.arch_lr)
+        params = variables["params"]
+        w_state = w_opt.init(params)
+        a_state = a_opt.init(params)
+
+        def ce(p, xb, yb):
+            logits = self.model.apply({"params": p}, xb, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+        def mask_tree(tree, alphas_on: bool):
+            return jax.tree_util.tree_map_with_path(
+                lambda path, g: g if (("alphas" in jax.tree_util.keystr(path)) == alphas_on) else jnp.zeros_like(g),
+                tree,
+            )
+
+        def step(carry, i):
+            params, w_state, a_state, key = carry
+            key, kw, ka = jax.random.split(key, 3)
+            iw = jax.random.randint(kw, (bs,), 0, half)
+            ia = jax.random.randint(ka, (bs,), half, x.shape[0])
+            # weight step (alphas frozen)
+            lw, gw = jax.value_and_grad(ce)(params, x[iw], y[iw])
+            up, w_state2 = w_opt.update(mask_tree(gw, False), w_state, params)
+            params = optax.apply_updates(params, up)
+            # alpha step on the search split (weights frozen)
+            la, ga = jax.value_and_grad(ce)(params, x[ia], y[ia])
+            up_a, a_state2 = a_opt.update(mask_tree(ga, True), a_state, params)
+            params = optax.apply_updates(params, up_a)
+            return (params, w_state2, a_state2, key), (lw, la)
+
+        (params, _, _, _), (lw, la) = jax.lax.scan(
+            step, (params, w_state, a_state, key), jnp.arange(steps)
+        )
+        return params, lw.mean(), la.mean()
+
+    def run_round(self) -> dict:
+        cfg = self.cfg
+        r = self.round_idx
+        n = self.dataset.n_clients
+        m = min(cfg.client_num_per_round, n)
+        sampled = np.asarray(rng.sample_clients(self.root_key, r, n, m))
+        rkey = rng.round_key(self.root_key, r)
+        keys = jnp.stack([rng.client_key(rkey, int(c)) for c in sampled])
+        stacked, lw, la = self._client_fn(self.variables, self._x[sampled], self._y[sampled], keys)
+        w = self.counts[sampled]
+        w = w / w.sum()
+        m_uniform = jnp.full_like(w, 1.0 / w.shape[0])
+
+        def agg(s, weights):
+            return jax.tree_util.tree_map(lambda t: jnp.tensordot(weights, t, axes=1), s)
+
+        # reference: weights sample-weighted, alphas uniform (__update_arch)
+        new_params = agg({k: v for k, v in stacked.items() if k != "alphas"}, w)
+        new_alphas = agg(stacked["alphas"], m_uniform)
+        self.variables = {"params": {**new_params, "alphas": new_alphas}}
+        self.round_idx += 1
+        return {"train_loss": float(lw.mean()), "arch_loss": float(la.mean())}
+
+    def genotype(self):
+        return derive_genotype(self.variables["params"]["alphas"])
+
+    def run(self) -> list[dict]:
+        history = []
+        for r in range(self.cfg.comm_round):
+            t0 = time.perf_counter()
+            metrics = self.run_round()
+            metrics.update(round=r, round_time_s=time.perf_counter() - t0,
+                           test_acc=float(self._eval(self.variables)))
+            self.logger.log(metrics)
+            history.append(metrics)
+        self.logger.log({"genotype": str(self.genotype())})
+        return history

@@ -1,0 +1,133 @@
+"""FedSeg — federated semantic segmentation.
+
+Reference: ``simulation/mpi/fedseg`` (``FedSegAggregator.py`` FedAvg over
+DeepLab/UNet weights; ``utils.py:56`` ``EvaluationMetricsKeeper`` tracks
+pixel accuracy / mIoU / FWIoU).
+
+TPU-native form: a UNet (``models/segmentation.py``) trained with per-pixel
+cross-entropy in one vmapped jitted client function; evaluation computes the
+confusion-matrix metrics on device.  The data frame stores class labels, so
+segmentation masks are synthesized deterministically from each image's class
+(class-dependent quadrant layouts) when no real mask data is present —
+mirroring the repo-wide synthetic-fallback policy (data/loader.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..arguments import Config
+from ..core import rng
+from ..models.segmentation import UNet, segmentation_metrics
+from ..obs.metrics import MetricsLogger
+
+
+def synthesize_masks(x: np.ndarray, y: np.ndarray, num_classes: int, seed: int = 0) -> np.ndarray:
+    """(n, H, W) int masks: the image's class paints a class-dependent
+    quadrant; background is class 0.  Deterministic in (x, y, seed)."""
+    n, h, w = x.shape[0], x.shape[1], x.shape[2]
+    masks = np.zeros((n, h, w), np.int32)
+    quad = np.asarray(y) % 4
+    hh, ww = h // 2, w // 2
+    for q in range(4):
+        sel = np.flatnonzero(quad == q)
+        r0 = (q // 2) * hh
+        c0 = (q % 2) * ww
+        for i in sel:
+            masks[i, r0 : r0 + hh, c0 : c0 + ww] = int(y[i]) % num_classes
+    return masks
+
+
+class FedSegSimulator:
+    def __init__(self, cfg: Config, dataset, mesh=None):
+        self.cfg = cfg
+        self.dataset = dataset
+        extra = getattr(cfg, "extra", {}) or {}
+        self.num_classes = max(int(dataset.class_num), 2)
+        self.model = UNet(num_classes=self.num_classes, base=int(extra.get("seg_base", 8)))
+        k0 = rng.root_key(cfg.random_seed)
+        feat = tuple(dataset.train_x.shape[1:])
+        assert len(feat) == 3, "FedSeg needs (H, W, C) image data"
+        x0 = jnp.zeros((2,) + feat, jnp.float32)
+        self.variables = self.model.init({"params": k0}, x0)
+        self.root_key = k0
+        self.round_idx = 0
+        self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
+
+        masks = synthesize_masks(dataset.train_x, dataset.train_y, self.num_classes, cfg.random_seed)
+        counts = np.array([len(ix) for ix in dataset.client_idx])
+        cap = int(((counts.max() + cfg.batch_size - 1) // cfg.batch_size) * cfg.batch_size)
+        xs = np.zeros((dataset.n_clients, cap) + feat, np.float32)
+        ms = np.zeros((dataset.n_clients, cap) + feat[:2], np.int32)
+        for i, ix in enumerate(dataset.client_idx):
+            reps = np.resize(np.asarray(ix), cap)
+            xs[i], ms[i] = dataset.train_x[reps], masks[reps]
+        self._x, self._m = jnp.asarray(xs), jnp.asarray(ms)
+        self.counts = jnp.asarray(counts, jnp.float32)
+        self._client_fn = jax.jit(jax.vmap(self._local_train, in_axes=(None, 0, 0, 0)))
+
+        tmask = synthesize_masks(dataset.test_x[:256], dataset.test_y[:256], self.num_classes, cfg.random_seed)
+        self._test = (jnp.asarray(dataset.test_x[:256], jnp.float32), jnp.asarray(tmask))
+        self._eval = jax.jit(self._eval_fn)
+
+    def _local_train(self, variables, x, m, key):
+        cfg = self.cfg
+        bs = cfg.batch_size
+        steps = max(1, x.shape[0] // bs) * max(1, cfg.epochs)
+        opt = optax.sgd(cfg.learning_rate, momentum=0.9)
+        state = opt.init(variables)
+
+        def loss_fn(v, xb, mb):
+            logits = self.model.apply(v, xb, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, mb).mean()
+
+        def step(carry, i):
+            v, state, key = carry
+            key, kb = jax.random.split(key)
+            ix = jax.random.randint(kb, (bs,), 0, x.shape[0])
+            loss, grads = jax.value_and_grad(loss_fn)(v, x[ix], m[ix])
+            up, state = opt.update(grads, state, v)
+            return (optax.apply_updates(v, up), state, key), loss
+
+        (v, _, _), losses = jax.lax.scan(step, (variables, state, key), jnp.arange(steps))
+        return v, losses.mean()
+
+    def _eval_fn(self, variables):
+        tx, tm = self._test
+        logits = self.model.apply(variables, tx, train=False)
+        return segmentation_metrics(logits, tm, self.num_classes)
+
+    def run_round(self) -> dict:
+        cfg = self.cfg
+        r = self.round_idx
+        n = self.dataset.n_clients
+        m = min(cfg.client_num_per_round, n)
+        sampled = np.asarray(rng.sample_clients(self.root_key, r, n, m))
+        rkey = rng.round_key(self.root_key, r)
+        keys = jnp.stack([rng.client_key(rkey, int(c)) for c in sampled])
+        stacked, losses = self._client_fn(self.variables, self._x[sampled], self._m[sampled], keys)
+        w = self.counts[sampled]
+        w = w / w.sum()
+        self.variables = jax.tree_util.tree_map(lambda s: jnp.tensordot(w, s, axes=1), stacked)
+        self.round_idx += 1
+        return {"train_loss": float(losses.mean())}
+
+    def run(self) -> list[dict]:
+        history = []
+        cfg = self.cfg
+        for r in range(cfg.comm_round):
+            t0 = time.perf_counter()
+            metrics = self.run_round()
+            metrics.update(round=r, round_time_s=time.perf_counter() - t0)
+            if cfg.frequency_of_the_test and (
+                (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1
+            ):
+                metrics.update({k: float(v) for k, v in self._eval(self.variables).items()})
+            self.logger.log(metrics)
+            history.append(metrics)
+        return history

@@ -1,0 +1,148 @@
+"""Turbo-Aggregate — multi-group ring aggregation with additive masking.
+
+Reference: ``simulation/sp/turboaggregate/TA_trainer.py:12`` — NOTE that the
+reference's ``TA_topology_vanilla`` (:109) is an empty stub (``pass``): its
+"TurboAggregate" actually performs plain FedAvg with per-client dropout
+flags.  This module implements the ACTUAL Turbo-Aggregate protocol (So,
+Guler, Avestimehr 2021) the reference names:
+
+- clients are partitioned into L groups arranged in a ring;
+- each group's clients send their additively-masked models to the next
+  group, which accumulates the running partial sum; the random masks are
+  also forwarded and cancel telescopically at the final hop;
+- a dropped client's contribution is recovered from the group-level
+  redundancy (here: the surviving group members re-weight, the reference
+  paper uses Lagrange coding — the fedml_tpu LightSecAgg stack already
+  provides that machinery for the cross-silo platform).
+
+The ring arithmetic runs in float on stacked trees (one tensordot per hop);
+the security property tested is that NO single group observes an unmasked
+individual model — only masked models and running partial sums.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms import create as create_algorithm, hparams_from_config
+from ..arguments import Config
+from ..core import pytree as pt, rng
+from ..data.dataset import pad_eval_set, stack_clients
+from ..fl.local_sgd import make_eval_fn, make_local_train_fn
+from ..obs.metrics import MetricsLogger
+
+
+class TurboAggregateSimulator:
+    def __init__(self, cfg: Config, dataset, model, mesh=None):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+        extra = getattr(cfg, "extra", {}) or {}
+        self.n_groups = max(2, int(extra.get("ta_group_num", 4)))
+        self.dropout_prob = float(extra.get("ta_dropout_prob", 0.0))
+
+        stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
+        spe = max(1, -(-stacked.capacity // cfg.batch_size))
+        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        self._local_train = jax.jit(jax.vmap(make_local_train_fn(model, self.hp),
+                                             in_axes=(None, 0, 0, 0, 0, None)))
+        k0 = rng.root_key(cfg.random_seed)
+        self.global_vars = model.init(
+            {"params": jax.random.fold_in(k0, 1), "dropout": jax.random.fold_in(k0, 2)},
+            jnp.asarray(stacked.x[0, : cfg.batch_size]), train=True,
+        )
+        self._x = jnp.asarray(stacked.x)
+        self._y = jnp.asarray(stacked.y)
+        self.counts = jnp.asarray(stacked.counts)
+        self.root_key = k0
+        self.round_idx = 0
+        eval_bs = min(256, max(32, cfg.test_batch_size))
+        tx, ty, n_valid = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
+        self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
+        self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
+        self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
+        # audit trail for the privacy test: flat vectors each group observed
+        self.observed_by_group: list[list[np.ndarray]] = []
+
+    # -- the ring protocol ---------------------------------------------------
+    def _ring_aggregate(self, flat_updates: jnp.ndarray, weights: jnp.ndarray,
+                        groups: list[np.ndarray], key) -> jnp.ndarray:
+        """Weighted sum over clients via the masked group ring.  flat_updates:
+        (m, d) client-weighted contributions w_i * u_i."""
+        d = flat_updates.shape[1]
+        running = jnp.zeros(d)
+        mask_sum = jnp.zeros(d)
+        self.observed_by_group = []
+        for g, members in enumerate(groups):
+            if len(members) == 0:
+                self.observed_by_group.append([])
+                continue
+            gkey = jax.random.fold_in(key, g)
+            masks = jax.random.normal(
+                jax.random.fold_in(gkey, 7), (len(members), d)
+            ) * 10.0  # mask scale >> update scale
+            masked = flat_updates[np.asarray(members)] * weights[np.asarray(members), None] + masks
+            # next group in the ring receives ONLY masked models + the
+            # running partial sum (records kept for the audit test)
+            self.observed_by_group.append(
+                [np.asarray(v) for v in masked] + [np.asarray(running)]
+            )
+            running = running + masked.sum(axis=0)
+            mask_sum = mask_sum + masks.sum(axis=0)
+        # final hop: the server removes the telescoped mask total
+        return running - mask_sum
+
+    def run_round(self) -> dict:
+        cfg = self.cfg
+        r = self.round_idx
+        n = self.dataset.n_clients
+        m = min(cfg.client_num_per_round, n)
+        sampled = np.asarray(rng.sample_clients(self.root_key, r, n, m))
+        rkey = rng.round_key(self.root_key, r)
+        keys = jnp.stack([rng.client_key(rkey, int(c)) for c in sampled])
+        new_vars, metrics = self._local_train(
+            self.global_vars, self._x[sampled], self._y[sampled], self.counts[sampled], keys, None
+        )
+        _, unravel = pt.tree_flatten_to_vector(
+            jax.tree_util.tree_map(lambda s: s[0], new_vars)
+        )
+        mat = jnp.stack([
+            pt.tree_flatten_to_vector(jax.tree_util.tree_map(lambda s, i=i: s[i], new_vars))[0]
+            for i in range(m)
+        ])
+        # per-client dropout (the reference TA_Client.set_dropout flag)
+        drop_rng = np.random.RandomState(1000 + r)
+        alive = drop_rng.rand(m) >= self.dropout_prob
+        if not alive.any():
+            alive[0] = True
+        w = np.asarray(self.counts[sampled], np.float64) * alive
+        w = jnp.asarray(w / w.sum(), jnp.float32)
+        groups = np.array_split(np.flatnonzero(alive), self.n_groups)
+        agg_flat = self._ring_aggregate(mat, w, groups, jax.random.fold_in(rkey, 0x7A))
+        self.global_vars = unravel(agg_flat)
+        self.round_idx += 1
+        out = {k: float(np.mean(v)) for k, v in metrics.items()}
+        out["alive"] = int(alive.sum())
+        return out
+
+    def evaluate(self) -> dict:
+        return {k: float(v) for k, v in self._eval_fn(self.global_vars, *self._test).items()}
+
+    def run(self) -> list[dict]:
+        history = []
+        cfg = self.cfg
+        for r in range(cfg.comm_round):
+            t0 = time.perf_counter()
+            metrics = self.run_round()
+            metrics.update(round=r, round_time_s=time.perf_counter() - t0)
+            if cfg.frequency_of_the_test and (
+                (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1
+            ):
+                metrics.update(self.evaluate())
+            self.logger.log(metrics)
+            history.append(metrics)
+        return history

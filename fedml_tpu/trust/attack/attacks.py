@@ -102,11 +102,38 @@ def backdoor_pixel_pattern(x: np.ndarray, client_idx: list, poisoned_clients: Se
     return x, labels
 
 
+def edge_case_backdoor(x: np.ndarray, client_idx: list, poisoned_clients: Sequence[int],
+                       target_class: int, labels: np.ndarray, frac: float = 0.2,
+                       seed: int = 0):
+    """Edge-case backdoor (reference ``backdoor_attack.py`` edge-case mode,
+    Wang et al. NeurIPS'20): poison with inputs from the TAIL of the data
+    distribution — rare-looking samples a pixel trigger doesn't need — all
+    relabeled to the target.  The reference injects curated natural edge
+    sets (e.g. Southwest airplanes into CIFAR); the dataset-agnostic stand-in
+    here synthesizes tail samples by pushing real samples far along their
+    deviation from the dataset mean (out-of-distribution but structured,
+    unlike uniform noise).  Returns (x', labels')."""
+    x = x.copy()
+    labels = labels.copy()
+    rng = np.random.RandomState(seed)
+    mean = x.mean(axis=0, keepdims=True)
+    scale = 3.0  # how far into the tail the samples are pushed
+    for c in poisoned_clients:
+        ix = client_idx[c]
+        n_poison = int(len(ix) * frac)
+        if n_poison == 0:
+            continue
+        sel = rng.choice(ix, size=n_poison, replace=False)
+        x[sel] = mean + scale * (x[sel] - mean)  # amplified deviation = tail
+        labels[sel] = target_class
+    return x, labels
+
+
 MODEL_ATTACKS = (
     "byzantine_random", "byzantine_zero", "byzantine_flip",
     "model_replacement", "lazy_worker",
 )
-DATA_ATTACKS = ("label_flipping", "backdoor")
+DATA_ATTACKS = ("label_flipping", "backdoor", "edge_case_backdoor")
 KNOWN_ATTACKS = MODEL_ATTACKS + DATA_ATTACKS
 
 
@@ -151,6 +178,12 @@ class FedMLAttacker:
             return dataclasses.replace(ds, train_y=new_y)
         if self.attack_type == "backdoor":
             new_x, new_y = backdoor_pixel_pattern(
+                ds.train_x, ds.client_idx, self.attackers,
+                self.target_class, ds.train_y, frac=self.poison_frac,
+            )
+            return dataclasses.replace(ds, train_x=new_x, train_y=new_y)
+        if self.attack_type == "edge_case_backdoor":
+            new_x, new_y = edge_case_backdoor(
                 ds.train_x, ds.client_idx, self.attackers,
                 self.target_class, ds.train_y, frac=self.poison_frac,
             )

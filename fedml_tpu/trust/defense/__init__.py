@@ -26,6 +26,7 @@ from .anomaly import (
     ThreeSigmaGeoMedianDefense,
     ThreeSigmaKrumDefense,
 )
+from .soteria import SoteriaDefense, WBCDefense, soteria_mask, soteria_sensitivity
 from .robust_agg import (
     BulyanDefense,
     CoordinateWiseMedianDefense,
@@ -58,6 +59,8 @@ _REGISTRY = {
     "outlier_detection": OutlierDetectionDefense,
     "residual_reweight": ResidualReweightDefense,
     "cross_round": CrossRoundDefense,
+    "soteria": SoteriaDefense,
+    "wbc": WBCDefense,
 }
 
 

@@ -1,0 +1,74 @@
+"""Cross-device platform test (VERDICT row 20, reference
+cross_device/server_mnn): the runner's cross_device dispatch drives a fleet
+of NATIVE C++ clients over TCP and dumps the per-round model artifact."""
+
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+from .test_native_client import _wait_listening, _write_shard, native_binary  # noqa: F401
+
+
+def test_cross_device_runner_with_native_fleet(native_binary, tmp_path, eight_devices):
+    import fedml_tpu
+    from fedml_tpu.comm import wire
+    from fedml_tpu.runner import FedMLRunner
+
+    base_port = 22790
+    artifact = tmp_path / "global_model.wire"
+    cfg = tiny_config(
+        training_type="cross_device", backend="TCP",
+        client_num_in_total=2, client_num_per_round=2, comm_round=2,
+        batch_size=16, synthetic_train_size=320, synthetic_test_size=160,
+        frequency_of_the_test=1,
+        extra={"tcp_base_port": base_port, "global_model_file_path": str(artifact)},
+    )
+    fedml_tpu.init(cfg)
+    from fedml_tpu.data import loader
+
+    ds = loader.load(cfg)
+
+    procs = []
+    try:
+        for rank in (1, 2):
+            shard = tmp_path / f"shard_{rank}.bin"
+            ix = ds.client_idx[rank - 1]
+            _write_shard(shard, ds.train_x[ix].reshape(len(ix), -1), ds.train_y[ix])
+            procs.append(subprocess.Popen(
+                [native_binary, "client", "--rank", str(rank),
+                 "--base-port", str(base_port), "--data", str(shard)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+        for rank in (1, 2):
+            assert _wait_listening(base_port + rank), f"device {rank} never bound"
+
+        history = FedMLRunner(cfg).run()
+        assert len(history) == 2
+        assert history[-1]["test_acc"] > 0.3, history
+
+        # the device-facing model artifact was written and decodes
+        tree = wire.decode_pytree(artifact.read_bytes())
+        leaves = [np.asarray(v) for v in _flatten(tree)]
+        assert any(l.ndim == 2 for l in leaves)
+        for p in procs:
+            assert p.wait(timeout=20) == 0, p.stderr.read()[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def _flatten(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _flatten(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _flatten(v)
+    else:
+        yield tree

@@ -1,0 +1,84 @@
+"""Federated serving managers + cross-cloud tests (VERDICT rows 21/46)."""
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def test_federated_serving_train_then_deploy(tmp_path, eight_devices):
+    """FL run completes, final model is registered + deployed, endpoint
+    serves predictions — the train->serve loop (reference fedml_server.py:4
+    wraps the FL run; deployment is its SaaS side, local here)."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.serving.deploy import ModelDeployScheduler
+    from fedml_tpu.serving.federated import FedMLModelServingClient, FedMLModelServingServer
+
+    cfg = tiny_config(
+        run_id="fsrv1", client_num_in_total=2, client_num_per_round=2,
+        comm_round=2, batch_size=16, synthetic_train_size=256,
+        synthetic_test_size=64, frequency_of_the_test=0,
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("fsrv1")
+
+    clients = [
+        FedMLModelServingClient(cfg, "ep-demo", "fl-lr", dataset=ds, model=model,
+                                rank=r, backend="INPROC")
+        for r in (1, 2)
+    ]
+    for c in clients:
+        c.run_in_thread()
+    sched = ModelDeployScheduler(str(tmp_path / "ep.db"))
+    server = FedMLModelServingServer(
+        cfg, "ep-demo", "fl-lr", dataset=ds, model=model,
+        scheduler=sched, backend="INPROC",
+    )
+    try:
+        history, card = server.run(timeout=120.0, artifact_dir=str(tmp_path))
+        assert len(history) == 2
+        assert card is not None and card.name == "fl-lr"
+        assert sched.wait_ready("ep-demo", timeout=60)
+        feat = int(ds.train_x.shape[1])
+        out = sched.predict("ep-demo", {"inputs": np.zeros((1, feat)).tolist()})
+        assert len(out["outputs"][0]) == ds.class_num
+    finally:
+        sched.stop()
+        for c in clients:
+            c.finish()
+
+
+def test_cross_cloud_over_tcp(eight_devices):
+    """Cross-cloud = cross-silo over a routable transport with bounded-wait
+    defaults; 1 server + 2 'cloud' silos complete a run over real sockets."""
+    import threading
+
+    import fedml_tpu
+    from fedml_tpu.cross_cloud import FedMLCrossCloudClient, FedMLCrossCloudServer
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(
+        training_type="cross_cloud", client_num_in_total=2, client_num_per_round=2,
+        comm_round=2, batch_size=16, synthetic_train_size=256, synthetic_test_size=64,
+        frequency_of_the_test=1, extra={"tcp_base_port": 23590},
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+
+    assert cfg.backend in ("", "INPROC", "MESH") or cfg.backend == "TCP"
+    clients = [FedMLCrossCloudClient(cfg, ds, model, rank=r) for r in (1, 2)]
+    assert cfg.backend == "TCP"  # WAN default applied
+    assert cfg.extra["straggler_timeout_s"] == 60.0
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server = FedMLCrossCloudServer(cfg, ds, model)
+    history = server.run(timeout=120.0)
+    assert len(history) == 2 and history[-1]["test_acc"] > 0.3

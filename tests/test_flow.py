@@ -1,0 +1,77 @@
+"""Algorithm Flow DSL tests (reference core/distributed/flow/fedml_flow.py,
+exercised like its test_fedml_flow.py demo: Client/Server executors composing
+a two-round FedAvg-shaped protocol)."""
+
+import numpy as np
+
+from .conftest import tiny_config
+
+
+class Client:
+    pass  # defined via FedMLExecutor subclass below (names matter for routing)
+
+
+def test_flow_two_round_fedavg_shape(eight_devices):
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.flow import FedMLAlgorithmFlow, FedMLExecutor, Params
+
+    class ClientEx(FedMLExecutor):
+        def __init__(self, id, neighbors):
+            super().__init__(id, neighbors)
+            self.local_value = float(id)
+            self.trained = 0
+
+        def local_training(self):
+            p = self.get_params()
+            if p is not None and "model" in p:
+                self.local_value = float(np.asarray(p["model"])[0])
+            self.trained += 1
+            return Params(update=np.array([self.local_value + 1.0]), n=1)
+
+    class ServerEx(FedMLExecutor):
+        def __init__(self, id, neighbors):
+            super().__init__(id, neighbors)
+            self.aggregates = []
+
+        def server_agg(self):
+            p = self.get_params()
+            ups = p["upstream_list"] if "upstream_list" in p else [p]
+            vals = [float(np.asarray(u["update"])[0]) for u in ups]
+            agg = float(np.mean(vals))
+            self.aggregates.append(agg)
+            return Params(model=np.array([agg]))
+
+        def finalize(self):
+            return None
+
+    cfg = tiny_config(run_id="flow1", backend="INPROC")
+    fedml_tpu.init(cfg)
+    InProcRouter.reset("flow1")
+    cast = {"ClientEx": [1, 2], "ServerEx": [0]}
+    flows = []
+    for node_id in (0, 1, 2):
+        ex = (ServerEx(node_id, [1, 2]) if node_id == 0 else ClientEx(node_id, [0]))
+        flow = FedMLAlgorithmFlow(cfg, ex, cast)
+        flow.add_flow("local_training", ClientEx.local_training)
+        flow.add_flow("server_agg", ServerEx.server_agg)
+        flow.loop(times=2)
+        flow.add_flow("finalize", ServerEx.finalize)
+        flow.build()
+        flows.append(flow)
+
+    from fedml_tpu.flow.flow import run_flow_group
+
+    results = run_flow_group(cfg, flows, timeout=60.0)
+
+    # trace shape: clients executed local_training twice; server aggregated twice + finalized
+    assert [n.split("#")[0] for n in results[1]] == ["local_training", "local_training"]
+    assert [n.split("#")[0] for n in results[0]] == ["server_agg", "server_agg", "finalize"]
+
+    server = flows[0].executor
+    # round 1: clients (1, 2) send (2, 3) -> mean 2.5
+    assert server.aggregates[0] == 2.5
+    # round 2: both clients resume from 2.5 and send 3.5 -> mean 3.5
+    assert server.aggregates[1] == 3.5
+    # clients actually consumed the broadcast model
+    assert flows[1].executor.local_value == 2.5
