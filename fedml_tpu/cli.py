@@ -1,11 +1,14 @@
 """CLI — ``python -m fedml_tpu.cli <command>``.
 
 Parity with the reference CLI verbs (``python/fedml/cli/cli.py:11-80``):
-``run`` (a training recipe), ``launch`` (a job.yaml through the scheduler),
-``build`` (package a workspace), ``agent`` (start a worker), ``jobs``/``logs``
-(job DB), ``env``, ``version``.  Cloud-account verbs (``login`` to the SaaS)
-have no meaning in a self-hosted TPU build; ``login`` here registers the
-local spool directory.
+``login``/``logout``, ``launch``, ``cluster``, ``run``, ``device``,
+``model``, ``build``, ``logs``, ``train``, ``federate``, ``storage``,
+``diagnosis``, ``version`` — plus ``agent``/``jobs``/``env`` from the local
+scheduler.  The reference's account verbs talk to its SaaS; the self-hosted
+translation keeps the same verb surface against local state: credentials in
+``~/.fedml_tpu/credentials.json``, model cards + endpoints in the spool
+directory's sqlite/json stores, storage as a local object dir, diagnosis as
+an environment self-check.
 """
 
 from __future__ import annotations
@@ -99,6 +102,234 @@ def cmd_version(args) -> int:
     return 0
 
 
+# -- account (reference login.py/logout.py; local credentials file) ----------
+
+def _cred_path() -> Path:
+    return Path(os.path.expanduser("~/.fedml_tpu/credentials.json"))
+
+
+def cmd_login(args) -> int:
+    p = _cred_path()
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps({"account": args.account, "api_key": args.api_key or ""}))
+    p.chmod(0o600)  # the api key is a secret; never world-readable
+    print(f"logged in as {args.account}")
+    return 0
+
+
+def cmd_logout(args) -> int:
+    p = _cred_path()
+    if p.exists():
+        p.unlink()
+    print("logged out")
+    return 0
+
+
+# -- train / federate (reference train.py / federate.py job verbs) -----------
+
+def cmd_train(args) -> int:
+    """Centralized training job (reference ``fedml train``)."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = fedml_tpu.init(argv=["--cf", args.config])
+    cfg.training_type = "centralized"
+    history = FedMLRunner(cfg).run()
+    if history:
+        print(json.dumps(history[-1]))
+    return 0
+
+
+def cmd_federate(args) -> int:
+    """Federated job (reference ``fedml federate``) — refuses a centralized
+    recipe instead of silently running one."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = fedml_tpu.init(argv=["--cf", args.config])
+    if cfg.training_type == "centralized":
+        print("error: 'federate' needs a federated training_type "
+              "(simulation/cross_silo/cross_device); use 'train' for centralized",
+              file=sys.stderr)
+        return 2
+    history = FedMLRunner(cfg).run()
+    if history:
+        print(json.dumps(history[-1]))
+    return 0
+
+
+# -- model (reference model.py: create/list/deploy/run against the deploy
+#    scheduler, local card registry in the spool) -----------------------------
+
+def _card_registry(spool: str) -> Path:
+    p = Path(spool) / "model_cards.json"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    if not p.exists():
+        p.write_text("{}")
+    return p
+
+
+def cmd_model(args) -> int:
+    reg = _card_registry(args.spool)
+    cards = json.loads(reg.read_text())
+    if args.model_cmd == "create":
+        cards[f"{args.name}:{args.model_version}"] = {
+            "name": args.name, "version": args.model_version,
+            "model": args.arch, "classes": args.classes, "params_path": args.params,
+        }
+        reg.write_text(json.dumps(cards, indent=2))
+        print(f"registered {args.name}:{args.model_version}")
+        return 0
+    if args.model_cmd == "list":
+        for key, card in sorted(cards.items()):
+            print(json.dumps(card))
+        return 0
+    if args.model_cmd == "delete":
+        removed = [k for k in list(cards) if k.split(":")[0] == args.name]
+        for k in removed:
+            del cards[k]
+        reg.write_text(json.dumps(cards, indent=2))
+        print(f"deleted {len(removed)} card(s)")
+        return 0
+    if args.model_cmd == "deploy":
+        from fedml_tpu.serving.deploy import ModelCard, ModelDeployScheduler
+
+        key = f"{args.name}:{args.model_version}"
+        if key not in cards:
+            print(f"error: no card {key}", file=sys.stderr)
+            return 2
+        sched = ModelDeployScheduler(str(Path(args.spool) / "endpoints.db"))
+        sched.cards.register(ModelCard(**cards[key]))
+        sched.deploy(args.endpoint, args.name, args.model_version, replicas=args.replicas)
+        ok = sched.wait_ready(args.endpoint, replicas=args.replicas, timeout=args.timeout)
+        ep = sched.endpoints[args.endpoint]
+        print(json.dumps({"endpoint": args.endpoint, "ready": ok,
+                          "ports": ep.ready_ports()}))
+        if ok and args.watch:
+            # foreground reconcile until interrupted — the CLI owns the
+            # replica processes for the session
+            sched.run_in_thread()
+            try:
+                import time as _t
+
+                while True:
+                    _t.sleep(1)
+            except KeyboardInterrupt:
+                pass
+        # a one-shot CLI cannot own background processes: stop the endpoint
+        # on exit either way (use --watch to keep serving)
+        sched.stop()
+        return 0 if ok else 1
+    print(f"unknown model subcommand {args.model_cmd}", file=sys.stderr)
+    return 2
+
+
+# -- device / cluster (reference device.py / cluster.py; local semantics) ----
+
+def cmd_device(args) -> int:
+    import jax
+
+    devices = [
+        {"id": d.id, "kind": getattr(d, "device_kind", d.platform), "platform": d.platform}
+        for d in jax.devices()
+    ]
+    print(json.dumps({"host_devices": devices, "process_index": jax.process_index(),
+                      "process_count": jax.process_count()}, indent=2))
+    return 0
+
+
+def cmd_cluster(args) -> int:
+    from fedml_tpu.sched.agent import JobDB
+
+    db_path = Path(args.spool) / "jobs.sqlite"
+    jobs = JobDB(str(db_path)).all_jobs() if db_path.exists() else []
+    running = [j for j in jobs if j.get("status") == "RUNNING"]
+    print(json.dumps({"spool": args.spool, "jobs_total": len(jobs),
+                      "running": len(running)}, indent=2))
+    return 0
+
+
+# -- storage (reference storage.py; local object dir) ------------------------
+
+def cmd_storage(args) -> int:
+    root = (Path(args.spool) / "storage").resolve()
+    root.mkdir(parents=True, exist_ok=True)
+
+    def contained(name: str) -> Path:
+        """Resolve an object name INSIDE the storage root; '..'-style
+        traversal out of the object dir is refused."""
+        p = (root / name).resolve()
+        if not p.is_relative_to(root):
+            print(f"error: object name {name!r} escapes the storage root", file=sys.stderr)
+            raise SystemExit(2)
+        return p
+
+    if args.storage_cmd == "upload":
+        src = Path(args.path)
+        dest = contained(src.name)
+        dest.write_bytes(src.read_bytes())
+        print(str(dest))
+        return 0
+    if args.storage_cmd == "download":
+        src = contained(args.path)
+        if not src.exists():
+            print(f"error: no object {args.path}", file=sys.stderr)
+            return 2
+        out = Path(args.output or args.path)
+        out.write_bytes(src.read_bytes())
+        print(str(out))
+        return 0
+    if args.storage_cmd == "list":
+        for p in sorted(root.iterdir()):
+            print(json.dumps({"name": p.name, "bytes": p.stat().st_size}))
+        return 0
+    if args.storage_cmd == "delete":
+        target = contained(args.path)
+        if target.exists():
+            target.unlink()
+            print("deleted")
+            return 0
+        print(f"error: no object {args.path}", file=sys.stderr)
+        return 2
+    return 2
+
+
+def cmd_diagnosis(args) -> int:
+    """Reference diagnosis.py checks SaaS/MQTT/S3 connectivity; here the
+    self-hosted equivalents: jax backend usable, a jit executes, the spool is
+    writable, and the TCP transport can bind."""
+    import socket
+
+    checks = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        checks["jax_backend"] = jax.default_backend()
+        checks["jit_executes"] = bool(jax.jit(lambda x: x + 1)(jnp.ones(8))[0] == 2.0)
+    except Exception as e:
+        checks["jax_error"] = f"{type(e).__name__}: {e}"
+    try:
+        Path(args.spool).mkdir(parents=True, exist_ok=True)
+        probe = Path(args.spool) / ".diag"
+        probe.write_text("ok")
+        probe.unlink()
+        checks["spool_writable"] = True
+    except Exception as e:
+        checks["spool_writable"] = f"{type(e).__name__}: {e}"
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            checks["tcp_bind"] = True
+    except Exception as e:
+        checks["tcp_bind"] = f"{type(e).__name__}: {e}"
+    ok = checks.get("jit_executes") is True and checks.get("spool_writable") is True \
+        and checks.get("tcp_bind") is True
+    checks["ok"] = ok
+    print(json.dumps(checks, indent=2))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="fedml-tpu")
     parser.add_argument("--spool", default=DEFAULT_SPOOL, help="local scheduler spool dir")
@@ -134,6 +365,63 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("version", help="print version")
     p.set_defaults(fn=cmd_version)
+
+    p = sub.add_parser("login", help="store local account credentials")
+    p.add_argument("account")
+    p.add_argument("--api-key", default="")
+    p.set_defaults(fn=cmd_login)
+
+    p = sub.add_parser("logout", help="remove local account credentials")
+    p.set_defaults(fn=cmd_logout)
+
+    p = sub.add_parser("train", help="run a centralized training recipe")
+    p.add_argument("--cf", dest="config", required=True)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("federate", help="run a federated recipe (refuses centralized)")
+    p.add_argument("--cf", dest="config", required=True)
+    p.set_defaults(fn=cmd_federate)
+
+    p = sub.add_parser("model", help="model card registry + deploy")
+    msub = p.add_subparsers(dest="model_cmd", required=True)
+    mc = msub.add_parser("create")
+    mc.add_argument("--name", required=True)
+    mc.add_argument("--model-version", default="v1")
+    mc.add_argument("--arch", required=True, help="model_hub name, e.g. lr/resnet20")
+    mc.add_argument("--classes", type=int, default=10)
+    mc.add_argument("--params", required=True, help="pytree-wire params file")
+    ml = msub.add_parser("list")
+    md = msub.add_parser("delete")
+    md.add_argument("--name", required=True)
+    mdep = msub.add_parser("deploy")
+    mdep.add_argument("--name", required=True)
+    mdep.add_argument("--model-version", default="v1")
+    mdep.add_argument("--endpoint", required=True)
+    mdep.add_argument("--replicas", type=int, default=1)
+    mdep.add_argument("--timeout", type=float, default=60.0)
+    mdep.add_argument("--watch", action="store_true")
+    p.set_defaults(fn=cmd_model)
+
+    p = sub.add_parser("device", help="show local accelerator devices")
+    p.set_defaults(fn=cmd_device)
+
+    p = sub.add_parser("cluster", help="show local cluster/agent status")
+    p.set_defaults(fn=cmd_cluster)
+
+    p = sub.add_parser("storage", help="local object storage")
+    ssub = p.add_subparsers(dest="storage_cmd", required=True)
+    su = ssub.add_parser("upload")
+    su.add_argument("path")
+    sd = ssub.add_parser("download")
+    sd.add_argument("path")
+    sd.add_argument("--output", default="")
+    ssub.add_parser("list")
+    sdel = ssub.add_parser("delete")
+    sdel.add_argument("path")
+    p.set_defaults(fn=cmd_storage)
+
+    p = sub.add_parser("diagnosis", help="environment/connectivity self-check")
+    p.set_defaults(fn=cmd_diagnosis)
 
     args = parser.parse_args(argv)
     return args.fn(args)

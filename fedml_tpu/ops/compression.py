@@ -59,11 +59,22 @@ def qsgd(vec: jax.Array, key: jax.Array, levels: int = 256) -> jax.Array:
     return jnp.sign(vec) * q * norm / levels
 
 
+def qsgd_int8_fused(vec: jax.Array, key: jax.Array, interpret: bool = False) -> jax.Array:
+    """Block-scaled stochastic int8 quantize+dequantize via the Pallas TPU
+    kernel (``ops/pallas/quantize.py``) — the fused fast path for the QSGD
+    semantics (one HBM read + int8 write instead of materialized f32
+    intermediates).  ``interpret=True`` for CPU/CI."""
+    from .pallas import qsgd_int8
+
+    return qsgd_int8(vec, key, interpret=interpret)
+
+
 def compress(name: str, vec: jax.Array, *, key: Optional[jax.Array] = None,
              residual: Optional[jax.Array] = None, ratio: float = 0.01,
              quantize_level: int = 8):
     """Dispatch matching reference ``compression`` config values
-    (``no | topk | eftopk | quantize | qsgd``).  Returns (vec, new_residual)."""
+    (``no | topk | eftopk | quantize | qsgd``), plus ``qsgd_int8`` — the
+    Pallas-fused block-scaled int8 fast path.  Returns (vec, new_residual)."""
     if name in ("no", "", None):
         return vec, residual
     if name == "topk":
@@ -74,4 +85,9 @@ def compress(name: str, vec: jax.Array, *, key: Optional[jax.Array] = None,
         return quantize_naive(vec, 2 ** quantize_level), residual
     if name == "qsgd":
         return qsgd(vec, key, 2 ** quantize_level), residual
+    if name == "qsgd_int8":
+        import jax as _jax
+
+        # the pallas interpreter is required off-TPU (CPU CI)
+        return qsgd_int8_fused(vec, key, interpret=_jax.default_backend() != "tpu"), residual
     raise ValueError(f"unknown compression {name!r}")

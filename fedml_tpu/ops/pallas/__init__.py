@@ -1,0 +1,21 @@
+"""Pallas TPU kernels (hand-written hot ops the XLA autofuser can't shape).
+
+Current kernels:
+- ``quantize.quantize_int8_stochastic`` / ``dequantize_int8`` — fused
+  block-scaled stochastic int8 gradient quantization for the FedSGD
+  compression path.
+"""
+
+from .quantize import (
+    dequantize_int8,
+    qsgd_int8,
+    quantize_int8_reference,
+    quantize_int8_stochastic,
+)
+
+__all__ = [
+    "dequantize_int8",
+    "qsgd_int8",
+    "quantize_int8_reference",
+    "quantize_int8_stochastic",
+]

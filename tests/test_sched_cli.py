@@ -110,3 +110,92 @@ def test_cli_run_subprocess(tmp_path):
     assert out.returncode == 0, out.stderr[-2000:]
     last = json.loads(out.stdout.strip().splitlines()[-1])
     assert "test_acc" in last
+
+
+def test_cli_account_model_storage_diagnosis(tmp_path, eight_devices, monkeypatch):
+    """The reference CLI verb surface in self-hosted semantics (VERDICT row 1):
+    login/logout, model create/list/deploy, storage, device, cluster,
+    diagnosis."""
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu import cli
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.serving.deploy import save_params_card
+    from .conftest import tiny_config
+
+    monkeypatch.setattr(cli, "_cred_path", lambda: tmp_path / "creds.json")
+    spool = str(tmp_path / "spool")
+
+    assert cli.main(["--spool", spool, "login", "alice", "--api-key", "k1"]) == 0
+    assert _json.loads((tmp_path / "creds.json").read_text())["account"] == "alice"
+    assert cli.main(["--spool", spool, "logout"]) == 0
+    assert not (tmp_path / "creds.json").exists()
+
+    # model registry + deploy + predict through the scheduler
+    cfg = tiny_config()
+    model = model_hub.create(cfg, 10)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 60)), train=True)
+    params = save_params_card(variables, str(tmp_path / "lr.wire"))
+    assert cli.main(["--spool", spool, "model", "create", "--name", "m1",
+                     "--arch", "lr", "--classes", "10", "--params", params]) == 0
+    assert cli.main(["--spool", spool, "model", "list"]) == 0
+    assert cli.main(["--spool", spool, "model", "deploy", "--name", "m1",
+                     "--endpoint", "e1", "--timeout", "60"]) == 0
+
+    # storage roundtrip
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"hello")
+    assert cli.main(["--spool", spool, "storage", "upload", str(src)]) == 0
+    assert cli.main(["--spool", spool, "storage", "list"]) == 0
+    out = tmp_path / "blob.out"
+    assert cli.main(["--spool", spool, "storage", "download", "blob.bin",
+                     "--output", str(out)]) == 0
+    assert out.read_bytes() == b"hello"
+    assert cli.main(["--spool", spool, "storage", "delete", "blob.bin"]) == 0
+
+    assert cli.main(["--spool", spool, "device"]) == 0
+    assert cli.main(["--spool", spool, "cluster"]) == 0
+    assert cli.main(["--spool", spool, "diagnosis"]) == 0
+
+
+def test_cli_federate_refuses_centralized(tmp_path, eight_devices):
+    from fedml_tpu import cli
+
+    cfg_yaml = tmp_path / "central.yaml"
+    cfg_yaml.write_text(
+        "common_args:\n  training_type: \"centralized\"\n"
+        "data_args:\n  dataset: \"synthetic\"\n  synthetic_train_size: 64\n"
+        "  synthetic_test_size: 32\nmodel_args:\n  model: \"lr\"\n"
+        "train_args:\n  comm_round: 1\n  batch_size: 16\n"
+    )
+    assert cli.main(["federate", "--cf", str(cfg_yaml)]) == 2
+
+
+def test_cli_storage_refuses_traversal(tmp_path):
+    from fedml_tpu import cli
+
+    spool = str(tmp_path / "spool")
+    victim = tmp_path / "spool" / "jobs.sqlite"
+    victim.parent.mkdir(parents=True)
+    victim.write_text("precious")
+    import pytest as _pt
+
+    with _pt.raises(SystemExit):
+        cli.main(["--spool", spool, "storage", "delete", "../jobs.sqlite"])
+    assert victim.exists()
+
+
+def test_compress_dispatch_qsgd_int8(eight_devices):
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.ops.compression import compress
+
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (3000,))
+    out, _ = compress("qsgd_int8", x, key=k)
+    assert out.shape == x.shape
+    assert float(jnp.abs(out - x).max()) < 0.2  # one int8 step per block
