@@ -111,8 +111,11 @@ def _cred_path() -> Path:
 def cmd_login(args) -> int:
     p = _cred_path()
     p.parent.mkdir(parents=True, exist_ok=True)
-    p.write_text(json.dumps({"account": args.account, "api_key": args.api_key or ""}))
-    p.chmod(0o600)  # the api key is a secret; never world-readable
+    # create 0600 from the first byte — chmod-after-write leaves a window
+    # where the api key is world-readable under umask 022
+    fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(json.dumps({"account": args.account, "api_key": args.api_key or ""}))
     print(f"logged in as {args.account}")
     return 0
 
@@ -264,10 +267,12 @@ def cmd_storage(args) -> int:
             raise SystemExit(2)
         return p
 
+    import shutil
+
     if args.storage_cmd == "upload":
         src = Path(args.path)
         dest = contained(src.name)
-        dest.write_bytes(src.read_bytes())
+        shutil.copyfile(src, dest)  # streaming copy — objects can be GBs
         print(str(dest))
         return 0
     if args.storage_cmd == "download":
@@ -276,7 +281,7 @@ def cmd_storage(args) -> int:
             print(f"error: no object {args.path}", file=sys.stderr)
             return 2
         out = Path(args.output or args.path)
-        out.write_bytes(src.read_bytes())
+        shutil.copyfile(src, out)
         print(str(out))
         return 0
     if args.storage_cmd == "list":

@@ -58,8 +58,18 @@ class PahoMqttBroker:
         self._subs: dict[str, list[Callable[[str, bytes], None]]] = {}
         self._lock = threading.Lock()
         self._client.on_message = self._dispatch
+        # clean-session reconnects start with ZERO subscriptions: re-issue
+        # every subscribe on (re)connect or a broker restart silently drops
+        # all FL-round traffic
+        self._client.on_connect = self._on_connect
         self._host, self._port, self._keepalive = host, port, keepalive
         self._connected = False
+
+    def _on_connect(self, client, userdata, *args, **kwargs) -> None:
+        with self._lock:
+            topics = list(self._subs)
+        for t in topics:
+            client.subscribe(t, qos=2)
 
     def _ensure_connected(self) -> None:
         if not self._connected:

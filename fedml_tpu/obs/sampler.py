@@ -1,0 +1,182 @@
+"""Device perf sampler + runtime log daemon.
+
+Parity with ``core/mlops/mlops_device_perfs.py:30`` (a background process
+streaming CPU/memory/GPU utilization at an interval) and
+``mlops_runtime_log_daemon.py:18`` (a daemon batching run log lines and
+shipping them to the backend).  TPU translation:
+
+- :class:`DevicePerfSampler` — a daemon thread sampling host CPU/memory
+  (psutil when present, /proc fallback) and per-device accelerator memory
+  (``jax.Device.memory_stats()``, which TPU backends expose) into a
+  MetricsLogger sink — consumable as jsonl streams by any collector.
+- :class:`RuntimeLogDaemon` — tails a log file, batches complete lines, and
+  hands them to a sink callable (local default: an offset-tracked spool
+  file; a SaaS uploader is just a different sink).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from .metrics import MetricsLogger
+
+try:  # psutil is optional; /proc fallback below
+    import psutil as _psutil
+except ImportError:  # pragma: no cover
+    _psutil = None
+
+
+def read_host_stats() -> dict:
+    """CPU/memory utilization for this host (reference system_stats.py)."""
+    out: dict = {}
+    if _psutil is not None:
+        out["cpu_utilization"] = _psutil.cpu_percent(interval=None)
+        vm = _psutil.virtual_memory()
+        out["system_memory_utilization"] = vm.percent
+        p = _psutil.Process()
+        out["process_memory_in_use_mb"] = p.memory_info().rss / 1e6
+        out["process_cpu_threads_in_use"] = p.num_threads()
+        return out
+    # /proc fallback (linux)
+    try:
+        with open("/proc/loadavg") as f:
+            out["loadavg_1m"] = float(f.read().split()[0])
+        with open("/proc/meminfo") as f:
+            mem = {l.split(":")[0]: int(l.split()[1]) for l in f if ":" in l}
+        total, avail = mem.get("MemTotal", 1), mem.get("MemAvailable", 0)
+        out["system_memory_utilization"] = round(100.0 * (1 - avail / total), 2)
+        with open(f"/proc/{os.getpid()}/statm") as f:
+            out["process_memory_in_use_mb"] = int(f.read().split()[1]) * 4096 / 1e6
+    except OSError:
+        pass
+    return out
+
+
+def read_device_stats() -> list[dict]:
+    """Per-accelerator memory stats (the TPU stand-in for the reference's
+    nvidia-smi GPU utilization stream)."""
+    import jax
+
+    devices = []
+    for d in jax.local_devices():
+        entry = {"device_id": d.id, "kind": getattr(d, "device_kind", d.platform)}
+        try:
+            stats = d.memory_stats() or {}
+            entry["bytes_in_use"] = stats.get("bytes_in_use")
+            entry["bytes_limit"] = stats.get("bytes_limit")
+            if entry.get("bytes_limit"):
+                entry["memory_utilization"] = round(
+                    100.0 * (entry.get("bytes_in_use") or 0) / entry["bytes_limit"], 2
+                )
+        except Exception:
+            pass  # not all backends expose memory_stats
+        devices.append(entry)
+    return devices
+
+
+class DevicePerfSampler:
+    """Stream host + device stats every ``interval_s`` to a MetricsLogger."""
+
+    def __init__(self, logger: Optional[MetricsLogger] = None, interval_s: float = 10.0,
+                 include_devices: bool = True):
+        self.logger = logger or MetricsLogger(stdout=False)
+        self.interval_s = interval_s
+        self.include_devices = include_devices
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples = 0
+
+    def sample_once(self) -> dict:
+        sample = {"perf_ts": time.time(), **read_host_stats()}
+        if self.include_devices:
+            sample["devices"] = read_device_stats()
+        self.logger.log(sample)
+        self.samples += 1
+        return sample
+
+    def start(self) -> "DevicePerfSampler":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.sample_once()
+                except Exception:  # the sampler must never kill training
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class RuntimeLogDaemon:
+    """Tail ``log_path``; every sweep, ship complete new lines to ``sink``
+    (batched, offset-tracked — reference MLOpsRuntimeLogProcessor.log_upload)."""
+
+    def __init__(self, log_path: str, sink: Optional[Callable[[list[str]], None]] = None,
+                 spool_path: Optional[str] = None, interval_s: float = 2.0,
+                 batch_lines: int = 1000):
+        self.log_path = Path(log_path)
+        self.interval_s = interval_s
+        self.batch_lines = batch_lines
+        self._offset = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if sink is None:
+            spool = Path(spool_path or (str(log_path) + ".uploaded"))
+
+            def sink(lines: list[str]) -> None:
+                with open(spool, "a") as f:
+                    f.writelines(l + "\n" for l in lines)
+
+        self.sink = sink
+        self.shipped = 0
+
+    def sweep_once(self) -> int:
+        if not self.log_path.exists():
+            return 0
+        # truncation/rotation: a shrunken file means a new log generation —
+        # restart from 0 or shipping silently stops forever
+        if self.log_path.stat().st_size < self._offset:
+            self._offset = 0
+        with open(self.log_path, "rb") as f:
+            f.seek(self._offset)
+            chunk = f.read()
+        if not chunk:
+            return 0
+        # only complete lines ship; a trailing partial waits for the next sweep
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:
+            return 0
+        complete = chunk[: last_nl + 1]
+        self._offset += len(complete)
+        lines = complete.decode(errors="replace").splitlines()
+        for i in range(0, len(lines), self.batch_lines):
+            self.sink(lines[i : i + self.batch_lines])
+        self.shipped += len(lines)
+        return len(lines)
+
+    def start(self) -> "RuntimeLogDaemon":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.sweep_once()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.sweep_once()  # final drain

@@ -1,0 +1,107 @@
+"""Observability tests: device perf sampler + runtime log daemon (VERDICT
+row 44, reference mlops_device_perfs.py / mlops_runtime_log_daemon.py), plus
+the invert-gradient privacy attack variant (row 32)."""
+
+import json
+import time
+
+import numpy as np
+
+from .conftest import tiny_config
+
+
+def test_device_perf_sampler_streams(tmp_path, eight_devices):
+    from fedml_tpu.obs.metrics import MetricsLogger
+    from fedml_tpu.obs.sampler import DevicePerfSampler
+
+    path = tmp_path / "perf.jsonl"
+    logger = MetricsLogger(str(path), stdout=False)
+    sampler = DevicePerfSampler(logger, interval_s=0.1)
+    s = sampler.sample_once()
+    assert "perf_ts" in s
+    assert "system_memory_utilization" in s or "loadavg_1m" in s
+    assert isinstance(s["devices"], list) and s["devices"]
+    assert "kind" in s["devices"][0]
+
+    sampler.start()
+    time.sleep(0.45)
+    sampler.stop()
+    assert sampler.samples >= 3
+    lines = [json.loads(l) for l in path.read_text().splitlines() if l.strip()]
+    assert len(lines) >= 3
+
+
+def test_runtime_log_daemon_ships_batches(tmp_path):
+    from fedml_tpu.obs.sampler import RuntimeLogDaemon
+
+    log = tmp_path / "run.log"
+    shipped: list[list[str]] = []
+    daemon = RuntimeLogDaemon(str(log), sink=shipped.append, interval_s=0.05, batch_lines=2)
+    log.write_text("line1\nline2\nline3\npartial")
+    assert daemon.sweep_once() == 3
+    assert [l for batch in shipped for l in batch] == ["line1", "line2", "line3"]
+    # the partial line ships once completed
+    with open(log, "a") as f:
+        f.write("-done\nline5\n")
+    assert daemon.sweep_once() == 2
+    assert [l for b in shipped for l in b][-2:] == ["partial-done", "line5"]
+
+    # default sink: offset-tracked spool file, no duplicates across sweeps
+    log2 = tmp_path / "run2.log"
+    d2 = RuntimeLogDaemon(str(log2), interval_s=0.05)
+    log2.write_text("a\nb\n")
+    d2.start()
+    time.sleep(0.3)
+    d2.stop()
+    uploaded = (tmp_path / "run2.log.uploaded").read_text().splitlines()
+    assert uploaded == ["a", "b"]
+
+
+def test_invert_gradient_attack_reconstructs(eight_devices):
+    """Known-label cosine-matching inversion must recover the victim input
+    substantially better than the random init does (reference
+    invert_gradient_attack.py capability)."""
+    import jax
+    import jax.numpy as jnp
+    import fedml_tpu
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.trust.attack.dlg import invert_gradient_attack
+
+    cfg = tiny_config()
+    fedml_tpu.init(cfg)
+    model = model_hub.create(cfg, 10)  # LR on 60-dim features
+    k = jax.random.PRNGKey(0)
+    x_true = jax.random.normal(k, (2, 60))
+    y_true = jnp.array([3, 7])
+    variables = model.init({"params": jax.random.PRNGKey(1)}, x_true, train=True)
+
+    def loss(v, x, y_onehot):
+        logits = model.apply(v, x, train=False)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * y_onehot, axis=-1))
+
+    victim_grads = jax.grad(loss)(variables, x_true, jax.nn.one_hot(y_true, 10))
+
+    def grad_fn(x, y_onehot):
+        return jax.grad(loss)(variables, x, y_onehot)
+
+    x_hat, final = invert_gradient_attack(
+        grad_fn, victim_grads, x_true.shape, y_true, jax.random.PRNGKey(2),
+        steps=400, lr=0.05,
+    )
+    err = float(jnp.abs(x_hat - x_true).mean())
+    base = float(jnp.abs(jax.random.normal(jax.random.PRNGKey(2), x_true.shape) * 0.1 - x_true).mean())
+    assert np.isfinite(final)
+    assert err < 0.6 * base, (err, base)
+
+
+def test_log_daemon_handles_truncation(tmp_path):
+    from fedml_tpu.obs.sampler import RuntimeLogDaemon
+
+    log = tmp_path / "r.log"
+    shipped = []
+    d = RuntimeLogDaemon(str(log), sink=shipped.append)
+    log.write_text("one\ntwo\n")
+    assert d.sweep_once() == 2
+    log.write_text("fresh\n")  # rotation: file shrank
+    assert d.sweep_once() == 1
+    assert [l for b in shipped for l in b] == ["one", "two", "fresh"]

@@ -143,7 +143,7 @@ def test_cli_account_model_storage_diagnosis(tmp_path, eight_devices, monkeypatc
                      "--arch", "lr", "--classes", "10", "--params", params]) == 0
     assert cli.main(["--spool", spool, "model", "list"]) == 0
     assert cli.main(["--spool", spool, "model", "deploy", "--name", "m1",
-                     "--endpoint", "e1", "--timeout", "60"]) == 0
+                     "--endpoint", "e1", "--timeout", "120"]) == 0
 
     # storage roundtrip
     src = tmp_path / "blob.bin"
