@@ -116,6 +116,8 @@ def cmd_login(args) -> int:
     fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     with os.fdopen(fd, "w") as f:
         f.write(json.dumps({"account": args.account, "api_key": args.api_key or ""}))
+    # os.open's mode applies only at CREATION; tighten a pre-existing file too
+    os.chmod(p, 0o600)
     print(f"logged in as {args.account}")
     return 0
 

@@ -79,6 +79,10 @@ class FedMLCommManager(Observer):
             from .mqtt_s3 import MqttS3CommManager
 
             return MqttS3CommManager(getattr(self.cfg, "run_id", "0"), self.rank)
+        if b in (C.COMM_BACKEND_WEB3, C.COMM_BACKEND_THETA):
+            from .blockchain import BlockchainCommManager
+
+            return BlockchainCommManager(getattr(self.cfg, "run_id", "0"), self.rank)
         if b == C.COMM_BACKEND_TCP:
             from .tcp_backend import TCPCommManager
 
@@ -90,5 +94,5 @@ class FedMLCommManager(Observer):
             )
         raise ValueError(
             f"unknown comm backend {b!r}; known: "
-            f"{[C.COMM_BACKEND_INPROC, C.COMM_BACKEND_GRPC, C.COMM_BACKEND_MQTT_S3, C.COMM_BACKEND_TCP]}"
+            f"{[C.COMM_BACKEND_INPROC, C.COMM_BACKEND_GRPC, C.COMM_BACKEND_MQTT_S3, C.COMM_BACKEND_TCP, C.COMM_BACKEND_WEB3, C.COMM_BACKEND_THETA]}"
         )

@@ -56,6 +56,8 @@ COMM_BACKEND_MQTT_S3 = "MQTT_S3"
 COMM_BACKEND_TCP = "TCP"  # polyglot frame transport (native/ C++ client)
 COMM_BACKEND_TRPC = "TRPC"
 COMM_BACKEND_MPI = "MPI"
+COMM_BACKEND_WEB3 = "WEB3"  # messages as ledger transactions (comm/blockchain.py)
+COMM_BACKEND_THETA = "THETASTORE"
 
 # Device / engine
 ENGINE_JAX = "jax"

@@ -139,7 +139,7 @@ class RuntimeLogDaemon:
         self.sink = sink
         self.shipped = 0
 
-    def sweep_once(self) -> int:
+    def sweep_once(self, final: bool = False) -> int:
         if not self.log_path.exists():
             return 0
         # truncation/rotation: a shrunken file means a new log generation —
@@ -151,11 +151,13 @@ class RuntimeLogDaemon:
             chunk = f.read()
         if not chunk:
             return 0
-        # only complete lines ship; a trailing partial waits for the next sweep
+        # only complete lines ship; a trailing partial waits for the next
+        # sweep — EXCEPT on the final drain, where it would be lost forever
+        # (a crash's last line is usually the diagnostic one)
         last_nl = chunk.rfind(b"\n")
-        if last_nl < 0:
+        if last_nl < 0 and not final:
             return 0
-        complete = chunk[: last_nl + 1]
+        complete = chunk if final else chunk[: last_nl + 1]
         self._offset += len(complete)
         lines = complete.decode(errors="replace").splitlines()
         for i in range(0, len(lines), self.batch_lines):
@@ -179,4 +181,4 @@ class RuntimeLogDaemon:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
-        self.sweep_once()  # final drain
+        self.sweep_once(final=True)  # final drain ships trailing partials too

@@ -1,0 +1,175 @@
+"""Agent control plane — remote start/stop/status/OTA over the comm fabric.
+
+Parity with the reference agent's MQTT control plane
+(``computing/scheduler/slave/client_runner.py`` family: the MLOps platform
+publishes start_run/stop_run to per-edge topics; the agent subscribes,
+spools the package, reports status, and OTA-upgrades itself on command).
+
+Here the same four verbs ride the repo's own comm layer (MQTT in-memory
+fabric by default; any backend with a Message path works), so the control
+plane is hermetically testable and transport-pluggable:
+
+    START_RUN(package bytes)  -> write to the agent's spool queue (the agent
+                                 claims it on its next sweep)
+    STOP_RUN(run_id)          -> terminate the job process, mark KILLED
+    STATUS()                  -> reply with the job DB rows
+    OTA(package bytes, ver)   -> stage the new agent package + stamp a
+                                 restart marker (the supervisor restarts the
+                                 agent process; in-place code reload is
+                                 deliberately NOT attempted)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("fedml_tpu.sched.control_plane")
+
+from ..comm.comm_manager import FedMLCommManager
+from ..comm.message import Message
+from .agent import FedMLAgent
+
+import re
+
+MSG_TYPE_START_RUN = 40
+MSG_TYPE_STOP_RUN = 41
+MSG_TYPE_STATUS_REQUEST = 42
+MSG_TYPE_STATUS_REPLY = 43
+MSG_TYPE_OTA = 44
+
+KEY_PACKAGE = "package"
+KEY_RUN_ID = "cp_run_id"
+KEY_JOBS = "jobs"
+KEY_VERSION = "agent_version"
+
+_SAFE_NAME = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
+
+
+def _safe_name(value, what: str) -> str:
+    """Remote-controlled identifiers become filename components; anything
+    with separators ('../../x') is an arbitrary-path write on an open
+    transport — refuse it."""
+    name = str(value)
+    if not _SAFE_NAME.match(name) or name in (".", ".."):
+        raise ValueError(f"unsafe {what} {name!r} from control plane")
+    return name
+
+
+class AgentControlPlane(FedMLCommManager):
+    """Rank = agent's edge id; the controller (rank 0) sends verbs."""
+
+    def __init__(self, cfg, agent: FedMLAgent, rank: int, backend: Optional[str] = None):
+        super().__init__(cfg, rank=rank, size=0, backend=backend)
+        self.agent = agent
+        self.ota_dir = agent.spool / "ota"
+
+    def register_message_receive_handlers(self) -> None:
+        # a malformed/hostile message must be REJECTED, not allowed to kill
+        # the receive loop (the observer loop does not catch handler errors)
+        def guarded(handler):
+            def wrapper(msg: Message) -> None:
+                try:
+                    handler(msg)
+                except ValueError as e:
+                    log.warning("control-plane message rejected: %s", e)
+            return wrapper
+
+        self.register_message_receive_handler(MSG_TYPE_START_RUN, guarded(self.handle_start_run))
+        self.register_message_receive_handler(MSG_TYPE_STOP_RUN, guarded(self.handle_stop_run))
+        self.register_message_receive_handler(MSG_TYPE_STATUS_REQUEST, guarded(self.handle_status))
+        self.register_message_receive_handler(MSG_TYPE_OTA, guarded(self.handle_ota))
+
+    def handle_start_run(self, msg: Message) -> None:
+        import numpy as np
+
+        pkg_bytes = bytes(np.asarray(msg.get(KEY_PACKAGE), dtype=np.uint8))
+        run_id = _safe_name(msg.get(KEY_RUN_ID), "run_id")
+        dest = self.agent.queue / f"{run_id}.zip"
+        dest.write_bytes(pkg_bytes)
+        self.agent.db.upsert(run_id, status="QUEUED")
+
+    def handle_stop_run(self, msg: Message) -> None:
+        run_id = _safe_name(msg.get(KEY_RUN_ID), "run_id")
+        # a stop that races the sweep: remove a still-queued package so the
+        # next sweep cannot launch the supposedly-stopped job
+        queued = self.agent.queue / f"{run_id}.zip"
+        if queued.exists():
+            queued.unlink()
+        proc = self.agent._procs.pop(run_id, None)  # sweeps must not re-reap
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                proc.kill()
+        self.agent.db.upsert(run_id, status="KILLED", finished=time.time())
+
+    def handle_status(self, msg: Message) -> None:
+        reply = Message(MSG_TYPE_STATUS_REPLY, self.rank, msg.get_sender_id())
+        reply.add_params(KEY_JOBS, json.dumps(self.agent.db.all_jobs()))
+        self.send_message(reply)
+
+    def handle_ota(self, msg: Message) -> None:
+        """Stage the new agent package; a supervisor (systemd/k8s restart
+        policy) picks up the marker — reference's OTA upgrade path
+        (client_runner ota_upgrade) minus the in-place pip install."""
+        import numpy as np
+
+        self.ota_dir.mkdir(parents=True, exist_ok=True)
+        version = _safe_name(msg.get(KEY_VERSION, "unknown"), "agent_version")
+        pkg = self.ota_dir / f"agent-{version}.zip"
+        pkg.write_bytes(bytes(np.asarray(msg.get(KEY_PACKAGE), dtype=np.uint8)))
+        (self.ota_dir / "RESTART_REQUIRED").write_text(
+            json.dumps({"version": version, "package": str(pkg), "ts": time.time()})
+        )
+
+
+class AgentController(FedMLCommManager):
+    """The MLOps-platform role: sends verbs to agents, collects status."""
+
+    def __init__(self, cfg, backend: Optional[str] = None):
+        super().__init__(cfg, rank=0, size=0, backend=backend)
+        self.status_replies: dict[int, list[dict]] = {}
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MSG_TYPE_STATUS_REPLY, self._handle_status_reply)
+
+    def _handle_status_reply(self, msg: Message) -> None:
+        self.status_replies[msg.get_sender_id()] = json.loads(msg.get(KEY_JOBS))
+
+    def _package_msg(self, msg_type: int, edge_id: int, package_bytes: bytes) -> Message:
+        import numpy as np
+
+        msg = Message(msg_type, 0, edge_id)
+        msg.add_params(KEY_PACKAGE, np.frombuffer(package_bytes, dtype=np.uint8).copy())
+        return msg
+
+    def start_run(self, edge_id: int, run_id: str, package_bytes: bytes) -> None:
+        msg = self._package_msg(MSG_TYPE_START_RUN, edge_id, package_bytes)
+        msg.add_params(KEY_RUN_ID, run_id)
+        self.send_message(msg)
+
+    def stop_run(self, edge_id: int, run_id: str) -> None:
+        msg = Message(MSG_TYPE_STOP_RUN, 0, edge_id)
+        msg.add_params(KEY_RUN_ID, run_id)
+        self.send_message(msg)
+
+    def request_status(self, edge_id: int) -> None:
+        self.send_message(Message(MSG_TYPE_STATUS_REQUEST, 0, edge_id))
+
+    def push_ota(self, edge_id: int, version: str, package_bytes: bytes) -> None:
+        msg = self._package_msg(MSG_TYPE_OTA, edge_id, package_bytes)
+        msg.add_params(KEY_VERSION, version)
+        self.send_message(msg)
+
+    def wait_status(self, edge_id: int, timeout: float = 10.0) -> Optional[list[dict]]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if edge_id in self.status_replies:
+                return self.status_replies.pop(edge_id)
+            time.sleep(0.05)
+        return None
