@@ -33,7 +33,19 @@ log = logging.getLogger("fedml_tpu.cross_silo.client")
 
 
 class FedMLTrainer:
-    """Local training operator (reference ``FedMLTrainer.train`` :71)."""
+    """Local training operator (reference ``FedMLTrainer.train`` :71).
+
+    Intra-silo data parallelism (the reference's torchrun-DDP-in-silo,
+    ``fedml_trainer_dist_adapter.py``): when the silo host has multiple
+    accelerators, each training step's minibatch is sharding-constrained
+    over a silo-local ``data`` mesh axis INSIDE the jitted program, so GSPMD
+    partitions the fwd/bwd compute per device and inserts the gradient
+    all-reduce that DDP does with NCCL hooks.  (Sharding only the at-rest
+    arrays would be undone by the random-index batch gather — verified via
+    HLO in the tests.)  Numerics are identical to the single-device run.
+    Requires batch_size divisible by the local device count; refused loudly
+    otherwise.  Disable with ``cfg.extra['silo_dp'] = False``.
+    """
 
     def __init__(self, cfg, model, x: np.ndarray, y: np.ndarray):
         cap = ((x.shape[0] + cfg.batch_size - 1) // cfg.batch_size) * cfg.batch_size
@@ -43,7 +55,36 @@ class FedMLTrainer:
         self.count = jnp.int32(x.shape[0])
         spe = max(1, math.ceil(cap / cfg.batch_size))
         self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
-        self._train = jax.jit(make_local_train_fn(model, self.hp))
+        n_local = len(jax.local_devices())
+        self.dp_active = False
+        batch_constraint = None
+        if n_local > 1 and bool((getattr(cfg, "extra", {}) or {}).get("silo_dp", True)):
+            if cfg.batch_size % n_local == 0:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ..parallel import mesh as meshlib
+
+                silo_mesh = meshlib.make_mesh((meshlib.AXIS_DATA,), (n_local,), jax.local_devices())
+
+                def batch_constraint(bx, by):
+                    # the batch dim is what partitions the compute; at-rest
+                    # array sharding alone gets undone by the index gather
+                    cx = jax.lax.with_sharding_constraint(
+                        bx, NamedSharding(silo_mesh, P(meshlib.AXIS_DATA, *([None] * (bx.ndim - 1)))))
+                    cy = jax.lax.with_sharding_constraint(
+                        by, NamedSharding(silo_mesh, P(meshlib.AXIS_DATA, *([None] * (by.ndim - 1)))))
+                    return cx, cy
+
+                self.dp_active = True
+            else:
+                log.warning(
+                    "silo_dp requested but batch_size %d is not divisible by "
+                    "the %d local devices — intra-silo data parallelism is "
+                    "DISABLED for this silo (make batch_size a multiple of "
+                    "the device count to enable it)",
+                    cfg.batch_size, n_local,
+                )
+        self._train = jax.jit(make_local_train_fn(model, self.hp, batch_constraint=batch_constraint))
 
     def train(self, global_vars, round_idx: int, seed_key, client_idx: int = 0) -> tuple:
         # per-client RNG stream keyed by the server-assigned client index —

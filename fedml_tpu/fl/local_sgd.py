@@ -55,11 +55,19 @@ def make_local_train_fn(
     hp: HParams,
     loss_extra: Optional[Callable] = None,
     grad_hook: Optional[Callable] = None,
+    batch_constraint: Optional[Callable] = None,
 ):
     """Build ``local_train(variables, x, y, count, key, ctx) -> (new_variables, metrics)``.
 
     ``ctx`` is an arbitrary pytree threaded to the hooks (global params,
     control variates, server momentum...).  All shapes static; jit/vmap-safe.
+
+    ``batch_constraint(bx, by) -> (bx, by)`` is applied to each step's
+    gathered minibatch — the intra-silo data-parallel hook: constraining the
+    batch dim to a device axis makes GSPMD partition the fwd/bwd compute and
+    insert the gradient all-reduce (without it, sharding only the at-rest
+    arrays gets re-assembled by the random-index gather and the compute
+    replicates).
     """
     if hp.steps_per_epoch <= 0:
         raise ValueError(
@@ -109,6 +117,8 @@ def make_local_train_fn(
             idx = jax.lax.dynamic_slice_in_dim(perm, step_in_epoch * bsz, bsz)
             bx = jnp.take(x, idx, axis=0)
             by = jnp.take(y, idx, axis=0)
+            if batch_constraint is not None:
+                bx, by = batch_constraint(bx, by)
             dkey = jax.random.fold_in(ekey, 2 + step_in_epoch)
             (loss, new_rest), grads = grad_fn(params, rest, bx, by, dkey, ctx)
             if grad_hook is not None:
