@@ -96,6 +96,27 @@ def shard_leading_axis(tree, mesh: Mesh, axis: str = AXIS_CLIENTS, warn: bool = 
 
     from .multihost import make_global_array
 
+    if axis not in mesh.shape:
+        if axis != AXIS_CLIENTS:
+            # an explicit axis that doesn't exist is a caller bug, not a
+            # convention to paper over
+            raise KeyError(
+                f"mesh has no axis {axis!r} (axes: {mesh.axis_names}); "
+                "pass one of the mesh's axes"
+            )
+        # the default stacked-clients axis on a mesh without one (e.g.
+        # hierarchical's 2-D ("silo", "data")) shards over the FIRST axis —
+        # the outer FL axis by this module's convention (P5 row above) —
+        # and says so
+        import warnings
+
+        warnings.warn(
+            f"shard_leading_axis: mesh has no {AXIS_CLIENTS!r} axis; "
+            f"sharding the stacked-client dim over {mesh.axis_names[0]!r} "
+            f"(the outer axis of {dict(mesh.shape)})",
+            stacklevel=3,
+        )
+        axis = mesh.axis_names[0]
     size = mesh.shape[axis]
 
     def put(x):

@@ -106,3 +106,21 @@ def test_vertical_fl(eight_devices):
              epochs=2, frequency_of_the_test=2)
     accs = [m["test_acc"] for m in h if "test_acc" in m]
     assert accs[-1] > 0.4, accs
+
+
+def test_hierarchical_over_2d_silo_mesh(eight_devices):
+    """The P5 design: hierarchical FL over a 2-D (silo, data) mesh — the
+    stacked clients shard over the outer silo axis (shard_leading_axis falls
+    back to the mesh's first axis when 'clients' is absent)."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(
+        federated_optimizer="HierarchicalFL", client_num_in_total=8,
+        client_num_per_round=8, comm_round=2, group_num=2, group_comm_round=2,
+        mesh_shape="silo:2,data:4", frequency_of_the_test=1,
+    )
+    fedml_tpu.init(cfg)
+    history = FedMLRunner(cfg).run()
+    assert np.isfinite(history[-1]["train_loss"])
+    assert history[-1]["test_acc"] > 0.2
