@@ -86,6 +86,7 @@ class FedMLAlgorithmFlow(FedMLCommManager):
         self._steps: list[tuple[str, Callable, str, str]] = []  # (name, task, cls, tag)
         self._built = False
         self._inbox: dict[int, dict[int, Params]] = {}  # step -> sender -> params
+        self._fired: set[int] = set()  # step indices already executed locally
         self._executed: list[str] = []
         self.done = threading.Event()
         self._lock = threading.Lock()
@@ -130,6 +131,8 @@ class FedMLAlgorithmFlow(FedMLCommManager):
         # step 0 starts unconditionally on its owning class (reference
         # _on_ready_to_run_flow)
         if self._steps[0][2] == self.executor_cls:
+            with self._lock:
+                self._fired.add(0)
             self._execute_step(0, upstream=[])
         if not self.done.wait(timeout):
             self.finish()
@@ -153,10 +156,16 @@ class FedMLAlgorithmFlow(FedMLCommManager):
             box = self._inbox.setdefault(step_idx, {})
             box[msg.get_sender_id()] = params
             ready = set(box) >= set(self._upstream_nodes(step_idx))
-        if ready:
-            self._execute_step(step_idx, upstream=[
-                self._inbox[step_idx][i] for i in sorted(self._inbox[step_idx])
-            ])
+            # at-least-once transports (MQTT redelivery, retries) can deliver a
+            # duplicate or late upstream message after fan-in was satisfied —
+            # the step must fire exactly once, and the upstream list must be
+            # snapshotted while the lock is held
+            if ready and step_idx not in self._fired:
+                self._fired.add(step_idx)
+                upstream = [box[i] for i in sorted(box)]
+            else:
+                return
+        self._execute_step(step_idx, upstream=upstream)
 
     def _execute_step(self, step_idx: int, upstream: list[Params]) -> None:
         name, task, cls, tag = self._steps[step_idx]

@@ -17,23 +17,33 @@ plane is hermetically testable and transport-pluggable:
                                  restart marker (the supervisor restarts the
                                  agent process; in-place code reload is
                                  deliberately NOT attempted)
+
+Authentication: every verb (not just the package-bearing ones — STOP_RUN
+kills jobs and STATUS leaks the job DB) carries an HMAC-SHA256 over
+(verb, target edge, identifier, timestamp, package bytes) keyed by the
+shared ``control_plane_secret``, with a freshness window so captured
+messages cannot be replayed later (e.g. re-staging an old OTA package as a
+downgrade attack).  Without a configured secret, only the in-proc fabric —
+same process, inherently trusted — is accepted; a routable transport
+without a secret refuses every verb.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import logging
+import re
 import time
-from pathlib import Path
 from typing import Optional
 
 log = logging.getLogger("fedml_tpu.sched.control_plane")
 
+from .. import constants as _C
 from ..comm.comm_manager import FedMLCommManager
 from ..comm.message import Message
 from .agent import FedMLAgent
-
-import re
 
 MSG_TYPE_START_RUN = 40
 MSG_TYPE_STOP_RUN = 41
@@ -45,8 +55,44 @@ KEY_PACKAGE = "package"
 KEY_RUN_ID = "cp_run_id"
 KEY_JOBS = "jobs"
 KEY_VERSION = "agent_version"
+KEY_SIGNATURE = "cp_signature"
+KEY_TIMESTAMP = "cp_ts"
+
+# replayed control messages older than this are rejected (bounds the replay
+# surface without a per-message nonce store)
+FRESHNESS_WINDOW_S = 300.0
 
 _SAFE_NAME = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
+
+
+def _verb_signature(secret: str, verb: int, edge_id: int, name: str,
+                    ts: str, package: bytes = b"", sender: int = 0) -> str:
+    """HMAC-SHA256 binding verb + sender + recipient + identifier +
+    timestamp + package bytes to the shared secret."""
+    mac = hmac.new(secret.encode(), digestmod=hashlib.sha256)
+    for part in (str(verb), str(sender), str(edge_id), name, ts):
+        mac.update(part.encode())
+        mac.update(b"\x00")
+    mac.update(package)
+    return mac.hexdigest()
+
+
+def _check_signature(secret: str, msg: Message, verb: int, edge_id: int,
+                     name: str, package: bytes = b"", sender: int = 0) -> None:
+    """Single verification path for BOTH directions (requests and the status
+    reply): freshness window, then constant-time MAC compare. Raises
+    ValueError on any failure."""
+    ts = str(msg.get(KEY_TIMESTAMP, ""))
+    try:
+        age = abs(time.time() - float(ts))
+    except ValueError:
+        raise ValueError(f"missing/invalid timestamp on verb {verb}")
+    if not (age <= FRESHNESS_WINDOW_S):  # rejects NaN too
+        raise ValueError(f"stale control-plane message (age {age:.0f}s) on verb {verb}")
+    got = str(msg.get(KEY_SIGNATURE, ""))
+    want = _verb_signature(secret, verb, edge_id, name, ts, package, sender)
+    if not hmac.compare_digest(got, want):
+        raise ValueError(f"bad control-plane signature on verb {verb}")
 
 
 def _safe_name(value, what: str) -> str:
@@ -66,15 +112,43 @@ class AgentControlPlane(FedMLCommManager):
         super().__init__(cfg, rank=rank, size=0, backend=backend)
         self.agent = agent
         self.ota_dir = agent.spool / "ota"
+        self.secret: Optional[str] = getattr(cfg, "control_plane_secret", None)
+
+    def _verify(self, msg: Message, verb: int, name: str, package: bytes = b"") -> None:
+        """Reject any verb whose HMAC or freshness fails; see module doc."""
+        if self.secret is None:
+            if self.backend != _C.COMM_BACKEND_INPROC:
+                raise ValueError(
+                    f"unauthenticated verb {verb} on routable backend {self.backend!r}: "
+                    "configure control_plane_secret"
+                )
+            return
+        _check_signature(self.secret, msg, verb, self.rank, name, package)
+
+    @staticmethod
+    def _package_bytes(msg: Message) -> bytes:
+        """Attacker-controlled field: a missing/mistyped package must become a
+        rejection, not an uncaught TypeError in the receive loop."""
+        import numpy as np
+
+        raw = msg.get(KEY_PACKAGE)
+        if raw is None:
+            raise ValueError("missing package")
+        try:
+            return bytes(np.asarray(raw, dtype=np.uint8))
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"malformed package: {e}")
 
     def register_message_receive_handlers(self) -> None:
         # a malformed/hostile message must be REJECTED, not allowed to kill
-        # the receive loop (the observer loop does not catch handler errors)
+        # the receive loop (the observer loop does not catch handler errors);
+        # anything a hostile sender can trigger — not just ValueError — must
+        # be contained here
         def guarded(handler):
             def wrapper(msg: Message) -> None:
                 try:
                     handler(msg)
-                except ValueError as e:
+                except Exception as e:
                     log.warning("control-plane message rejected: %s", e)
             return wrapper
 
@@ -84,16 +158,16 @@ class AgentControlPlane(FedMLCommManager):
         self.register_message_receive_handler(MSG_TYPE_OTA, guarded(self.handle_ota))
 
     def handle_start_run(self, msg: Message) -> None:
-        import numpy as np
-
-        pkg_bytes = bytes(np.asarray(msg.get(KEY_PACKAGE), dtype=np.uint8))
+        pkg_bytes = self._package_bytes(msg)
         run_id = _safe_name(msg.get(KEY_RUN_ID), "run_id")
+        self._verify(msg, MSG_TYPE_START_RUN, run_id, pkg_bytes)
         dest = self.agent.queue / f"{run_id}.zip"
         dest.write_bytes(pkg_bytes)
         self.agent.db.upsert(run_id, status="QUEUED")
 
     def handle_stop_run(self, msg: Message) -> None:
         run_id = _safe_name(msg.get(KEY_RUN_ID), "run_id")
+        self._verify(msg, MSG_TYPE_STOP_RUN, run_id)
         # a stop that races the sweep: remove a still-queued package so the
         # next sweep cannot launch the supposedly-stopped job
         queued = self.agent.queue / f"{run_id}.zip"
@@ -109,20 +183,33 @@ class AgentControlPlane(FedMLCommManager):
         self.agent.db.upsert(run_id, status="KILLED", finished=time.time())
 
     def handle_status(self, msg: Message) -> None:
+        self._verify(msg, MSG_TYPE_STATUS_REQUEST, "")
         reply = Message(MSG_TYPE_STATUS_REPLY, self.rank, msg.get_sender_id())
-        reply.add_params(KEY_JOBS, json.dumps(self.agent.db.all_jobs()))
+        jobs_json = json.dumps(self.agent.db.all_jobs())
+        reply.add_params(KEY_JOBS, jobs_json)
+        if self.secret is not None:
+            ts = repr(time.time())
+            reply.add_params(KEY_TIMESTAMP, ts)
+            # sender=self.rank binds the replying agent's identity: a signed
+            # reply from agent A replayed with the sender field rewritten to
+            # agent B must not verify
+            reply.add_params(
+                KEY_SIGNATURE,
+                _verb_signature(self.secret, MSG_TYPE_STATUS_REPLY, msg.get_sender_id(),
+                                jobs_json, ts, sender=self.rank),
+            )
         self.send_message(reply)
 
     def handle_ota(self, msg: Message) -> None:
         """Stage the new agent package; a supervisor (systemd/k8s restart
         policy) picks up the marker — reference's OTA upgrade path
         (client_runner ota_upgrade) minus the in-place pip install."""
-        import numpy as np
-
-        self.ota_dir.mkdir(parents=True, exist_ok=True)
         version = _safe_name(msg.get(KEY_VERSION, "unknown"), "agent_version")
+        pkg_bytes = self._package_bytes(msg)
+        self._verify(msg, MSG_TYPE_OTA, version, pkg_bytes)
+        self.ota_dir.mkdir(parents=True, exist_ok=True)
         pkg = self.ota_dir / f"agent-{version}.zip"
-        pkg.write_bytes(bytes(np.asarray(msg.get(KEY_PACKAGE), dtype=np.uint8)))
+        pkg.write_bytes(pkg_bytes)
         (self.ota_dir / "RESTART_REQUIRED").write_text(
             json.dumps({"version": version, "package": str(pkg), "ts": time.time()})
         )
@@ -134,12 +221,38 @@ class AgentController(FedMLCommManager):
     def __init__(self, cfg, backend: Optional[str] = None):
         super().__init__(cfg, rank=0, size=0, backend=backend)
         self.status_replies: dict[int, list[dict]] = {}
+        self.secret: Optional[str] = getattr(cfg, "control_plane_secret", None)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MSG_TYPE_STATUS_REPLY, self._handle_status_reply)
 
     def _handle_status_reply(self, msg: Message) -> None:
-        self.status_replies[msg.get_sender_id()] = json.loads(msg.get(KEY_JOBS))
+        # replies are attacker-observable/forgeable on routable transports:
+        # verify the agent's signature (it binds the reply body, the sending
+        # agent, and this controller) and contain malformed payloads instead
+        # of killing the receive loop
+        try:
+            jobs_json = str(msg.get(KEY_JOBS, ""))
+            if self.secret is None:
+                # same policy as the agent side: no secret -> in-proc only
+                if self.backend != _C.COMM_BACKEND_INPROC:
+                    raise ValueError(
+                        f"unauthenticated status reply on routable backend {self.backend!r}"
+                    )
+            else:
+                _check_signature(self.secret, msg, MSG_TYPE_STATUS_REPLY, self.rank,
+                                 jobs_json, sender=msg.get_sender_id())
+            self.status_replies[msg.get_sender_id()] = json.loads(jobs_json)
+        except Exception as e:
+            log.warning("status reply rejected: %s", e)
+
+    def _sign(self, msg: Message, verb: int, edge_id: int, name: str,
+              package: bytes = b"") -> None:
+        if self.secret is None:
+            return
+        ts = repr(time.time())
+        msg.add_params(KEY_TIMESTAMP, ts)
+        msg.add_params(KEY_SIGNATURE, _verb_signature(self.secret, verb, edge_id, name, ts, package))
 
     def _package_msg(self, msg_type: int, edge_id: int, package_bytes: bytes) -> Message:
         import numpy as np
@@ -151,19 +264,24 @@ class AgentController(FedMLCommManager):
     def start_run(self, edge_id: int, run_id: str, package_bytes: bytes) -> None:
         msg = self._package_msg(MSG_TYPE_START_RUN, edge_id, package_bytes)
         msg.add_params(KEY_RUN_ID, run_id)
+        self._sign(msg, MSG_TYPE_START_RUN, edge_id, run_id, package_bytes)
         self.send_message(msg)
 
     def stop_run(self, edge_id: int, run_id: str) -> None:
         msg = Message(MSG_TYPE_STOP_RUN, 0, edge_id)
         msg.add_params(KEY_RUN_ID, run_id)
+        self._sign(msg, MSG_TYPE_STOP_RUN, edge_id, run_id)
         self.send_message(msg)
 
     def request_status(self, edge_id: int) -> None:
-        self.send_message(Message(MSG_TYPE_STATUS_REQUEST, 0, edge_id))
+        msg = Message(MSG_TYPE_STATUS_REQUEST, 0, edge_id)
+        self._sign(msg, MSG_TYPE_STATUS_REQUEST, edge_id, "")
+        self.send_message(msg)
 
     def push_ota(self, edge_id: int, version: str, package_bytes: bytes) -> None:
         msg = self._package_msg(MSG_TYPE_OTA, edge_id, package_bytes)
         msg.add_params(KEY_VERSION, version)
+        self._sign(msg, MSG_TYPE_OTA, edge_id, version, package_bytes)
         self.send_message(msg)
 
     def wait_status(self, edge_id: int, timeout: float = 10.0) -> Optional[list[dict]]:

@@ -267,6 +267,12 @@ class Endpoint:
         self.inflight = 0
         self._last_rate_t = time.time()
         self._last_rate_n = 0
+        # guards procs/ports: the reconcile thread mutates them while predict/
+        # ready_ports iterate from request threads
+        self.lock = threading.Lock()
+        # set by undeploy: a reconcile sweep that snapshotted this endpoint
+        # before the pop must not resurrect its replicas
+        self.closed = False
 
     def qps(self) -> float:
         now = time.time()
@@ -277,10 +283,13 @@ class Endpoint:
         return rate
 
     def ready_ports(self) -> list[int]:
-        return [
-            p for idx, p in sorted(self.ports.items())
-            if self.procs.get(idx) is not None and self.procs[idx].poll() is None and probe_ready(p)
-        ]
+        # snapshot under the lock, probe outside it (probes do HTTP)
+        with self.lock:
+            live = [
+                p for idx, p in sorted(self.ports.items())
+                if self.procs.get(idx) is not None and self.procs[idx].poll() is None
+            ]
+        return [p for p in live if probe_ready(p)]
 
 
 class ModelDeployScheduler:
@@ -296,6 +305,10 @@ class ModelDeployScheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.RLock()
+        # deploy()/scale() call reconcile_once inline while the background
+        # loop runs the same sweep; serializing sweeps prevents double-starting
+        # the same replica index (the loser's process would leak)
+        self._reconcile_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
     def deploy(self, endpoint_name: str, model_name: str, version: Optional[str] = None,
@@ -319,45 +332,90 @@ class ModelDeployScheduler:
             ep = self.endpoints.pop(endpoint_name, None)
         if ep is None:
             return
-        for idx, proc in list(ep.procs.items()):
-            ReplicaHandler.stop(proc)
-            self.db.delete_replica(endpoint_name, idx)
-        self.db.upsert_endpoint(endpoint_name, ep.card.model, ep.card.version, 0, "UNDEPLOYED")
+        with ep.lock:
+            ep.closed = True
+        # serialize with the sweep: a reconcile that snapshotted this endpoint
+        # before the pop must fully drain before we stop processes and write
+        # the terminal DB state, or it could resurrect replicas / overwrite
+        # the UNDEPLOYED record
+        with self._reconcile_lock:
+            with ep.lock:
+                stopping = list(ep.procs.items())
+                ep.procs.clear()
+                ep.ports.clear()
+            for idx, proc in stopping:
+                ReplicaHandler.stop(proc)
+                self.db.delete_replica(endpoint_name, idx)
+            self.db.upsert_endpoint(endpoint_name, ep.card.model, ep.card.version, 0, "UNDEPLOYED")
 
     # -- the reconcile loop (replica controller + monitor) -------------------
     def reconcile_once(self) -> None:
+        with self._reconcile_lock:
+            self._reconcile_impl()
+
+    def _install_replica(self, ep: Endpoint, idx: int, status: str) -> bool:
+        """Start one replica and register it; if the endpoint was undeployed
+        while the process was starting, stop it again instead of leaking it.
+        Returns False when the endpoint is gone (caller abandons the sweep)."""
+        proc, port = ep.handler.start()
+        with ep.lock:
+            if ep.closed:
+                abandoned = True
+            else:
+                abandoned = False
+                ep.procs[idx] = proc
+                ep.ports[idx] = port
+        if abandoned:
+            ReplicaHandler.stop(proc)
+            return False
+        self.db.upsert_replica(ep.name, idx, proc.pid, port, status)
+        return True
+
+    def _reconcile_impl(self) -> None:
         with self._lock:
             eps = list(self.endpoints.values())
         for ep in eps:
-            # autoscaling first: it updates desired before the diff
-            if ep.autoscaler is not None:
-                ep.desired = ep.autoscaler.desired(
-                    current=max(len(ep.procs), 1), qps=ep.qps(), concurrency=ep.inflight,
-                )
-            # restart dead replicas (the monitor role)
-            for idx, proc in list(ep.procs.items()):
-                if proc.poll() is not None and idx < ep.desired:
-                    log.warning("endpoint %s replica %d died (rc=%s); restarting",
-                                ep.name, idx, proc.returncode)
-                    new_proc, port = ep.handler.start()
-                    ep.procs[idx] = new_proc
-                    ep.ports[idx] = port
-                    self.db.upsert_replica(ep.name, idx, new_proc.pid, port, "RESTARTING")
-            # start missing replicas
-            for idx in range(ep.desired):
-                if idx not in ep.procs:
-                    proc, port = ep.handler.start()
-                    ep.procs[idx] = proc
-                    ep.ports[idx] = port
-                    self.db.upsert_replica(ep.name, idx, proc.pid, port, "STARTING")
-            # stop extras (scale-down)
-            for idx in [i for i in ep.procs if i >= ep.desired]:
-                ReplicaHandler.stop(ep.procs.pop(idx))
-                ep.ports.pop(idx, None)
-                self.db.delete_replica(ep.name, idx)
-            ready = ep.ready_ports()
-            status = "READY" if len(ready) >= min(ep.desired, 1) else "DEPLOYING"
-            self.db.upsert_endpoint(ep.name, ep.card.model, ep.card.version, ep.desired, status)
+            self._reconcile_endpoint(ep)
+
+    def _reconcile_endpoint(self, ep: Endpoint) -> None:
+        if ep.closed:
+            return
+        # autoscaling first: it updates desired before the diff
+        if ep.autoscaler is not None:
+            ep.desired = ep.autoscaler.desired(
+                current=max(len(ep.procs), 1), qps=ep.qps(), concurrency=ep.inflight,
+            )
+        # restart dead replicas (the monitor role)
+        with ep.lock:
+            dead = [
+                (idx, proc.returncode) for idx, proc in ep.procs.items()
+                if proc.poll() is not None and idx < ep.desired
+            ]
+        for idx, rc in dead:
+            log.warning("endpoint %s replica %d died (rc=%s); restarting",
+                        ep.name, idx, rc)
+            if not self._install_replica(ep, idx, "RESTARTING"):
+                return  # endpoint undeployed mid-sweep: abandon it entirely
+        # start missing replicas
+        with ep.lock:
+            missing = [idx for idx in range(ep.desired) if idx not in ep.procs]
+        for idx in missing:
+            if not self._install_replica(ep, idx, "STARTING"):
+                return
+        # stop extras (scale-down)
+        with ep.lock:
+            extras = [
+                (idx, ep.procs.pop(idx), ep.ports.pop(idx, None))
+                for idx in [i for i in ep.procs if i >= ep.desired]
+            ]
+        for idx, proc, _port in extras:
+            ReplicaHandler.stop(proc)
+            self.db.delete_replica(ep.name, idx)
+        if ep.closed:  # best-effort probe-skip; undeploy's terminal DB write
+            return      # is serialized after this sweep via _reconcile_lock
+        ready = ep.ready_ports()
+        status = "READY" if len(ready) >= min(ep.desired, 1) else "DEPLOYING"
+        self.db.upsert_endpoint(ep.name, ep.card.model, ep.card.version, ep.desired, status)
 
     def run_in_thread(self) -> threading.Thread:
         def loop():

@@ -16,8 +16,12 @@ Guler, Avestimehr 2021) the reference names:
   provides that machinery for the cross-silo platform).
 
 The ring arithmetic runs in float on stacked trees (one tensordot per hop);
-the security property tested is that NO single group observes an unmasked
-individual model — only masked models and running partial sums.
+the property tested is that no single group observes an individual model in
+the clear — only noise-masked models and running partial sums.  The masks are
+float Gaussians at a fixed scale, so this is masking-within-noise (finite
+SNR), NOT the information-theoretic guarantee of uniform finite-field masks;
+for that, the cross-silo LightSecAgg stack (trust/secagg) is the real
+protocol — this simulator mirrors the reference's float TA topology.
 """
 
 from __future__ import annotations

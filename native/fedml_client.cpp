@@ -61,9 +61,17 @@ static bool read_exact(int fd, uint8_t* buf, size_t n) {
   return true;
 }
 
+// Mirror of tcp_backend.py's MAX_FRAME_BYTES: refuse absurd length prefixes
+// before allocating, so a corrupt/hostile peer cannot OOM the client.
+static constexpr uint64_t kMaxFrameBytes = 1ull << 30;  // 1 GB
+
 static bool read_frame(int fd, std::vector<uint8_t>* out) {
   uint64_t len = 0;
   if (!read_exact(fd, (uint8_t*)&len, 8)) return false;
+  if (len > kMaxFrameBytes) {
+    fprintf(stderr, "frame length %llu exceeds cap\n", (unsigned long long)len);
+    return false;
+  }
   out->resize(len);
   return read_exact(fd, out->data(), len);
 }

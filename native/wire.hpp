@@ -173,25 +173,57 @@ inline uint32_t read_u32(const uint8_t* p) {
 }
 
 inline DecodedMessage decode_message(const std::vector<uint8_t>& frame) {
+  // Every length prefix is validated against the remaining frame bytes before
+  // any iterator arithmetic: a truncated or corrupt frame must throw, not read
+  // out of bounds.
   if (frame.size() < 4) throw std::runtime_error("short frame");
   const uint32_t clen = read_u32(frame.data());
+  if ((size_t)clen > frame.size() - 4) throw std::runtime_error("control length exceeds frame");
   std::string control_json(frame.begin() + 4, frame.begin() + 4 + clen);
-  size_t off = 4 + clen;
+  size_t off = 4 + (size_t)clen;
+  if (frame.size() - off < 4) throw std::runtime_error("truncated header length");
   const uint32_t hlen = read_u32(frame.data() + off);
+  if ((size_t)hlen > frame.size() - off - 4) throw std::runtime_error("header length exceeds frame");
   std::string header_json(frame.begin() + off + 4, frame.begin() + off + 4 + hlen);
-  off += 4 + hlen;
+  off += 4 + (size_t)hlen;
 
   DecodedMessage out;
   out.control = JsonParser(control_json).parse();
   out.header_json = header_json;
   Json header = JsonParser(header_json).parse();
   if (header.at("version").as_int() != 1) throw std::runtime_error("wire version");
+  const size_t buf_bytes = frame.size() - off;
   size_t rel = 0;
   for (const Json& spec : header.at("leaves").arr) {
     Leaf leaf;
     leaf.dtype = spec.at("dtype").s;
     for (const Json& dim : spec.at("shape").arr) leaf.shape.push_back(dim.as_int());
-    leaf.nbytes = (size_t)spec.at("nbytes").as_int();
+    // a hostile header can claim negative/huge nbytes; without these checks
+    // (size_t) wrap makes offset+nbytes a wild pointer downstream
+    const int64_t declared = spec.at("nbytes").as_int();
+    if (declared < 0 || (uint64_t)declared > buf_bytes - rel)
+      throw std::runtime_error("leaf nbytes exceeds buffer region");
+    // nbytes must also agree with shape x itemsize: consumers size their
+    // reads/writes from the SHAPE (e.g. the trainer's d*c kernel loop), so a
+    // frame whose shape promises more elements than its bytes deliver would
+    // still be a heap overrun. dtype strings end in the itemsize ("<f4").
+    uint64_t elems = 1;
+    for (int64_t dim : leaf.shape) {
+      if (dim < 0) throw std::runtime_error("negative dim");
+      if (dim != 0 && elems > UINT64_MAX / (uint64_t)dim)
+        throw std::runtime_error("shape product overflow");
+      elems *= (uint64_t)dim;
+    }
+    uint64_t itemsize = 0;
+    for (char ch : leaf.dtype) {
+      if (ch >= '0' && ch <= '9') itemsize = itemsize * 10 + (uint64_t)(ch - '0');
+    }
+    if (itemsize == 0 || itemsize > 16) throw std::runtime_error("bad dtype itemsize");
+    if (elems > UINT64_MAX / itemsize)
+      throw std::runtime_error("shape byte size overflow");
+    if (elems * itemsize != (uint64_t)declared)
+      throw std::runtime_error("nbytes != shape product * itemsize");
+    leaf.nbytes = (size_t)declared;
     leaf.offset = rel;
     rel += leaf.nbytes;
     out.leaves.push_back(std::move(leaf));

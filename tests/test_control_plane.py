@@ -59,11 +59,13 @@ def test_control_plane_start_status_stop_ota(tmp_path, eight_devices):
         agent.sweep_once()
         assert "job-2" in agent._procs
         controller.stop_run(7, "job-2")
-        deadline = time.time() + 10
-        while agent._procs.get("job-2") is not None \
-                and agent._procs["job-2"].poll() is None and time.time() < deadline:
+        # wait on the DB row, not the process table: the handler pops the
+        # proc BEFORE it writes KILLED, so polling _procs races the upsert
+        deadline = time.time() + 15
+        while agent.db.get("job-2")["status"] != "KILLED" and time.time() < deadline:
             time.sleep(0.1)
         assert agent.db.get("job-2")["status"] == "KILLED"
+        assert agent._procs.get("job-2") is None
 
         # OTA stages the package + restart marker
         controller.push_ota(7, "0.2.0", b"new-agent-code")
@@ -115,6 +117,100 @@ def test_control_plane_rejects_traversal_and_stop_races(tmp_path, eight_devices)
         agent.sweep_once()
         assert agent.db.get("job-r")["status"] == "KILLED"
         assert "job-r" not in agent._procs
+    finally:
+        plane.finish()
+        controller.finish()
+
+
+def test_control_plane_package_auth(tmp_path, eight_devices):
+    """START_RUN/OTA are code execution on the agent: with a configured
+    shared secret a bad/absent HMAC must be rejected, a good one accepted;
+    without a secret, routable backends must refuse package verbs outright."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.sched.agent import FedMLAgent
+    from fedml_tpu.sched.control_plane import (
+        KEY_PACKAGE, KEY_RUN_ID, KEY_SIGNATURE, KEY_TIMESTAMP,
+        MSG_TYPE_START_RUN, MSG_TYPE_STOP_RUN,
+        AgentControlPlane, AgentController, _verb_signature,
+    )
+
+    cfg = tiny_config(run_id="cp3", backend="INPROC")
+    cfg.control_plane_secret = "sesame"
+    fedml_tpu.init(cfg)
+    InProcRouter.reset("cp3")
+    agent = FedMLAgent(str(tmp_path / "spool"))
+    plane = AgentControlPlane(cfg, agent, rank=5, backend="INPROC")
+    plane.run_in_thread()
+    controller = AgentController(cfg, backend="INPROC")
+    try:
+        import numpy as np
+
+        pkg = _job_package("job-a", "echo authed")
+
+        # forged signature (fresh timestamp): package must never hit the spool
+        msg = Message(MSG_TYPE_START_RUN, 0, 5)
+        msg.add_params(KEY_PACKAGE, np.frombuffer(pkg, dtype=np.uint8).copy())
+        msg.add_params(KEY_RUN_ID, "job-a")
+        msg.add_params(KEY_TIMESTAMP, repr(time.time()))
+        msg.add_params(KEY_SIGNATURE, "0" * 64)
+        controller.send_message(msg)
+        time.sleep(0.5)
+        assert not list(agent.queue.glob("*.zip")), "forged package spooled"
+
+        # stale-but-correctly-signed (replay): rejected by the freshness window
+        old_ts = repr(time.time() - 3600)
+        replay = Message(MSG_TYPE_START_RUN, 0, 5)
+        replay.add_params(KEY_PACKAGE, np.frombuffer(pkg, dtype=np.uint8).copy())
+        replay.add_params(KEY_RUN_ID, "job-a")
+        replay.add_params(KEY_TIMESTAMP, old_ts)
+        replay.add_params(
+            KEY_SIGNATURE, _verb_signature("sesame", MSG_TYPE_START_RUN, 5, "job-a", old_ts, pkg)
+        )
+        controller.send_message(replay)
+        time.sleep(0.5)
+        assert not list(agent.queue.glob("*.zip")), "replayed package spooled"
+
+        # unsigned STOP_RUN must not kill jobs when a secret is configured
+        agent.db.upsert("job-x", status="RUNNING")
+        bare_stop = Message(MSG_TYPE_STOP_RUN, 0, 5)
+        bare_stop.add_params(KEY_RUN_ID, "job-x")
+        controller.send_message(bare_stop)
+        time.sleep(0.5)
+        assert agent.db.get("job-x")["status"] == "RUNNING"
+
+        # correctly signed (controller signs automatically with the secret)
+        controller.start_run(5, "job-a", pkg)
+        deadline = time.time() + 10
+        while not list(agent.queue.glob("*.zip")) and time.time() < deadline:
+            time.sleep(0.05)
+        assert list(agent.queue.glob("*.zip")), "signed package rejected"
+
+        # signed STOP_RUN works
+        controller.stop_run(5, "job-x")
+        deadline = time.time() + 10
+        while agent.db.get("job-x")["status"] != "KILLED" and time.time() < deadline:
+            time.sleep(0.05)
+        assert agent.db.get("job-x")["status"] == "KILLED"
+
+        # signature is verb/name-bound
+        s1 = _verb_signature("sesame", MSG_TYPE_START_RUN, 5, "job-a", "1.0", pkg)
+        s2 = _verb_signature("sesame", MSG_TYPE_START_RUN, 5, "job-b", "1.0", pkg)
+        assert s1 != s2
+
+        # unauthenticated plane on a routable backend refuses packages
+        # (backend attribute faked to avoid binding a real TCP socket)
+        plane_open = AgentControlPlane(
+            tiny_config(run_id="cp3b", backend="INPROC"), agent, rank=6, backend="INPROC"
+        )
+        plane_open.secret = None
+        plane_open.backend = "TCP"
+        import pytest
+
+        with pytest.raises(ValueError, match="unauthenticated"):
+            plane_open._verify(msg, MSG_TYPE_START_RUN, "job-a", pkg)
+        plane_open.finish()
     finally:
         plane.finish()
         controller.finish()
