@@ -56,15 +56,12 @@ def bench_fedavg(peak):
     fedml_tpu.init(cfg)
     sim = FedMLRunner(cfg).runner
 
-    sim.run_round()  # compile
-    jax.block_until_ready(jax.tree_util.tree_leaves(sim.global_vars)[0])
-
+    # the round loop lives on-device (jit(scan(round))): ONE dispatch + ONE
+    # host sync per chunk — per-round metric pulls would otherwise dominate
+    # wall clock on a tunneled chip (host<->device latency >> round compute)
+    sim.run_rounds(rounds)  # compile + warm
     t0 = time.perf_counter()
-    for _ in range(rounds):
-        sim.run_round()
-    # force a real host sync (block_until_ready can be a no-op on tunneled
-    # backends): pull one scalar to the host
-    float(jax.tree_util.tree_leaves(sim.global_vars)[0].ravel()[0])
+    sim.run_rounds(rounds)  # run_rounds syncs on its stacked metrics
     dt = time.perf_counter() - t0
 
     steps_per_client = -(-samples_per_client // batch)

@@ -92,3 +92,66 @@ def test_resnet20_forward_shape(eight_devices):
     n_params = sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))
     # reference resnet20 has ~272k params; ours should match closely
     assert 250_000 < n_params < 300_000, n_params
+
+
+def test_run_rounds_chunk_matches_per_round(eight_devices):
+    """run_rounds(k) (jit(scan(round)) + donation) must produce the same
+    trained state and metrics as k iterative run_round() calls — the chunked
+    fast path may not diverge from the per-round reference path."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.runner import FedMLRunner
+
+    k = 3
+    params = {}
+    metrics = {}
+    for mode in ("per_round", "chunk"):
+        cfg = tiny_config(comm_round=k, frequency_of_the_test=0)
+        import fedml_tpu
+
+        fedml_tpu.init(cfg)
+        sim = FedMLRunner(cfg).runner
+        if mode == "per_round":
+            ms = [sim.run_round() for _ in range(k)]
+        else:
+            ms = sim.run_rounds(k)
+        params[mode] = jax.device_get(sim.global_vars)
+        metrics[mode] = ms
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params["per_round"]),
+        jax.tree_util.tree_leaves(params["chunk"]),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+    for ma, mb in zip(metrics["per_round"], metrics["chunk"]):
+        for key in ma:
+            np.testing.assert_allclose(ma[key], mb[key], rtol=2e-4, atol=1e-5)
+
+
+def test_next_boundary_table(eight_devices):
+    """Chunk boundaries must reproduce the per-round eval/checkpoint cadence."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(comm_round=10, frequency_of_the_test=3)
+    fedml_tpu.init(cfg)
+    sim = FedMLRunner(cfg).runner
+    # eval after rounds 2, 5, 8 (1-indexed multiples of 3) and the last round
+    assert sim._next_boundary(0) == 3
+    assert sim._next_boundary(3) == 6
+    assert sim._next_boundary(8) == 9
+    assert sim._next_boundary(9) == 10
+
+    cfg2 = tiny_config(comm_round=7, frequency_of_the_test=0)
+    cfg2.checkpoint_every_rounds = 4
+    fedml_tpu.init(cfg2)
+    sim2 = FedMLRunner(cfg2).runner
+    assert sim2._next_boundary(0) == 4
+    assert sim2._next_boundary(4) == 7
+
+    cfg3 = tiny_config(comm_round=5, frequency_of_the_test=0)
+    cfg3.enable_contribution = True
+    fedml_tpu.init(cfg3)
+    sim3 = FedMLRunner(cfg3).runner
+    # must stop before the final round so its pre-round state is snapshotted
+    assert sim3._next_boundary(0) == 4
+    assert sim3._next_boundary(4) == 5
