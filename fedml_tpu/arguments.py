@@ -77,6 +77,20 @@ class Config:
     quantize_level: int = 8
     is_biased: bool = False
 
+    # ---- agg_args (fork research: MyAvg CKA layer-selective aggregation,
+    # reference my_research/.../fedml_config_7_m5top3_opt.yaml agg_args) ----
+    agg_unselect_layer: tuple = ()
+    agg_all_select_layer: tuple = ()
+    agg_any_select_layer: tuple = ()
+    agg_mod_list: tuple = ()
+    agg_mod_dict: dict = field(default_factory=dict)
+    cka_select_topk: int = 3
+    cka_unselect_layer: tuple = ()
+    cka_all_select_layer: tuple = ()
+    cka_any_select_layer: tuple = ()
+    cka_low_thresh: float = 0.0
+    cka_high_thresh: float = 1.0
+
     # ---- validation_args ---------------------------------------------------
     frequency_of_the_test: int = 5
     test_batch_size: int = 0  # 0 -> batch_size
@@ -150,6 +164,12 @@ class Config:
             self.test_batch_size = self.batch_size
         if isinstance(self.poisoned_client_list, list):
             self.poisoned_client_list = tuple(self.poisoned_client_list)
+        for name in ("agg_unselect_layer", "agg_all_select_layer", "agg_any_select_layer",
+                     "agg_mod_list", "cka_unselect_layer", "cka_all_select_layer",
+                     "cka_any_select_layer"):
+            v = getattr(self, name)
+            if isinstance(v, list):
+                object.__setattr__(self, name, tuple(v))
 
     # reference code reads duck-typed attributes; keep that working for extras
     def __getattr__(self, name: str) -> Any:

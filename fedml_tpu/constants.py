@@ -48,6 +48,10 @@ FEDERATED_OPTIMIZER_SPLIT_NN = "split_nn"
 FEDERATED_OPTIMIZER_FEDGKT = "FedGKT"
 FEDERATED_OPTIMIZER_FEDNAS = "FedNAS"
 FEDERATED_OPTIMIZER_FEDSEG = "FedSeg"
+# Fork research: CKA layer-selective personalized aggregation
+# (my_research/.../MyAvgAPI_7.py; simulator.py:88-95 dispatches "MyAgg-*")
+FEDERATED_OPTIMIZER_MYAVG = "MyAvg"
+FEDERATED_OPTIMIZER_MYAVG_ALIASES = ("MyAvg", "MyAgg-7", "MyAgg-6", "MyAgg-5", "MyAgg-4")
 
 # Communication backends (reference: fedml_comm_manager.py:133-207)
 COMM_BACKEND_INPROC = "INPROC"  # loopback fake for tests (new; SURVEY.md §4)

@@ -90,6 +90,7 @@ class FedMLRunner:
         C.FEDERATED_OPTIMIZER_FEDNAS,
         C.FEDERATED_OPTIMIZER_FEDSEG,
         C.FEDERATED_OPTIMIZER_TURBO_AGGREGATE,
+        *C.FEDERATED_OPTIMIZER_MYAVG_ALIASES,
     }
     # these build their own model pair internally; model_hub model is unused
     _OWN_MODEL_OPTIMIZERS = {
@@ -179,6 +180,10 @@ class FedMLRunner:
             from .sim.turboaggregate import TurboAggregateSimulator
 
             return TurboAggregateSimulator(self.cfg, dataset, model)
+        if opt in C.FEDERATED_OPTIMIZER_MYAVG_ALIASES:
+            from .sim.myavg import MyAvgSimulator
+
+            return MyAvgSimulator(self.cfg, dataset, model)
         from .sim.engine import MeshSimulator
 
         return MeshSimulator(self.cfg, dataset, model, algorithm=self.client_trainer)

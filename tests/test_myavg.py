@@ -1,0 +1,164 @@
+"""MyAvg (fork research) — CKA layer-selective personalized aggregation.
+
+Pins the behaviors of reference ``my_research/.../MyAvgAPI_7.py``:
+mod-N layer schedule, CKA top-k partner personalization, personal models
+persisting across rounds, and end-to-end learning on the hetero recipe.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _myavg_cfg(**over):
+    base = dict(
+        model="mlp",
+        federated_optimizer="MyAvg",
+        client_num_in_total=5,
+        client_num_per_round=5,
+        comm_round=4,
+        partition_method="hetero",
+        partition_alpha=0.5,
+        # normal rounds: only Dense_0 aggregates; every 3rd round: everything
+        agg_unselect_layer=("Dense_1",),
+        agg_mod_list=(3,),
+        agg_mod_dict={3: {}},
+        # CKA personalization on the head
+        cka_any_select_layer=("Dense_1",),
+        cka_select_topk=2,
+        cka_low_thresh=0.0,
+        cka_high_thresh=1.0,
+    )
+    base.update(over)
+    return tiny_config(**base)
+
+
+def _build(cfg):
+    from fedml_tpu.runner import FedMLRunner
+
+    runner = FedMLRunner(cfg)
+    return runner.runner
+
+
+def _leaf(tree, path_sub):
+    from fedml_tpu.sim.myavg import leaf_paths
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    paths = leaf_paths(tree)
+    hits = [l for p, l in zip(paths, leaves) if path_sub in p]
+    assert hits, f"no leaf matching {path_sub} in {paths}"
+    return np.asarray(jax.device_get(hits[0]))
+
+
+def test_runner_dispatches_myavg(eight_devices):
+    from fedml_tpu.sim.myavg import MyAvgSimulator
+
+    for name in ("MyAvg", "MyAgg-7"):
+        sim = _build(_myavg_cfg(federated_optimizer=name))
+        assert isinstance(sim, MyAvgSimulator)
+
+
+def test_mod_schedule_gates_layer_aggregation(eight_devices):
+    """Dense_1 is excluded by the default filter, so the GLOBAL head must not
+    move on rounds 0-2 and must move on round 3 (3 % 3 == 0) — the mod-N
+    round-interval schedule of MyAvgAPI_7.py:242-263."""
+    sim = _build(_myavg_cfg())
+    head0 = _leaf(sim.global_vars, "Dense_1.kernel")
+    body0 = _leaf(sim.global_vars, "Dense_0.kernel")
+    for _ in range(3):  # rounds 0, 1, 2 — default filter
+        sim.run_round()
+    head_after = _leaf(sim.global_vars, "Dense_1.kernel")
+    body_after = _leaf(sim.global_vars, "Dense_0.kernel")
+    np.testing.assert_array_equal(head0, head_after)  # head gated off
+    assert np.abs(body_after - body0).max() > 0  # body aggregated
+    sim.run_round()  # round 3 — mod filter aggregates everything
+    head_mod = _leaf(sim.global_vars, "Dense_1.kernel")
+    assert np.abs(head_mod - head_after).max() > 0
+
+
+def test_personal_models_persist_and_personalize(eight_devices):
+    """Clients keep personal weights on unaggregated layers (set_param=False
+    semantics), and the CKA round hands each client a DIFFERENT personalized
+    head while the plain-aggregated body is shared."""
+    sim = _build(_myavg_cfg())
+    for _ in range(3):
+        sim.run_round()
+    # non-mod rounds: heads are the clients' own trained leaves -> differ
+    heads = _leaf(sim.client_states, "Dense_1.kernel")
+    assert heads.shape[0] == 5
+    spread = np.abs(heads - heads[0]).max()
+    assert spread > 1e-6, "personal heads should diverge under hetero data"
+    # body was plain-aggregated for everyone -> identical across clients
+    bodies = _leaf(sim.client_states, "Dense_0.kernel")
+    np.testing.assert_allclose(bodies, np.broadcast_to(bodies[:1], bodies.shape),
+                               rtol=0, atol=1e-6)
+    sim.run_round()  # CKA round
+    heads_cka = _leaf(sim.client_states, "Dense_1.kernel")
+    # personalized: clients differ (top-2 partner sets differ under hetero)
+    assert np.abs(heads_cka - heads_cka[0]).max() > 1e-6
+    # but each equals old-global + corrected partner-average delta, which is
+    # NOT the plain trained head carried from before
+    assert np.abs(heads_cka - heads).max() > 1e-6
+
+
+def test_myavg_learns_end_to_end(eight_devices):
+    cfg = _myavg_cfg(comm_round=6, learning_rate=0.3)
+    sim = _build(cfg)
+    history = sim.run()
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    pers = sim.evaluate_personalized()
+    assert pers["personalized_test_acc_mean"] > 0.3, pers
+    # scan path and config-id metric: rounds 0-2 default (0), round 3 mod (1)
+    cids = [h["myavg_config_id"] for h in history]
+    assert cids[:4] == [0.0, 0.0, 0.0, 1.0], cids
+
+
+def test_linear_cka_matrix_properties():
+    from fedml_tpu.sim.myavg import linear_cka_matrix
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 8, 6)).astype(np.float32)
+    c = np.asarray(linear_cka_matrix(jnp.asarray(x)))
+    np.testing.assert_allclose(np.diag(c), 1.0, atol=1e-5)
+    np.testing.assert_allclose(c, c.T, atol=1e-5)
+    assert (c <= 1.0 + 1e-6).all()
+    # identical inputs are maximally similar; scaling is invariant
+    x2 = np.stack([x[0], 2.5 * x[0], x[1], x[2]])
+    c2 = np.asarray(linear_cka_matrix(jnp.asarray(x2)))
+    np.testing.assert_allclose(c2[0, 1], 1.0, atol=1e-5)
+    assert c2[0, 1] > c2[0, 2]
+
+
+def test_cka_partner_selection_prefers_similar_clients(eight_devices):
+    """Two client clusters with distinct label mappings: a client's CKA
+    partners for the head layer should come from its own cluster, so the
+    personalized heads converge within clusters and differ across them."""
+    sim = _build(_myavg_cfg(comm_round=8, learning_rate=0.3,
+                            partition_method="homo"))
+    # hand-craft cluster structure: clients 0-2 keep labels, clients 3-4 see
+    # permuted labels -> their head deltas point in different directions
+    y = np.array(jax.device_get(sim._data[1]))
+    y_perm = (y + 1) % int(sim.dataset.class_num)
+    y[3:] = y_perm[3:]
+    sim._data = (sim._data[0], jnp.asarray(y))
+    for _ in range(7):
+        sim.run_round()
+    heads = _leaf(sim.client_states, "Dense_1.kernel")
+    flat = heads.reshape(5, -1)
+
+    def d(i, j):
+        return np.linalg.norm(flat[i] - flat[j])
+
+    within = (d(0, 1) + d(0, 2) + d(1, 2) + d(3, 4)) / 4
+    across = (d(0, 3) + d(0, 4) + d(1, 3) + d(2, 4)) / 4
+    assert across > within, (within, across)
+
+
+def test_myavg_rejects_sp_backend(eight_devices):
+    with pytest.raises(NotImplementedError):
+        _build(_myavg_cfg(backend_sim="sp"))
