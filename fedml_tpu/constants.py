@@ -51,7 +51,9 @@ FEDERATED_OPTIMIZER_FEDSEG = "FedSeg"
 # Fork research: CKA layer-selective personalized aggregation
 # (my_research/.../MyAvgAPI_7.py; simulator.py:88-95 dispatches "MyAgg-*")
 FEDERATED_OPTIMIZER_MYAVG = "MyAvg"
-FEDERATED_OPTIMIZER_MYAVG_ALIASES = ("MyAvg", "MyAgg-7", "MyAgg-6", "MyAgg-5", "MyAgg-4")
+# only the -7 variant is implemented; MyAgg-4/5/6 differ materially in the
+# reference (no CKA / no projection correction) and must not silently alias
+FEDERATED_OPTIMIZER_MYAVG_ALIASES = ("MyAvg", "MyAgg-7")
 
 # Communication backends (reference: fedml_comm_manager.py:133-207)
 COMM_BACKEND_INPROC = "INPROC"  # loopback fake for tests (new; SURVEY.md §4)

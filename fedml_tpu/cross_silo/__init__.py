@@ -60,6 +60,17 @@ class _CrossSiloRunner:
         """(run_group, build_srv, build_cli) for the configured privacy mode."""
         cfg = self.cfg
         if getattr(cfg, "enable_secagg", False):
+            # two secure-agg variants, as in the reference: LightSecAgg
+            # (cross_silo/lightsecagg/) and Shamir pairwise-mask SecAgg
+            # (cross_silo/secagg/) — selected by secagg_method
+            method = str((getattr(cfg, "extra", {}) or {}).get("secagg_method", "lightsecagg")).lower()
+            if method in ("shamir", "secagg", "pairwise"):
+                from .secagg_shamir import build_sa_client, build_sa_server, run_shamir_secagg_process_group
+
+                return (lambda *a, **k: run_shamir_secagg_process_group(*a, **k)[0],
+                        build_sa_server, build_sa_client)
+            if method not in ("lightsecagg", "lsa"):
+                raise ValueError(f"unknown secagg_method {method!r}; use 'lightsecagg' or 'shamir'")
             from .lightsecagg import build_lsa_client, build_lsa_server, run_lightsecagg_process_group
 
             return (lambda *a, **k: run_lightsecagg_process_group(*a, **k)[0],

@@ -106,6 +106,7 @@ class MeshSimulator:
         eval_bs = min(256, max(32, cfg.test_batch_size))
         tx, ty, n_test = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
         self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_test))
+        self._eval_bs = eval_bs  # the padding multiple of self._test
         self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
 
         self.root_key = k0

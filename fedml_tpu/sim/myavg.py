@@ -156,7 +156,7 @@ class MyAvgSimulator(MeshSimulator):
             )
         active_trust = [
             f for f in ("enable_attack", "enable_defense", "enable_dp",
-                        "enable_secagg", "enable_fhe")
+                        "enable_secagg", "enable_fhe", "enable_contribution")
             if getattr(cfg, f, False)
         ]
         if active_trust:
@@ -188,6 +188,9 @@ class MyAvgSimulator(MeshSimulator):
         default_f = LayerFilter(cfg.agg_unselect_layer, cfg.agg_all_select_layer,
                                 cfg.agg_any_select_layer)
         self._mods = [int(mi) for mi in cfg.agg_mod_list]
+        if any(mi <= 0 for mi in self._mods):
+            # a 0 would trace round_idx % 0 into XLA (undefined, silent)
+            raise ValueError(f"agg_mod_list entries must be positive, got {self._mods}")
         mod_filters = []
         for mi in self._mods:
             spec = cfg.agg_mod_dict.get(mi, cfg.agg_mod_dict.get(str(mi), {}))
@@ -331,14 +334,22 @@ class MyAvgSimulator(MeshSimulator):
         return round_fn
 
     # ------------------------------------------------------------------
+    def evaluate(self) -> dict:
+        """Global-model eval PLUS personalized-model eval: the personal
+        models are what MyAvg optimizes (the reference's periodic test is
+        per-client local models, ``MyAvgAPI_7.py:304-309``), so the run-loop
+        history must carry both."""
+        out = super().evaluate()
+        out.update(self.evaluate_personalized())
+        return out
+
     def evaluate_personalized(self) -> dict:
         """Mean/min test accuracy of the clients' PERSONAL models — the
         quantity MyAvg optimizes (the reference evaluates every client's local
         model, ``MyAvgAPI_7.py:487-520``)."""
         if getattr(self, "_personal_eval_fn", None) is None:
-            eval_bs = min(256, max(32, self.cfg.test_batch_size))
             self._personal_eval_fn = jax.jit(jax.vmap(
-                make_eval_fn(self.model, self.hp, batch_size=eval_bs),
+                make_eval_fn(self.model, self.hp, batch_size=self._eval_bs),
                 in_axes=(0, None, None, None),
             ))
         res = self._personal_eval_fn(self.client_states, *self._test)

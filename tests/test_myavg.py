@@ -113,6 +113,10 @@ def test_myavg_learns_end_to_end(eight_devices):
     assert history[-1]["train_loss"] < history[0]["train_loss"]
     pers = sim.evaluate_personalized()
     assert pers["personalized_test_acc_mean"] > 0.3, pers
+    # the run-loop history carries the personalized metric (the quantity
+    # MyAvg optimizes), not just the global-model accuracy
+    evals = [h for h in history if "personalized_test_acc_mean" in h]
+    assert evals and "test_acc" in evals[-1]
     # scan path and config-id metric: rounds 0-2 default (0), round 3 mod (1)
     cids = [h["myavg_config_id"] for h in history]
     assert cids[:4] == [0.0, 0.0, 0.0, 1.0], cids

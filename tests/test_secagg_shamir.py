@@ -1,0 +1,212 @@
+"""Shamir pairwise-mask SecAgg wire-protocol tests.
+
+Same three properties the LightSecAgg suite pins (VERDICT round-2 item 3):
+1. secure aggregate == plaintext aggregate (full participation),
+2. the server never sees a plaintext update,
+3. dropout reconstruction: a client whose pair masks ARE in the survivors'
+   uploads drops out; the server reconstructs its s_sk from T+1 shares and
+   cancels the orphaned masks.
+"""
+
+import jax.flatten_util  # noqa: F401
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _sa_config(**kw):
+    base = dict(
+        client_num_in_total=4,
+        client_num_per_round=4,
+        comm_round=2,
+        epochs=1,
+        batch_size=16,
+        synthetic_train_size=256,
+        synthetic_test_size=64,
+        training_type="cross_silo",
+        enable_secagg=True,
+        frequency_of_the_test=1,
+        extra={"secagg_method": "shamir"},
+    )
+    extra = kw.pop("extra", {})
+    base.update(kw)
+    merged = dict(base["extra"])
+    merged.update(extra)
+    base["extra"] = merged
+    return tiny_config(**base)
+
+
+def test_shamir_roundtrip_and_per_round_seeds():
+    from fedml_tpu.cross_silo.secagg_shamir import (
+        derive_round_seed, dh_agree, dh_keypair,
+    )
+    from fedml_tpu.trust.secagg.shamir import shamir_reconstruct, shamir_share
+
+    rng = np.random.RandomState(7)
+    secret = 123456789
+    shares = shamir_share(secret, 5, 3, rng)
+    assert shamir_reconstruct(shares[1:4]) == secret
+    assert shamir_reconstruct(shares[:3]) == secret
+    # key agreement is symmetric
+    sk1, pk1 = dh_keypair()
+    sk2, pk2 = dh_keypair()
+    assert dh_agree(sk1, pk2) == dh_agree(sk2, pk1)
+    # per-round seeds never repeat
+    assert derive_round_seed(42, 0) != derive_round_seed(42, 1)
+
+
+def test_shamir_matches_plaintext_aggregate(eight_devices):
+    import fedml_tpu
+    from fedml_tpu.cross_silo import run_in_process_group
+    from fedml_tpu.cross_silo.secagg_shamir import run_shamir_secagg_process_group
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = _sa_config(run_id="sa1")
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    history, server = run_shamir_secagg_process_group(cfg, ds, model, timeout=120.0)
+    assert len(history) == cfg.comm_round
+    assert history[-1]["test_acc"] > 0.4, history
+
+    cfg2 = _sa_config(run_id="sa1p", enable_secagg=False)
+    plain_history = run_in_process_group(cfg2, ds, model, timeout=120.0)
+    for h_sa, h_plain in zip(history, plain_history):
+        assert abs(h_sa["test_acc"] - h_plain["test_acc"]) < 0.05, (h_sa, h_plain)
+
+
+def test_shamir_server_never_sees_plaintext(eight_devices):
+    import fedml_tpu
+    from fedml_tpu.cross_silo.secagg_shamir import SAAggregator, run_shamir_secagg_process_group
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.trust.secagg.field import dequantize_from_field
+
+    cfg = _sa_config(run_id="sa2", comm_round=1, frequency_of_the_test=0)
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+
+    seen_masked = []
+    orig_add = SAAggregator.add_local_trained_result
+
+    def spy_add(self, client_idx, masked_vec, sample_num):
+        seen_masked.append(np.asarray(masked_vec, dtype=np.int64).copy())
+        orig_add(self, client_idx, masked_vec, sample_num)
+
+    SAAggregator.add_local_trained_result = spy_add
+    try:
+        run_shamir_secagg_process_group(cfg, ds, model, timeout=120.0)
+    finally:
+        SAAggregator.add_local_trained_result = orig_add
+
+    assert len(seen_masked) == cfg.client_num_in_total
+    for vec in seen_masked:
+        deq = np.abs(dequantize_from_field(vec, 1))
+        assert np.mean(deq) > 100.0, np.mean(deq)
+
+
+def test_shamir_masks_differ_across_rounds(eight_devices):
+    """The reference reuses b_u every round (masks repeat — two uploads
+    differ by exactly the model delta); our per-round seed derivation makes
+    consecutive masked uploads field-uniform relative to each other."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo.secagg_shamir import SAAggregator, run_shamir_secagg_process_group
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.trust.secagg.field import dequantize_from_field
+
+    cfg = _sa_config(run_id="sa5", comm_round=2, frequency_of_the_test=0)
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+
+    by_client: dict[int, list] = {}
+    orig_add = SAAggregator.add_local_trained_result
+
+    def spy_add(self, client_idx, masked_vec, sample_num):
+        by_client.setdefault(client_idx, []).append(
+            np.asarray(masked_vec, dtype=np.int64).copy()
+        )
+        orig_add(self, client_idx, masked_vec, sample_num)
+
+    SAAggregator.add_local_trained_result = spy_add
+    try:
+        run_shamir_secagg_process_group(cfg, ds, model, timeout=120.0)
+    finally:
+        SAAggregator.add_local_trained_result = orig_add
+
+    for cid, vecs in by_client.items():
+        assert len(vecs) == 2
+        # if masks repeated, the difference would dequantize to a small model
+        # delta; with fresh masks it is field-uniform noise
+        from fedml_tpu.trust.secagg.field import DEFAULT_PRIME
+
+        diff = (vecs[1] - vecs[0]) % DEFAULT_PRIME
+        deq = np.abs(dequantize_from_field(diff, 1))
+        assert np.mean(deq) > 100.0, (cid, np.mean(deq))
+
+
+def test_shamir_dropout_reconstruction(eight_devices):
+    """Client 4 completes setup (its pair masks are inside survivors'
+    uploads) but never uploads.  With T=2, the server reconstructs s_sk_4
+    from 3 reveals and cancels the orphaned masks; the result equals the
+    survivors' plaintext mean."""
+    import jax
+    import fedml_tpu
+    from fedml_tpu.core import rng
+    from fedml_tpu.cross_silo.client import FedMLTrainer
+    from fedml_tpu.cross_silo.secagg_shamir import build_sa_server, run_shamir_secagg_process_group
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = _sa_config(
+        run_id="sa3", comm_round=1, frequency_of_the_test=0,
+        extra={"straggler_timeout_s": 3.0, "straggler_quorum_frac": 0.5,
+               "secagg_privacy_t": 2},
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+
+    history, server = run_shamir_secagg_process_group(
+        cfg, ds, model, timeout=120.0, drop_ranks=frozenset({4})
+    )
+    assert len(history) == 1
+    final = jax.device_get(server.aggregator.global_vars)
+
+    ref = build_sa_server(cfg, ds, model, backend="INPROC")
+    init_global = jax.device_get(ref.aggregator.global_vars)
+    k0 = rng.root_key(cfg.random_seed)
+    updates = []
+    for rank in (1, 2, 3):
+        ix = ds.client_idx[rank - 1]
+        tr = FedMLTrainer(cfg, model, ds.train_x[ix], ds.train_y[ix])
+        new_vars, _ = tr.train(init_global, 0, k0, client_idx=rank - 1)
+        updates.append(new_vars)
+    expected = jax.tree_util.tree_map(
+        lambda *xs: np.mean(np.stack([np.asarray(x) for x in xs]), axis=0), *updates
+    )
+    flat_f, _ = jax.flatten_util.ravel_pytree(final)
+    flat_e, _ = jax.flatten_util.ravel_pytree(expected)
+    np.testing.assert_allclose(np.asarray(flat_f), np.asarray(flat_e), atol=2e-3)
+
+
+def test_shamir_method_dispatch(eight_devices):
+    """secagg_method='shamir' routes the cross-silo runner through the
+    Shamir protocol; unknown methods are refused."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = _sa_config(run_id="sa4", role="server", backend="INPROC", comm_round=1,
+                     frequency_of_the_test=0)
+    fedml_tpu.init(cfg)
+    history = FedMLRunner(cfg).run()
+    assert history and history[-1]["round"] == 0
+
+    bad = _sa_config(run_id="sa6", role="server", backend="INPROC",
+                     extra={"secagg_method": "nope"})
+    with pytest.raises(ValueError):
+        FedMLRunner(bad).run()
