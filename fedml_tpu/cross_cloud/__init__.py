@@ -49,3 +49,69 @@ class FedMLCrossCloudClient:
         thread = self.client.run_in_thread()
         self.client.done.wait()
         thread.join(timeout=5.0)
+
+
+class _CrossCloudRunner:
+    """Platform runner for ``training_type='cross_cloud'`` (reference
+    ``runner.py:19`` dispatches Cheetah the same way it does Octopus).
+
+    The distinguishing cross-cloud capability is the workload Cheetah exists
+    to host (``spotlight_prj/unitedllm/run_unitedllm.py``): federated LLM
+    training where silos exchange ONLY LoRA adapters — enabled with
+    ``extra.unitedllm: true``.  Non-LLM runs are the cross-silo protocol
+    with WAN transport defaults."""
+
+    def __init__(self, cfg, dataset, model):
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+
+    def run(self, timeout: float = 3600.0):
+        cfg = self.cfg
+        llm_mode = bool((getattr(cfg, "extra", {}) or {}).get("unitedllm", False))
+        if llm_mode:
+            active = [
+                f for f in ("enable_secagg", "enable_fhe", "enable_attack",
+                            "enable_defense", "enable_dp")
+                if getattr(cfg, f, False)
+            ]
+            if active:
+                raise NotImplementedError(
+                    f"trust features {active} are not wired into the "
+                    "UnitedLLM adapter-exchange path; disable them or run "
+                    "without extra.unitedllm"
+                )
+            from ..llm.unitedllm import (
+                build_unitedllm_client,
+                build_unitedllm_server,
+                run_unitedllm_process_group,
+            )
+
+            if cfg.role == "server" and cfg.backend in ("INPROC", "MESH", ""):
+                return run_unitedllm_process_group(cfg, self.dataset, timeout=timeout)[0]
+            _wan_defaults(cfg)
+            if cfg.role == "server":
+                return build_unitedllm_server(cfg, self.dataset, backend=cfg.backend).run_until_done(timeout=timeout)
+            client = build_unitedllm_client(cfg, self.dataset, rank=int(cfg.rank), backend=cfg.backend)
+            thread = client.run_in_thread()
+            client.done.wait()
+            thread.join(timeout=5.0)
+            return None
+        # non-LLM cross-cloud IS the cross-silo platform (same builders, so
+        # enable_secagg/enable_fhe dispatch to the secure managers — building
+        # plain server/client here would silently downgrade WAN privacy) with
+        # WAN transport defaults applied for distributed roles
+        from ..cross_silo import create_cross_silo_runner
+
+        if not (cfg.role == "server" and cfg.backend in ("INPROC", "MESH", "")):
+            _wan_defaults(cfg)
+        else:
+            extra = dict(getattr(cfg, "extra", {}) or {})
+            extra.setdefault("straggler_timeout_s", 60.0)
+            extra.setdefault("straggler_quorum_frac", 0.5)
+            cfg.extra = extra
+        return create_cross_silo_runner(cfg, self.dataset, self.model).run()
+
+
+def create_cross_cloud_runner(cfg, dataset, model):
+    return _CrossCloudRunner(cfg, dataset, model)

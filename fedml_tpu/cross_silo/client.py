@@ -135,6 +135,12 @@ class ClientMasterManager(FedMLCommManager):
         self.send_message(reply)
 
     def handle_message_finish(self, msg: Message) -> None:
-        self.send_message(Message(md.MSG_TYPE_C2S_FINISHED, self.rank, 0))
+        try:
+            self.send_message(Message(md.MSG_TYPE_C2S_FINISHED, self.rank, 0))
+        except OSError:
+            # best-effort terminal ack: over real sockets the server may have
+            # torn down its listener right after broadcasting FINISH (the ack
+            # is bookkeeping only, server.handle_message_client_finished)
+            log.debug("client %d: FINISHED ack undeliverable (server gone)", self.rank)
         self.done.set()
         self.finish()

@@ -114,12 +114,19 @@ def derive_round_seed(seed: int, round_idx: int) -> int:
     return int.from_bytes(h[:4], "little") % (2**31)
 
 
-def _share_pad(c_key: int, n_items: int = 2) -> np.ndarray:
-    """Keystream hiding a share pair in server transit (derived from the
-    c-key agreement, which the server does not know)."""
-    return np.random.RandomState(int(c_key) % (2**31)).randint(
-        0, P, size=n_items, dtype=np.int64
-    )
+def _share_pad(c_key: int, src: int, dst: int) -> tuple[int, int]:
+    """Keystream hiding a (b, s_sk) share pair in server transit, derived
+    from the c-key agreement the server does not know.  Bound to the
+    DIRECTION and share kind: the u<->v agreement is symmetric, so a pad
+    derived from the key alone would repeat for u->v and v->u, and a later
+    plaintext b-share reveal would hand the server a known-plaintext recovery
+    of the sibling s_sk pad.  Hashing (key, src, dst, kind) makes every pad
+    element independent."""
+    def h(kind: str) -> int:
+        d = hashlib.sha256(f"pad:{int(c_key)}:{int(src)}:{int(dst)}:{kind}".encode()).digest()
+        return int.from_bytes(d[:8], "little") % P
+
+    return h("b"), h("sk")
 
 
 def shamir_secagg_params(cfg):
@@ -162,8 +169,22 @@ class SAAggregator(FedMLAggregator):
         self.s_pk_table: dict[int, int] = {}
         # reveals[v] = (b_reveals {u: y}, sk_reveals {u: y}) from survivor v
         self.reveals: dict[int, tuple[dict, dict]] = {}
+        # clients whose s_sk was reconstructed after a dropout: their pairwise
+        # seeds are known to the server, so a later rejoin would let it also
+        # learn b_u (revealed for survivors) and fully unmask that client's
+        # upload.  Secrets are exchanged once per run, so the only sound move
+        # is PERMANENT exclusion (the reference instead re-runs its offline
+        # phase every round).
+        self.compromised: set[int] = set()
 
     def add_local_trained_result(self, client_idx: int, masked_vec, sample_num: float) -> None:
+        if client_idx in self.compromised:
+            log.warning(
+                "client %d rejoined after its s_sk was reconstructed; refusing "
+                "its upload (accepting would reveal BOTH of its secrets)",
+                client_idx,
+            )
+            return
         vec = np.asarray(masked_vec, dtype=np.int64)
         if vec.shape != (self.model_dim,):
             raise ValueError(f"masked vector shape {vec.shape} != ({self.model_dim},)")
@@ -201,6 +222,7 @@ class SAAggregator(FedMLAggregator):
             if len(shares) < self.t + 1:
                 raise RuntimeError(f"not enough s_sk-shares for dropped {u}: {len(shares)}")
             s_sk_u = shamir_reconstruct(shares[: self.t + 1])
+            self.compromised.add(u)  # its pairwise seeds are now server-known
             for v in active:
                 s_uv = dh_agree(s_sk_u, self.s_pk_table[v])
                 dropped_pair_seeds[(u, v)] = derive_round_seed(s_uv, round_idx)
@@ -289,7 +311,10 @@ class SAServerManager(FedMLServerManager):
                 msg.get(md.MSG_ARG_KEY_MODEL_PARAMS),
                 float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES)),
             )
-            if self.aggregator.check_whether_all_receive(len(self.selected)):
+            # permanently-excluded (compromised) clients never count toward
+            # the expectation — their uploads are refused by the aggregator
+            expected = len([c for c in self.selected if c not in self.aggregator.compromised])
+            if self.aggregator.check_whether_all_receive(expected):
                 self._request_reveals()
 
     def _request_reveals(self) -> None:
@@ -403,9 +428,11 @@ class SAClientManager(ClientMasterManager):
         b_enc = np.zeros(self.n, dtype=np.int64)
         sk_enc = np.zeros(self.n, dtype=np.int64)
         for v in range(1, self.n + 1):
-            pad = _share_pad(dh_agree(self.c_sk, self.pk_table[v][0]))
-            b_enc[v - 1] = (b_shares[v - 1][1] + int(pad[0])) % P
-            sk_enc[v - 1] = (sk_shares[v - 1][1] + int(pad[1])) % P
+            pad_b, pad_sk = _share_pad(
+                dh_agree(self.c_sk, self.pk_table[v][0]), self.rank, v
+            )
+            b_enc[v - 1] = (b_shares[v - 1][1] + pad_b) % P
+            sk_enc[v - 1] = (sk_shares[v - 1][1] + pad_sk) % P
         out = Message(MSG_TYPE_C2S_SECRET_SHARES, self.rank, 0)
         out.add_params(MSG_ARG_KEY_B_SHARES, b_enc)
         out.add_params(MSG_ARG_KEY_SK_SHARES, sk_enc)
@@ -417,10 +444,12 @@ class SAClientManager(ClientMasterManager):
         with self._lock:
             for u_str, b in b_enc.items():
                 u = int(u_str)
-                pad = _share_pad(dh_agree(self.c_sk, self.pk_table[u][0]))
+                pad_b, pad_sk = _share_pad(
+                    dh_agree(self.c_sk, self.pk_table[u][0]), u, self.rank
+                )
                 self.held_shares[u] = (
-                    (int(b) - int(pad[0])) % P,
-                    (int(sk_enc[u_str]) - int(pad[1])) % P,
+                    (int(b) - pad_b) % P,
+                    (int(sk_enc[u_str]) - pad_sk) % P,
                 )
             ready = len(self.held_shares) == self.n
         if ready:

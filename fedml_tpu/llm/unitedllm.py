@@ -1,0 +1,191 @@
+"""UnitedLLM — cross-cloud federated LLM training over the wire.
+
+Parity with ``spotlight_prj/unitedllm/run_unitedllm.py`` (the workload the
+reference's cross-cloud "Cheetah" platform exists to host): silos in
+different clouds fine-tune a shared LLM on private corpora and exchange ONLY
+LoRA adapter trees through the cross-silo protocol — the frozen base model
+never crosses the network (the reference ships PEFT adapter state-dicts the
+same way, ``spotlight_prj/fedllm/src/fedllm_trainer.py``).
+
+Composition, not duplication: the silo trainer implements the
+``FedMLTrainer`` train() contract, the aggregator subclasses
+``FedMLAggregator`` with the LoRA tree as its global state, and both plug
+into the UNCHANGED cross-silo server/client managers — so every transport
+(INPROC/TCP/gRPC/MQTT), the straggler handling, and the finish protocol work
+for LLM silos for free.  The base model is derived deterministically from
+``random_seed`` on every party (in a real deployment each cloud loads the
+same public checkpoint; what matters is only the adapters ride the wire).
+
+A round moves O(rank * d * layers) floats per silo.  For the default tiny
+config that is ~100x smaller than the base model — asserted by test.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core import rng
+from ..cross_silo.client import ClientMasterManager
+from ..cross_silo.server import FedMLAggregator, FedMLServerManager
+from ..models.transformer import Transformer, TransformerConfig
+from . import lora as lora_lib
+
+log = logging.getLogger("fedml_tpu.llm.unitedllm")
+
+
+def _build_base(cfg, dataset):
+    """Deterministic (cfg.random_seed-keyed) frozen base model shared by all
+    parties — the stand-in for 'every cloud loads the same checkpoint'."""
+    extra = getattr(cfg, "extra", {}) or {}
+    tcfg = TransformerConfig.tiny(vocab_size=dataset.class_num)
+    model = Transformer(tcfg)
+    k0 = rng.root_key(cfg.random_seed)
+    sample = jnp.zeros((cfg.batch_size, dataset.train_x.shape[1]), jnp.int32)
+    base_params = model.init({"params": jax.random.fold_in(k0, 1)}, sample)["params"]
+    lora0 = lora_lib.init_lora(
+        base_params, int(extra.get("lora_r", 4)), jax.random.fold_in(k0, 2),
+        targets=extra.get("lora_targets", lora_lib.DEFAULT_TARGETS),
+    )
+    alpha = float(extra.get("lora_alpha", 16.0))
+    return model, base_params, lora0, alpha
+
+
+class LoRASiloTrainer:
+    """``FedMLTrainer``-shaped local operator: global state is the LoRA tree;
+    the base stays frozen and silo-resident."""
+
+    def __init__(self, cfg, dataset, x: np.ndarray, y: np.ndarray):
+        self.cfg = cfg
+        self.model, self.base_params, _, self.alpha = _build_base(cfg, dataset)
+        cap = ((x.shape[0] + cfg.batch_size - 1) // cfg.batch_size) * cfg.batch_size
+        reps = np.resize(np.arange(x.shape[0]), cap)
+        self.x = jnp.asarray(x[reps])
+        self.y = jnp.asarray(y[reps])
+        self.count = jnp.int32(x.shape[0])
+        self._steps = cfg.epochs * max(1, cap // cfg.batch_size)
+        self._train = jax.jit(self._make_step())
+
+    def _make_step(self):
+        cfg = self.cfg
+        opt = optax.adamw(cfg.learning_rate)
+        model, base, alpha = self.model, self.base_params, self.alpha
+
+        def loss_fn(lora, x, y):
+            params = lora_lib.merge(base, lora, alpha=alpha)
+            logits = model.apply({"params": params}, x, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y
+            ).mean()
+
+        grad_fn = jax.value_and_grad(loss_fn)
+        steps = self._steps
+
+        def run(lora, x, y, count, key):
+            opt_state = opt.init(lora)
+
+            def step(carry, s):
+                lora, opt_state = carry
+                idx = jax.random.randint(jax.random.fold_in(key, s), (cfg.batch_size,), 0, count)
+                loss, g = grad_fn(lora, jnp.take(x, idx, 0), jnp.take(y, idx, 0))
+                u, opt_state = opt.update(g, opt_state, lora)
+                return (optax.apply_updates(lora, u), opt_state), loss
+
+            (lora, _), losses = jax.lax.scan(step, (lora, opt_state), jnp.arange(steps))
+            return lora, jnp.mean(losses)
+
+        return run
+
+    def train(self, global_lora, round_idx: int, seed_key, client_idx: int = 0) -> tuple:
+        key = rng.client_key(rng.round_key(seed_key, round_idx), client_idx)
+        lora = jax.tree_util.tree_map(jnp.asarray, global_lora)
+        new_lora, loss = self._train(lora, self.x, self.y, self.count, key)
+        log.info("silo %d round %d lora train loss %.4f", client_idx, round_idx, float(loss))
+        return jax.device_get(new_lora), float(self.count)
+
+
+class LoRAAggregator(FedMLAggregator):
+    """Cross-silo aggregator whose global state is the LoRA tree; evaluation
+    merges base+adapters and reports LM loss/perplexity."""
+
+    def __init__(self, cfg, dataset):
+        # deliberately NOT calling super().__init__: the base class builds a
+        # classifier + eval pipeline from a flax vision model; here global
+        # state is the adapter tree and eval is LM loss
+        self.cfg = cfg
+        self.model, self.base_params, self.global_vars, self.alpha = _build_base(cfg, dataset)
+        from ..algorithms import create as create_algorithm, hparams_from_config
+
+        spe = max(1, math.ceil(
+            getattr(cfg, "synthetic_train_size", 1024) / max(cfg.client_num_in_total, 1) / cfg.batch_size
+        ))
+        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        self.algorithm = create_algorithm(cfg, self.hp)  # aggregate/server_update only
+        self.server_state = self.algorithm.init_server_state(self.global_vars)
+        self.trust = None
+        self._schedule_calibrated = True  # adapters carry no schedule state
+        self.root_key = rng.root_key(cfg.random_seed)
+        self.model_dict: dict[int, object] = {}
+        self.sample_num_dict: dict[int, float] = {}
+        self.flag_client_model_uploaded: dict[int, bool] = {}
+        n_eval = min(256, len(dataset.test_x))
+        self._eval_x = jnp.asarray(dataset.test_x[:n_eval])
+        self._eval_y = jnp.asarray(dataset.test_y[:n_eval])
+        self._eval_jit = jax.jit(self._eval_loss)
+
+    def _calibrate_schedule(self) -> None:  # adapters: nothing to calibrate
+        return
+
+    def _eval_loss(self, lora, x, y):
+        params = lora_lib.merge(self.base_params, lora, alpha=self.alpha)
+        logits = self.model.apply({"params": params}, x, train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y
+        ).mean()
+        return {"test_loss": loss, "test_ppl": jnp.exp(loss)}
+
+    def test_on_server(self) -> dict:
+        res = self._eval_jit(self.global_vars, self._eval_x, self._eval_y)
+        return {k: float(v) for k, v in res.items()}
+
+
+def build_unitedllm_server(cfg, dataset, backend: Optional[str] = None) -> FedMLServerManager:
+    return FedMLServerManager(cfg, LoRAAggregator(cfg, dataset), backend=backend)
+
+
+def build_unitedllm_client(cfg, dataset, rank: int, backend: Optional[str] = None) -> ClientMasterManager:
+    ix = dataset.client_idx[rank - 1]
+    trainer = LoRASiloTrainer(cfg, dataset, dataset.train_x[ix], dataset.train_y[ix])
+    return ClientMasterManager(cfg, trainer, rank=rank, backend=backend)
+
+
+def run_unitedllm_process_group(cfg, dataset, backend: str = "INPROC", timeout: float = 600.0):
+    """1 server + N LLM silos on threads — over INPROC or real TCP loopback
+    (the reference smoke runs its silos as background processes over MQTT;
+    TCP is this build's routable equivalent)."""
+    if backend == "INPROC":
+        from ..comm.inproc import InProcRouter
+
+        InProcRouter.reset(str(getattr(cfg, "run_id", "0")))
+    # the server is constructed FIRST so its transport listener exists before
+    # any client's first status send (real sockets, unlike the buffering
+    # in-proc router, refuse connections to an unbound port)
+    server = build_unitedllm_server(cfg, dataset, backend=backend)
+    clients = [
+        build_unitedllm_client(cfg, dataset, rank=r, backend=backend)
+        for r in range(1, cfg.client_num_in_total + 1)
+    ]
+    for c in clients:
+        c.run_in_thread()
+    try:
+        history = server.run_until_done(timeout=timeout)
+    finally:
+        for c in clients:
+            c.finish()
+    return history, server

@@ -61,6 +61,10 @@ class FedMLRunner:
             self.runner = self._init_cross_silo_runner()
         elif cfg.training_type == C.TRAINING_PLATFORM_CROSS_DEVICE:
             self.runner = self._init_cross_device_runner()
+        elif cfg.training_type == C.TRAINING_PLATFORM_CROSS_CLOUD:
+            self.runner = self._init_cross_cloud_runner()
+        elif cfg.training_type == C.TRAINING_PLATFORM_SERVING:
+            self.runner = self._init_serving_runner()
         elif cfg.training_type == C.TRAINING_PLATFORM_CENTRALIZED:
             self.runner = self._init_centralized_runner()
         else:
@@ -203,6 +207,88 @@ class FedMLRunner:
         from .cross_device import create_cross_device_runner
 
         return create_cross_device_runner(self.cfg, dataset, model)
+
+    def _init_cross_cloud_runner(self):
+        cfg = self.cfg
+        llm_mode = bool((getattr(cfg, "extra", {}) or {}).get("unitedllm", False))
+        if self.dataset is None:
+            from .data import loader
+
+            self.dataset = loader.load(cfg)
+        if self.model is None and not llm_mode:
+            from .models import model_hub
+
+            self.model = model_hub.create(cfg, self.dataset.class_num)
+        from .cross_cloud import create_cross_cloud_runner
+
+        return create_cross_cloud_runner(cfg, self.dataset, self.model)
+
+    def _init_serving_runner(self):
+        """``training_type='model_serving'`` (reference ``runner.py:19`` +
+        ``serving/fedml_server.py``): a federated run under an endpoint
+        identity; the server registers + deploys the final model."""
+        cfg = self.cfg
+        for flag in ("enable_secagg", "enable_fhe"):
+            if getattr(cfg, flag, False):
+                # the serving managers wrap the PLAIN cross-silo builders;
+                # silently dropping a privacy flag is worse than refusing
+                raise NotImplementedError(
+                    f"{flag} is not wired into the model_serving platform; "
+                    "run the secure-aggregation job under "
+                    "training_type='cross_silo' and deploy the result"
+                )
+        dataset, model = self._load_data_model()
+        extra = getattr(cfg, "extra", {}) or {}
+        end_point = str(extra.get("end_point_name", f"ep-{cfg.run_id}"))
+        model_name = str(extra.get("serving_model_name", cfg.model))
+        version = str(extra.get("model_version", "v1"))
+        from .serving.federated import FedMLModelServingClient, FedMLModelServingServer
+
+        if cfg.role == "server":
+            single_process = cfg.backend in ("INPROC", "MESH", "")
+
+            class _ServingRunner:
+                def run(self_inner):
+                    clients = []
+                    if single_process:
+                        from .comm.inproc import InProcRouter
+
+                        InProcRouter.reset(str(getattr(cfg, "run_id", "0")))
+                        clients = [
+                            FedMLModelServingClient(
+                                cfg, end_point, model_name, version,
+                                dataset=dataset, model=model, rank=r,
+                                backend="INPROC",
+                            )
+                            for r in range(1, cfg.client_num_in_total + 1)
+                        ]
+                        for c in clients:
+                            c.run_in_thread()
+                    server = FedMLModelServingServer(
+                        cfg, end_point, model_name, version, dataset=dataset, model=model,
+                        backend="INPROC" if single_process else None,
+                    )
+                    try:
+                        history, _card = server.run()
+                    finally:
+                        for c in clients:
+                            c.finish()
+                    return history
+
+            return _ServingRunner()
+
+        class _ServingClientRunner:
+            def run(self_inner):
+                client = FedMLModelServingClient(
+                    cfg, end_point, model_name, version, dataset=dataset, model=model,
+                    rank=int(cfg.rank),
+                )
+                thread = client.run_in_thread()
+                client.client.done.wait()
+                thread.join(timeout=5.0)
+                return None
+
+        return _ServingClientRunner()
 
     def _init_centralized_runner(self):
         dataset, model = self._load_data_model()

@@ -1,0 +1,206 @@
+"""Cross-cloud federated LLM (UnitedLLM parity) + full runner dispatch.
+
+VERDICT round-2 item 4: silos exchange LoRA adapters over a routable
+transport through the cross-silo protocol — adapter-only payloads, loss
+decreases — and FedMLRunner dispatches every training_type constant.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _free_port_block(n: int = 8) -> int:
+    """A base port whose first n+1 offsets are currently free."""
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+        if base + n < 65000:
+            return base
+
+
+def _llm_cfg(**kw):
+    base = dict(
+        training_type="cross_cloud",
+        dataset="shakespeare",
+        model="transformer",
+        client_num_in_total=2,
+        client_num_per_round=2,
+        comm_round=2,
+        epochs=1,
+        batch_size=4,
+        learning_rate=0.01,
+        synthetic_train_size=128,
+        synthetic_test_size=32,
+        frequency_of_the_test=1,
+        extra={"unitedllm": True, "lora_r": 2},
+    )
+    extra = kw.pop("extra", {})
+    base.update(kw)
+    merged = dict(base["extra"])
+    merged.update(extra)
+    base["extra"] = merged
+    return tiny_config(**base)
+
+
+def test_unitedllm_adapters_only_over_tcp(eight_devices):
+    """2 LLM silos + server over REAL TCP loopback sockets: every model
+    payload on the wire is the LoRA tree (a small fraction of the base
+    model's size), and training loss decreases across rounds."""
+    import jax
+    import fedml_tpu
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+    from fedml_tpu.data import loader
+    from fedml_tpu.llm import lora as lora_lib
+    from fedml_tpu.llm.unitedllm import LoRASiloTrainer, run_unitedllm_process_group
+
+    base_port = _free_port_block()
+    cfg = _llm_cfg(run_id="ccllm1", backend="TCP",
+                   extra={"tcp_base_port": base_port})
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+
+    sizes = []
+    orig_encode = Message.encode
+
+    def spy_encode(self):
+        blob = orig_encode(self)
+        if self.get(md.MSG_ARG_KEY_MODEL_PARAMS) is not None:
+            sizes.append(len(blob))
+        return blob
+
+    Message.encode = spy_encode
+    try:
+        history, server = run_unitedllm_process_group(cfg, ds, backend="TCP", timeout=240.0)
+    finally:
+        Message.encode = orig_encode
+
+    assert len(history) == cfg.comm_round
+    # loss decreases and perplexity is finite
+    assert history[-1]["test_loss"] <= history[0]["test_loss"] + 1e-6, history
+    # adapter-only payloads: every model message is a small fraction of the
+    # full base model's wire size
+    base_bytes = sum(
+        np.asarray(l).nbytes
+        for l in jax.tree_util.tree_leaves(server.aggregator.base_params)
+    )
+    lora_bytes = sum(
+        np.asarray(l).nbytes
+        for l in jax.tree_util.tree_leaves(server.aggregator.global_vars)
+    )
+    assert lora_bytes < base_bytes / 10, (lora_bytes, base_bytes)
+    assert sizes, "no model payloads observed on the wire"
+    for s in sizes:
+        assert s < base_bytes / 2, (s, base_bytes)
+
+
+def test_runner_dispatches_cross_cloud_llm(eight_devices):
+    """training_type='cross_cloud' + extra.unitedllm through FedMLRunner."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = _llm_cfg(run_id="ccllm2", role="server", backend="INPROC", comm_round=1)
+    fedml_tpu.init(cfg)
+    history = FedMLRunner(cfg).run()
+    assert history and "test_loss" in history[-1]
+
+
+def test_runner_dispatches_cross_cloud_plain(eight_devices):
+    """Non-LLM cross-cloud = cross-silo protocol with WAN defaults."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(
+        training_type="cross_cloud", role="server", backend="INPROC",
+        client_num_in_total=2, client_num_per_round=2, comm_round=1,
+        run_id="ccplain", frequency_of_the_test=1,
+    )
+    fedml_tpu.init(cfg)
+    history = FedMLRunner(cfg).run()
+    assert history and history[-1]["test_acc"] > 0.2
+
+
+def test_cross_cloud_routes_secagg_to_secure_managers(eight_devices):
+    """cross_cloud + enable_secagg must dispatch the secure protocol, not
+    plain cross-silo (a silent WAN privacy downgrade otherwise)."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo.secagg_shamir import SAAggregator
+    from fedml_tpu.runner import FedMLRunner
+
+    seen = []
+    orig = SAAggregator.add_local_trained_result
+
+    def spy(self, *a, **k):
+        seen.append(1)
+        return orig(self, *a, **k)
+
+    cfg = tiny_config(
+        training_type="cross_cloud", role="server", backend="INPROC",
+        client_num_in_total=4, client_num_per_round=4, comm_round=1,
+        run_id="ccsec", frequency_of_the_test=0, enable_secagg=True,
+        extra={"secagg_method": "shamir"},
+    )
+    fedml_tpu.init(cfg)
+    SAAggregator.add_local_trained_result = spy
+    try:
+        FedMLRunner(cfg).run()
+    finally:
+        SAAggregator.add_local_trained_result = orig
+    assert seen, "secagg cross-cloud run did not go through the Shamir aggregator"
+
+
+def test_serving_refuses_secagg_flags(eight_devices):
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(
+        training_type="model_serving", role="server", backend="INPROC",
+        client_num_in_total=2, client_num_per_round=2, comm_round=1,
+        run_id="srvsec", enable_secagg=True,
+    )
+    fedml_tpu.init(cfg)
+    with pytest.raises(NotImplementedError):
+        FedMLRunner(cfg)
+
+
+def test_runner_dispatches_model_serving(eight_devices, tmp_path):
+    """training_type='model_serving' runs the federated job under an
+    endpoint identity through FedMLRunner."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(
+        training_type="model_serving", role="server", backend="INPROC",
+        client_num_in_total=2, client_num_per_round=2, comm_round=1,
+        run_id="ccserve", frequency_of_the_test=1,
+        extra={"end_point_name": "ep-test", "serving_model_name": "lr-test"},
+    )
+    fedml_tpu.init(cfg)
+    history = FedMLRunner(cfg).run()
+    assert history and history[-1]["test_acc"] > 0.2
+
+
+def test_runner_dispatches_all_platform_constants(eight_devices):
+    """Every training_type constant reaches a platform runner (reference
+    runner.py:19 dispatches all platforms); unknown values are refused."""
+    import fedml_tpu
+    from fedml_tpu import constants as C
+    from fedml_tpu.runner import FedMLRunner
+
+    for t in (C.TRAINING_PLATFORM_SIMULATION, C.TRAINING_PLATFORM_CROSS_SILO,
+              C.TRAINING_PLATFORM_CROSS_DEVICE, C.TRAINING_PLATFORM_CROSS_CLOUD,
+              C.TRAINING_PLATFORM_SERVING, C.TRAINING_PLATFORM_CENTRALIZED):
+        cfg = tiny_config(training_type=t, role="client", rank=1,
+                          client_num_in_total=2, client_num_per_round=2,
+                          run_id=f"disp-{t}")
+        fedml_tpu.init(cfg)
+        runner = FedMLRunner(cfg)  # construction must succeed for every platform
+        assert runner.runner is not None
+
+    with pytest.raises(ValueError):
+        FedMLRunner(tiny_config(training_type="nope"))

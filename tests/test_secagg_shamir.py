@@ -194,6 +194,45 @@ def test_shamir_dropout_reconstruction(eight_devices):
     np.testing.assert_allclose(np.asarray(flat_f), np.asarray(flat_e), atol=2e-3)
 
 
+def test_shamir_rejoin_after_drop_is_refused(eight_devices):
+    """Once a client's s_sk was reconstructed (it dropped), the server knows
+    its pairwise seeds; if it later rejoined, a b_u reveal would unmask it
+    completely.  The aggregator must permanently refuse its uploads."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo.secagg_shamir import build_sa_server, run_shamir_secagg_process_group
+
+    cfg = _sa_config(
+        run_id="sa7", comm_round=1, frequency_of_the_test=0,
+        extra={"straggler_timeout_s": 3.0, "straggler_quorum_frac": 0.5,
+               "secagg_privacy_t": 2},
+    )
+    fedml_tpu.init(cfg)
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    history, server = run_shamir_secagg_process_group(
+        cfg, ds, model, timeout=120.0, drop_ranks=frozenset({4})
+    )
+    agg = server.aggregator
+    assert 4 in agg.compromised
+    # a late upload from the reconstructed client is silently refused
+    agg.add_local_trained_result(4, np.zeros(agg.model_dim, dtype=np.int64), 1.0)
+    assert 4 not in agg.model_dict
+
+
+def test_share_pads_are_directional():
+    """The u<->v DH agreement is symmetric; pads must still differ by
+    direction and share kind (no known-plaintext reuse)."""
+    from fedml_tpu.cross_silo.secagg_shamir import _share_pad
+
+    key = 123456789
+    b_uv, sk_uv = _share_pad(key, 1, 2)
+    b_vu, sk_vu = _share_pad(key, 2, 1)
+    assert len({b_uv, sk_uv, b_vu, sk_vu}) == 4
+
+
 def test_shamir_method_dispatch(eight_devices):
     """secagg_method='shamir' routes the cross-silo runner through the
     Shamir protocol; unknown methods are refused."""
