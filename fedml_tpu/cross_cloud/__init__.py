@@ -19,13 +19,19 @@ from .. import constants as C
 from ..cross_silo import build_client, build_server
 
 
-def _wan_defaults(cfg):
-    """Apply cross-cloud transport defaults in place (no silent override of
-    explicit user choices)."""
+def _straggler_defaults(cfg):
+    """WAN silos fail more than LAN ones: bounded-wait straggler handling is
+    on by default (no silent override of explicit user choices)."""
     extra = dict(getattr(cfg, "extra", {}) or {})
     extra.setdefault("straggler_timeout_s", 60.0)
     extra.setdefault("straggler_quorum_frac", 0.5)
     cfg.extra = extra
+    return cfg
+
+
+def _wan_defaults(cfg):
+    """Straggler defaults + a routable transport for distributed roles."""
+    cfg = _straggler_defaults(cfg)
     if not cfg.backend or cfg.backend in ("INPROC", "MESH"):
         cfg.backend = C.COMM_BACKEND_TCP
     return cfg
@@ -103,13 +109,10 @@ class _CrossCloudRunner:
         # WAN transport defaults applied for distributed roles
         from ..cross_silo import create_cross_silo_runner
 
-        if not (cfg.role == "server" and cfg.backend in ("INPROC", "MESH", "")):
-            _wan_defaults(cfg)
+        if cfg.role == "server" and cfg.backend in ("INPROC", "MESH", ""):
+            _straggler_defaults(cfg)  # keep the in-process transport
         else:
-            extra = dict(getattr(cfg, "extra", {}) or {})
-            extra.setdefault("straggler_timeout_s", 60.0)
-            extra.setdefault("straggler_quorum_frac", 0.5)
-            cfg.extra = extra
+            _wan_defaults(cfg)
         return create_cross_silo_runner(cfg, self.dataset, self.model).run()
 
 

@@ -46,7 +46,28 @@ def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None)
 
 def build_client(cfg, dataset, model, rank: int, backend: Optional[str] = None) -> ClientMasterManager:
     x, y = _client_shard(dataset, rank - 1)
-    trainer = FedMLTrainer(cfg, model, x, y)
+    from ..parallel import multihost
+
+    multihost.ensure_initialized(cfg)
+    if multihost.is_multiprocess():
+        # silo spans processes (reference torchrun-DDP launcher parity):
+        # local SGD runs over the global jax.distributed data mesh, FL comm
+        # stays on the master process — see cross_silo/silo_dist.py.  Only
+        # the master builds a client manager; followers must go through
+        # run_silo_follower (the runner routes them there).
+        import jax
+
+        if jax.process_index() != 0:
+            raise RuntimeError(
+                "build_client called on a silo follower process; followers "
+                "run cross_silo.silo_dist.run_silo_follower (the cross-silo "
+                "runner does this routing when role='client')"
+            )
+        from .silo_dist import DistributedSiloTrainer
+
+        trainer = DistributedSiloTrainer(cfg, model, x, y)
+    else:
+        trainer = FedMLTrainer(cfg, model, x, y)
     return ClientMasterManager(cfg, trainer, rank=rank, backend=backend)
 
 
@@ -90,6 +111,24 @@ class _CrossSiloRunner:
             return run_group(cfg, self.dataset, self.model)
         if cfg.role == "server":
             return build_srv(cfg, self.dataset, self.model).run_until_done()
+        from ..parallel import multihost
+
+        multihost.ensure_initialized(cfg)
+        if multihost.is_multiprocess():
+            import jax
+
+            if getattr(cfg, "enable_secagg", False) or getattr(cfg, "enable_fhe", False):
+                raise NotImplementedError(
+                    "multi-process silos are not wired into the secure-"
+                    "aggregation clients; run the silo as one process"
+                )
+            if jax.process_index() != 0:
+                # silo follower: lockstep local-SGD loop, no FL comm
+                from .silo_dist import run_silo_follower
+
+                x, y = _client_shard(self.dataset, int(cfg.rank) - 1)
+                run_silo_follower(cfg, self.model, x, y)
+                return None
         client = build_cli(cfg, self.dataset, self.model, rank=int(cfg.rank))
         thread = client.run_in_thread()
         client.done.wait()

@@ -135,6 +135,11 @@ class ClientMasterManager(FedMLCommManager):
         self.send_message(reply)
 
     def handle_message_finish(self, msg: Message) -> None:
+        # release any trainer-side resources first (a distributed-silo
+        # trainer broadcasts CMD_FINISH to its follower processes here)
+        trainer_finish = getattr(self.trainer, "finish", None)
+        if callable(trainer_finish):
+            trainer_finish()
         try:
             self.send_message(Message(md.MSG_TYPE_C2S_FINISHED, self.rank, 0))
         except OSError:

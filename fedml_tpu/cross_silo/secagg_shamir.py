@@ -349,9 +349,22 @@ class SAServerManager(FedMLServerManager):
         soon as >= T+1 reveals arrived (the hard decode threshold)."""
         with self._agg_lock:
             if self._phase == "model":
+                # quorum over clients that CAN still upload: permanently
+                # excluded (compromised) clients never will
+                eligible = [c for c in self.selected if c not in self.aggregator.compromised]
+                if len(eligible) < self.aggregator.t + 1:
+                    self.failed = (
+                        f"only {len(eligible)} eligible clients remain but "
+                        f"reconstruction needs T+1={self.aggregator.t + 1}; "
+                        "the run cannot make progress (too many permanently "
+                        "excluded clients)"
+                    )
+                    log.error(self.failed)
+                    self.send_finish()
+                    return
                 need = max(
                     self.aggregator.t + 1,
-                    int(math.ceil(self.quorum_frac * len(self.selected))),
+                    int(math.ceil(self.quorum_frac * len(eligible))),
                 )
                 if self.aggregator.received_count() >= need:
                     log.warning(

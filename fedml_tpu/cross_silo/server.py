@@ -41,6 +41,15 @@ from . import message_define as md
 log = logging.getLogger("fedml_tpu.cross_silo.server")
 
 
+def provisional_steps_per_epoch(cfg) -> int:
+    """Config-derived steps/epoch guess used before real per-client sample
+    counts arrive in the protocol (MSG_ARG_KEY_NUM_SAMPLES); only seeds
+    round 0's server-side schedule."""
+    return max(1, math.ceil(
+        getattr(cfg, "synthetic_train_size", 1024) / max(cfg.client_num_in_total, 1) / cfg.batch_size
+    ))
+
+
 class FedMLAggregator:
     """Server-side state: per-round model buffer + the algorithm frame
     (reference ``FedMLAggregator`` ``fedml_aggregator.py``)."""
@@ -48,12 +57,9 @@ class FedMLAggregator:
     def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
         self.cfg = cfg
         self._model = model
-        # provisional steps/epoch until real per-client sample counts arrive
-        # in the protocol (MSG_ARG_KEY_NUM_SAMPLES) — the config-derived guess
-        # only seeds round 0's server-side schedule; _calibrate_schedule
-        # replaces it with the protocol truth at first aggregation
-        spe = max(1, math.ceil(getattr(cfg, "synthetic_train_size", 1024) / max(cfg.client_num_in_total, 1) / cfg.batch_size))
-        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        # _calibrate_schedule replaces the guess with protocol truth at
+        # first aggregation
+        self.hp = hparams_from_config(cfg, steps_per_epoch=provisional_steps_per_epoch(cfg))
         self.algorithm = create_algorithm(cfg, self.hp).build(model)
         self._schedule_calibrated = False
         k0 = rng.root_key(cfg.random_seed)
@@ -163,6 +169,9 @@ class FedMLServerManager(FedMLCommManager):
         self.quorum_frac = float((getattr(cfg, "extra", {}) or {}).get("straggler_quorum_frac", 0.5) or 0.5)
         self._round_timer: Optional[threading.Timer] = None
         self._agg_lock = threading.Lock()
+        # set by handlers/timers when the run cannot make progress; surfaced
+        # as an exception by run_until_done instead of a silent timeout
+        self.failed: Optional[str] = None
 
     # -- protocol ------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -269,4 +278,6 @@ class FedMLServerManager(FedMLCommManager):
             self.finish()
             raise TimeoutError(f"cross-silo run did not finish in {timeout}s (round {self.round_idx})")
         thread.join(timeout=5.0)
+        if self.failed:
+            raise RuntimeError(f"cross-silo run failed: {self.failed}")
         return self.history

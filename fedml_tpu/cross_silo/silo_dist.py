@@ -1,0 +1,156 @@
+"""A silo that spans processes — hierarchical cross-silo local training.
+
+Parity with the reference's torchrun-DDP-in-silo machinery
+(``cross_silo/client/client_launcher.py:46`` spawns one torchrun process
+group per silo; ``process_group_manager.py:8`` builds the NCCL/gloo group;
+``fedml_trainer_dist_adapter.py`` wraps the trainer in DDP): a silo's local
+SGD runs data-parallel over EVERY process of the silo, while only the silo
+master (process 0) speaks the FL protocol to the server.
+
+TPU-native translation — no DDP wrapper, no process group objects:
+
+- All silo processes share one ``jax.distributed`` runtime; the silo mesh is
+  a ``data`` axis over the GLOBAL device set (multi-controller JAX).
+- The local-SGD step is the SAME jitted program as the single-process
+  trainer, with each minibatch sharding-constrained over the global ``data``
+  axis — GSPMD partitions fwd/bwd per device and inserts the gradient
+  all-reduce that DDP does with NCCL hooks.  Numerics are IDENTICAL to the
+  1-process silo (asserted by test).
+- The FL transport (INPROC/TCP/gRPC/MQTT) stays single-process on the
+  master.  Followers run :func:`run_silo_follower`: a lockstep loop fed by
+  ``multihost_utils.broadcast_one_to_all`` — the master broadcasts
+  (command, round, client_idx) + the global params before each collective
+  train call, which is the multi-controller invariant (every process issues
+  the same programs in the same order).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..fl.local_sgd import make_local_train_fn
+from ..parallel import mesh as meshlib, multihost
+from .client import FedMLTrainer
+
+log = logging.getLogger("fedml_tpu.cross_silo.silo_dist")
+
+CMD_TRAIN = 1
+CMD_FINISH = 2
+
+
+def _global_data_mesh():
+    devs = jax.devices()
+    return meshlib.make_mesh((meshlib.AXIS_DATA,), (len(devs),), devs)
+
+
+def _make_silo_train_fn(cfg, model, hp):
+    """The shared jitted local-SGD program: batch constrained over the global
+    ``data`` axis so every silo process computes a slice of each minibatch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    silo_mesh = _global_data_mesh()
+    n = len(jax.devices())
+    if cfg.batch_size % n != 0:
+        raise ValueError(
+            f"distributed silo needs batch_size ({cfg.batch_size}) divisible "
+            f"by the global device count ({n})"
+        )
+
+    def batch_constraint(bx, by):
+        cx = jax.lax.with_sharding_constraint(
+            bx, NamedSharding(silo_mesh, P(meshlib.AXIS_DATA, *([None] * (bx.ndim - 1)))))
+        cy = jax.lax.with_sharding_constraint(
+            by, NamedSharding(silo_mesh, P(meshlib.AXIS_DATA, *([None] * (by.ndim - 1)))))
+        return cx, cy
+
+    return jax.jit(make_local_train_fn(model, hp, batch_constraint=batch_constraint))
+
+
+class DistributedSiloTrainer(FedMLTrainer):
+    """Silo-master trainer: same ``train()`` contract as FedMLTrainer, but
+    each call first broadcasts (TRAIN, round, client_idx) + params so the
+    follower processes join the collective program."""
+
+    def __init__(self, cfg, model, x: np.ndarray, y: np.ndarray):
+        super().__init__(cfg, model, x, y)
+        if not multihost.is_multiprocess():
+            raise RuntimeError(
+                "DistributedSiloTrainer requires an initialized multi-process "
+                "jax.distributed runtime (call multihost.ensure_initialized)"
+            )
+        # replace the local-devices program with the global-mesh program
+        self._train = _make_silo_train_fn(cfg, model, self.hp)
+        self.dp_active = True
+        self._finished = False
+
+    def train(self, global_vars, round_idx: int, seed_key, client_idx: int = 0) -> tuple:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.broadcast_one_to_all(
+            np.asarray([CMD_TRAIN, round_idx, client_idx], np.int32)
+        )
+        variables = jax.tree_util.tree_map(np.asarray, jax.device_get(global_vars))
+        variables = multihost_utils.broadcast_one_to_all(variables)
+        key = rng.client_key(rng.round_key(seed_key, round_idx), client_idx)
+        new_vars, _metrics = self._train(variables, self.x, self.y, self.count, key, None)
+        return jax.device_get(new_vars), float(self.count)
+
+    def finish(self) -> None:
+        """Release the followers (master-side, after the FL run ends).
+        Idempotent: a second CMD_FINISH broadcast would block forever because
+        the followers exited after the first."""
+        if self._finished:
+            return
+        self._finished = True
+        from jax.experimental import multihost_utils
+
+        multihost_utils.broadcast_one_to_all(
+            np.asarray([CMD_FINISH, 0, 0], np.int32)
+        )
+
+
+def run_silo_follower(cfg, model, x: np.ndarray, y: np.ndarray) -> int:
+    """Follower-process loop (reference: the non-zero torchrun ranks running
+    ``fedml_trainer_dist_adapter`` under DDP).  Executes the identical jitted
+    train program in lockstep with the master until CMD_FINISH.  Returns the
+    number of rounds trained."""
+    from jax.experimental import multihost_utils
+
+    trainer = FedMLTrainer.__new__(FedMLTrainer)
+    FedMLTrainer.__init__(trainer, cfg, model, x, y)
+    train_fn = _make_silo_train_fn(cfg, model, trainer.hp)
+    seed_key = rng.root_key(cfg.random_seed)
+    # params template for the broadcast collective: same deterministic init
+    # as the server's (seeded), so shapes/dtypes match the master's broadcast
+    template = _follower_params_template(cfg, model, x)
+    rounds = 0
+    while True:
+        cmd = np.asarray(multihost_utils.broadcast_one_to_all(
+            np.zeros(3, np.int32)
+        ))
+        if int(cmd[0]) == CMD_FINISH:
+            log.info("silo follower: finish after %d rounds", rounds)
+            return rounds
+        round_idx, client_idx = int(cmd[1]), int(cmd[2])
+        variables = multihost_utils.broadcast_one_to_all(template)
+        key = rng.client_key(rng.round_key(seed_key, round_idx), client_idx)
+        train_fn(variables, trainer.x, trainer.y, trainer.count, key, None)
+        rounds += 1
+
+
+def _follower_params_template(cfg, model, x):
+    """Host-side zero pytree with the global model's structure (the broadcast
+    collective needs matching shapes on every process)."""
+    k0 = rng.root_key(cfg.random_seed)
+    sample = jnp.asarray(x[: cfg.batch_size])
+    variables = jax.eval_shape(
+        lambda k: model.init({"params": jax.random.fold_in(k, 1),
+                              "dropout": jax.random.fold_in(k, 2)}, sample, train=True),
+        k0,
+    )
+    return jax.tree_util.tree_map(lambda s: np.zeros(s.shape, s.dtype), variables)

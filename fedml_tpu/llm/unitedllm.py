@@ -64,12 +64,11 @@ class LoRASiloTrainer:
     def __init__(self, cfg, dataset, x: np.ndarray, y: np.ndarray):
         self.cfg = cfg
         self.model, self.base_params, _, self.alpha = _build_base(cfg, dataset)
-        cap = ((x.shape[0] + cfg.batch_size - 1) // cfg.batch_size) * cfg.batch_size
-        reps = np.resize(np.arange(x.shape[0]), cap)
-        self.x = jnp.asarray(x[reps])
-        self.y = jnp.asarray(y[reps])
+        # batches are drawn by random index in [0, count) — no padding needed
+        self.x = jnp.asarray(x)
+        self.y = jnp.asarray(y)
         self.count = jnp.int32(x.shape[0])
-        self._steps = cfg.epochs * max(1, cap // cfg.batch_size)
+        self._steps = cfg.epochs * max(1, math.ceil(x.shape[0] / cfg.batch_size))
         self._train = jax.jit(self._make_step())
 
     def _make_step(self):
@@ -121,11 +120,9 @@ class LoRAAggregator(FedMLAggregator):
         self.cfg = cfg
         self.model, self.base_params, self.global_vars, self.alpha = _build_base(cfg, dataset)
         from ..algorithms import create as create_algorithm, hparams_from_config
+        from ..cross_silo.server import provisional_steps_per_epoch
 
-        spe = max(1, math.ceil(
-            getattr(cfg, "synthetic_train_size", 1024) / max(cfg.client_num_in_total, 1) / cfg.batch_size
-        ))
-        self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
+        self.hp = hparams_from_config(cfg, steps_per_epoch=provisional_steps_per_epoch(cfg))
         self.algorithm = create_algorithm(cfg, self.hp)  # aggregate/server_update only
         self.server_state = self.algorithm.init_server_state(self.global_vars)
         self.trust = None

@@ -1,0 +1,102 @@
+"""Worker entry for the 2-process distributed-silo test (spawned by
+tests/test_silo_dist.py).  Usage:
+
+    python tests/_silo_dist_worker.py <process_id> <num_processes> <port>
+
+One silo spans both processes (4 virtual CPU devices each -> an 8-device
+global ``data`` mesh for its local SGD).  Process 0 runs the FULL cross-silo
+FL group (server + silo master over INPROC); process 1 runs the follower
+loop.  Process 0 prints the final global checksum as MULTIHOST_RESULT.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.parallel import multihost
+
+    cfg = Config(
+        training_type="cross_silo",
+        dataset="synthetic",
+        model="lr",
+        client_num_in_total=1,
+        client_num_per_round=1,
+        comm_round=2,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.1,
+        synthetic_train_size=256,
+        synthetic_test_size=64,
+        partition_method="homo",
+        frequency_of_the_test=1,
+        compute_dtype="float32",
+        random_seed=0,
+        backend="INPROC",
+        extra={
+            "coordinator_address": f"localhost:{port}",
+            "num_processes": nproc,
+            "process_id": pid,
+        },
+    )
+    fedml_tpu.init(cfg)
+    multihost.ensure_initialized(cfg)
+    assert jax.process_count() == nproc, jax.process_count()
+
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    ix = ds.client_idx[0]
+    x, y = ds.train_x[ix], ds.train_y[ix]
+
+    if pid == 0:
+        import numpy as np
+
+        from fedml_tpu.comm.inproc import InProcRouter
+        from fedml_tpu.cross_silo import build_server
+        from fedml_tpu.cross_silo.client import ClientMasterManager
+        from fedml_tpu.cross_silo.silo_dist import DistributedSiloTrainer
+
+        InProcRouter.reset("silo-dist")
+        trainer = DistributedSiloTrainer(cfg, model, x, y)
+        client = ClientMasterManager(cfg, trainer, rank=1, backend="INPROC")
+        client.run_in_thread()
+        server = build_server(cfg, ds, model, backend="INPROC")
+        try:
+            history = server.run_until_done(timeout=180.0)
+        finally:
+            trainer.finish()  # release the follower
+            client.finish()
+        flat = np.concatenate([
+            np.asarray(l, dtype=np.float64).ravel()
+            for l in jax.tree_util.tree_leaves(jax.device_get(server.aggregator.global_vars))
+        ])
+        print("MULTIHOST_RESULT " + json.dumps({
+            "pid": pid,
+            "checksum": float(flat.sum()),
+            "l2": float(np.sqrt((flat ** 2).sum())),
+            "test_acc": history[-1].get("test_acc"),
+        }), flush=True)
+    else:
+        from fedml_tpu.cross_silo.silo_dist import run_silo_follower
+
+        rounds = run_silo_follower(cfg, model, x, y)
+        print("MULTIHOST_RESULT " + json.dumps({"pid": pid, "rounds": rounds}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

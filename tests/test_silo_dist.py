@@ -1,0 +1,91 @@
+"""Distributed silo (multi-process local SGD) — VERDICT round-2 item 5.
+
+A silo spanning 2 processes (jax.distributed, 8-device global data mesh)
+must produce numerics IDENTICAL to the same silo as 1 process: the jitted
+local-SGD program is the same SPMD math, only partitioned.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_silo_reference():
+    """The identical FL run with the silo as ONE process (plain trainer)."""
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    from .conftest import tiny_config
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=1, client_num_per_round=1,
+        comm_round=2, batch_size=16, synthetic_train_size=256,
+        synthetic_test_size=64, frequency_of_the_test=1, run_id="silo-ref",
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("silo-ref")
+    client = build_client(cfg, ds, model, rank=1, backend="INPROC")
+    client.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    try:
+        history = server.run_until_done(timeout=180.0)
+    finally:
+        client.finish()
+    flat = np.concatenate([
+        np.asarray(l, dtype=np.float64).ravel()
+        for l in jax.tree_util.tree_leaves(jax.device_get(server.aggregator.global_vars))
+    ])
+    return float(flat.sum()), float(np.sqrt((flat ** 2).sum())), history[-1].get("test_acc")
+
+
+def test_two_process_silo_equals_one_process_silo(eight_devices):
+    port = _free_port()
+    worker = os.path.join(_REPO, "tests", "_silo_dist_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_PLATFORM_NAME")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", str(port)],
+            env=env, cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+        assert p.returncode == 0, out[-3000:]
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("MULTIHOST_RESULT "):
+                r = json.loads(line[len("MULTIHOST_RESULT "):])
+                results[r["pid"]] = r
+    assert set(results) == {0, 1}, outs[0][-2000:]
+    # the follower trained every round in lockstep with the master
+    assert results[1]["rounds"] == 2, results
+
+    ref_sum, ref_l2, ref_acc = _single_process_silo_reference()
+    assert results[0]["checksum"] == pytest.approx(ref_sum, rel=1e-5, abs=1e-5)
+    assert results[0]["l2"] == pytest.approx(ref_l2, rel=1e-5, abs=1e-5)
+    assert results[0]["test_acc"] == pytest.approx(ref_acc, abs=1e-6)
