@@ -39,20 +39,23 @@ class PahoMqttBroker:
 
     def __init__(self, host: str, port: int = 1883, client_id: str = "",
                  username: Optional[str] = None, password: Optional[str] = None,
-                 keepalive: int = 180):
-        if _paho is None:
+                 keepalive: int = 180, paho_module=None):
+        """``paho_module`` is an injection seam (tests drive the adapter with
+        a scripted fake; production leaves it None for the real import)."""
+        paho = paho_module if paho_module is not None else _paho
+        if paho is None:
             raise ImportError(
                 "paho-mqtt is not installed; install it for a real broker or "
                 "use comm.mqtt_s3.InMemoryBroker for hermetic runs"
             )
-        if hasattr(_paho, "CallbackAPIVersion"):
+        if hasattr(paho, "CallbackAPIVersion"):
             # paho-mqtt >= 2.0 (the pip default since 2024) requires the
             # callback API version and dropped the clean_session kwarg
-            self._client = _paho.Client(
-                _paho.CallbackAPIVersion.VERSION1, client_id=client_id
+            self._client = paho.Client(
+                paho.CallbackAPIVersion.VERSION1, client_id=client_id
             )
         else:  # paho-mqtt 1.x
-            self._client = _paho.Client(client_id=client_id, clean_session=True)
+            self._client = paho.Client(client_id=client_id, clean_session=True)
         if username:
             self._client.username_pw_set(username, password or "")
         self._subs: dict[str, list[Callable[[str, bytes], None]]] = {}

@@ -130,9 +130,17 @@ class _CrossSiloRunner:
                 run_silo_follower(cfg, self.model, x, y)
                 return None
         client = build_cli(cfg, self.dataset, self.model, rank=int(cfg.rank))
-        thread = client.run_in_thread()
-        client.done.wait()
-        thread.join(timeout=5.0)
+        try:
+            thread = client.run_in_thread()
+            client.done.wait()
+            thread.join(timeout=5.0)
+        finally:
+            # release distributed-silo followers even on an abnormal end
+            # (timeout, transport error) — without CMD_FINISH they block
+            # forever in the broadcast collective; idempotent on clean runs
+            trainer_finish = getattr(getattr(client, "trainer", None), "finish", None)
+            if callable(trainer_finish):
+                trainer_finish()
         return None
 
 

@@ -32,6 +32,25 @@ from . import message_define as md
 log = logging.getLogger("fedml_tpu.cross_silo.client")
 
 
+def data_parallel_constraint(mesh):
+    """Sharding-constrain each training minibatch over ``mesh``'s data axis.
+    The batch dim is what partitions the compute; at-rest array sharding
+    alone gets undone by the random-index batch gather (verified via HLO in
+    the tests).  Shared by the local-silo and distributed-silo trainers."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel import mesh as meshlib
+
+    def batch_constraint(bx, by):
+        cx = jax.lax.with_sharding_constraint(
+            bx, NamedSharding(mesh, P(meshlib.AXIS_DATA, *([None] * (bx.ndim - 1)))))
+        cy = jax.lax.with_sharding_constraint(
+            by, NamedSharding(mesh, P(meshlib.AXIS_DATA, *([None] * (by.ndim - 1)))))
+        return cx, cy
+
+    return batch_constraint
+
+
 class FedMLTrainer:
     """Local training operator (reference ``FedMLTrainer.train`` :71).
 
@@ -55,36 +74,31 @@ class FedMLTrainer:
         self.count = jnp.int32(x.shape[0])
         spe = max(1, math.ceil(cap / cfg.batch_size))
         self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
-        n_local = len(jax.local_devices())
         self.dp_active = False
-        batch_constraint = None
+        self._train = jax.jit(make_local_train_fn(
+            model, self.hp, batch_constraint=self._batch_constraint(cfg)
+        ))
+
+    def _batch_constraint(self, cfg):
+        """Minibatch sharding constraint for this silo's device set; the
+        distributed-silo subclass overrides this with the global mesh."""
+        n_local = len(jax.local_devices())
         if n_local > 1 and bool((getattr(cfg, "extra", {}) or {}).get("silo_dp", True)):
             if cfg.batch_size % n_local == 0:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
                 from ..parallel import mesh as meshlib
 
-                silo_mesh = meshlib.make_mesh((meshlib.AXIS_DATA,), (n_local,), jax.local_devices())
-
-                def batch_constraint(bx, by):
-                    # the batch dim is what partitions the compute; at-rest
-                    # array sharding alone gets undone by the index gather
-                    cx = jax.lax.with_sharding_constraint(
-                        bx, NamedSharding(silo_mesh, P(meshlib.AXIS_DATA, *([None] * (bx.ndim - 1)))))
-                    cy = jax.lax.with_sharding_constraint(
-                        by, NamedSharding(silo_mesh, P(meshlib.AXIS_DATA, *([None] * (by.ndim - 1)))))
-                    return cx, cy
-
                 self.dp_active = True
-            else:
-                log.warning(
-                    "silo_dp requested but batch_size %d is not divisible by "
-                    "the %d local devices — intra-silo data parallelism is "
-                    "DISABLED for this silo (make batch_size a multiple of "
-                    "the device count to enable it)",
-                    cfg.batch_size, n_local,
+                return data_parallel_constraint(
+                    meshlib.make_mesh((meshlib.AXIS_DATA,), (n_local,), jax.local_devices())
                 )
-        self._train = jax.jit(make_local_train_fn(model, self.hp, batch_constraint=batch_constraint))
+            log.warning(
+                "silo_dp requested but batch_size %d is not divisible by "
+                "the %d local devices — intra-silo data parallelism is "
+                "DISABLED for this silo (make batch_size a multiple of "
+                "the device count to enable it)",
+                cfg.batch_size, n_local,
+            )
+        return None
 
     def train(self, global_vars, round_idx: int, seed_key, client_idx: int = 0) -> tuple:
         # per-client RNG stream keyed by the server-assigned client index —

@@ -33,7 +33,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import rng
-from ..fl.local_sgd import make_local_train_fn
 from ..parallel import mesh as meshlib, multihost
 from .client import FedMLTrainer
 
@@ -43,50 +42,35 @@ CMD_TRAIN = 1
 CMD_FINISH = 2
 
 
-def _global_data_mesh():
-    devs = jax.devices()
-    return meshlib.make_mesh((meshlib.AXIS_DATA,), (len(devs),), devs)
-
-
-def _make_silo_train_fn(cfg, model, hp):
-    """The shared jitted local-SGD program: batch constrained over the global
-    ``data`` axis so every silo process computes a slice of each minibatch."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    silo_mesh = _global_data_mesh()
-    n = len(jax.devices())
-    if cfg.batch_size % n != 0:
-        raise ValueError(
-            f"distributed silo needs batch_size ({cfg.batch_size}) divisible "
-            f"by the global device count ({n})"
-        )
-
-    def batch_constraint(bx, by):
-        cx = jax.lax.with_sharding_constraint(
-            bx, NamedSharding(silo_mesh, P(meshlib.AXIS_DATA, *([None] * (bx.ndim - 1)))))
-        cy = jax.lax.with_sharding_constraint(
-            by, NamedSharding(silo_mesh, P(meshlib.AXIS_DATA, *([None] * (by.ndim - 1)))))
-        return cx, cy
-
-    return jax.jit(make_local_train_fn(model, hp, batch_constraint=batch_constraint))
-
-
 class DistributedSiloTrainer(FedMLTrainer):
     """Silo-master trainer: same ``train()`` contract as FedMLTrainer, but
     each call first broadcasts (TRAIN, round, client_idx) + params so the
-    follower processes join the collective program."""
+    follower processes join the collective program.  The jitted program is
+    the base trainer's, with its minibatch constraint over the GLOBAL
+    ``data`` mesh instead of the local device set."""
 
     def __init__(self, cfg, model, x: np.ndarray, y: np.ndarray):
+        self._finished = False
         super().__init__(cfg, model, x, y)
+
+    def _batch_constraint(self, cfg):
         if not multihost.is_multiprocess():
             raise RuntimeError(
                 "DistributedSiloTrainer requires an initialized multi-process "
                 "jax.distributed runtime (call multihost.ensure_initialized)"
             )
-        # replace the local-devices program with the global-mesh program
-        self._train = _make_silo_train_fn(cfg, model, self.hp)
+        devs = jax.devices()
+        if cfg.batch_size % len(devs) != 0:
+            raise ValueError(
+                f"distributed silo needs batch_size ({cfg.batch_size}) "
+                f"divisible by the global device count ({len(devs)})"
+            )
         self.dp_active = True
-        self._finished = False
+        from .client import data_parallel_constraint
+
+        return data_parallel_constraint(
+            meshlib.make_mesh((meshlib.AXIS_DATA,), (len(devs),), devs)
+        )
 
     def train(self, global_vars, round_idx: int, seed_key, client_idx: int = 0) -> tuple:
         from jax.experimental import multihost_utils
@@ -121,12 +105,11 @@ def run_silo_follower(cfg, model, x: np.ndarray, y: np.ndarray) -> int:
     number of rounds trained."""
     from jax.experimental import multihost_utils
 
-    trainer = FedMLTrainer.__new__(FedMLTrainer)
-    FedMLTrainer.__init__(trainer, cfg, model, x, y)
-    train_fn = _make_silo_train_fn(cfg, model, trainer.hp)
+    # same class as the master -> the identical jitted global-mesh program
+    trainer = DistributedSiloTrainer(cfg, model, x, y)
     seed_key = rng.root_key(cfg.random_seed)
-    # params template for the broadcast collective: same deterministic init
-    # as the server's (seeded), so shapes/dtypes match the master's broadcast
+    # params template for the broadcast collective: shapes/dtypes must match
+    # the master's broadcast (values are ignored on non-zero processes)
     template = _follower_params_template(cfg, model, x)
     rounds = 0
     while True:
@@ -139,7 +122,7 @@ def run_silo_follower(cfg, model, x: np.ndarray, y: np.ndarray) -> int:
         round_idx, client_idx = int(cmd[1]), int(cmd[2])
         variables = multihost_utils.broadcast_one_to_all(template)
         key = rng.client_key(rng.round_key(seed_key, round_idx), client_idx)
-        train_fn(variables, trainer.x, trainer.y, trainer.count, key, None)
+        trainer._train(variables, trainer.x, trainer.y, trainer.count, key, None)
         rounds += 1
 
 

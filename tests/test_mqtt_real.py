@@ -1,0 +1,216 @@
+"""mqtt_real.py adapter tests with an injected fake paho client.
+
+VERDICT round-2 weak #3: the paho adapter contains real logic (2.x/1.x API
+switch, subscribe-before-connect, resubscribe-on-reconnect, will ordering)
+that only ran in production before.  The fake scripts a paho-shaped client so
+every branch is exercised hermetically.
+"""
+
+import json
+import threading
+
+import pytest
+
+
+class FakePahoClient:
+    def __init__(self, *args, **kwargs):
+        self.ctor_args = args
+        self.ctor_kwargs = kwargs
+        self.on_message = None
+        self.on_connect = None
+        self.connect_calls = []
+        self.loop_started = 0
+        self.loop_stopped = 0
+        self.subscriptions = []  # (topic, qos)
+        self.published = []  # (topic, payload, qos)
+        self.will = None
+        self.userpass = None
+        self.disconnected = 0
+
+    def username_pw_set(self, u, p):
+        self.userpass = (u, p)
+
+    def connect(self, host, port, keepalive):
+        self.connect_calls.append((host, port, keepalive))
+        if self.on_connect:
+            self.on_connect(self, None, None, 0)
+
+    def loop_start(self):
+        self.loop_started += 1
+
+    def loop_stop(self):
+        self.loop_stopped += 1
+
+    def subscribe(self, topic, qos=0):
+        self.subscriptions.append((topic, qos))
+
+    def publish(self, topic, payload, qos=0):
+        self.published.append((topic, payload, qos))
+
+    def will_set(self, topic, payload, qos=0, retain=False):
+        self.will = (topic, payload, qos, retain)
+
+    def disconnect(self):
+        self.disconnected += 1
+
+    # test helper: simulate an inbound broker message
+    def deliver(self, topic, payload):
+        class M:
+            pass
+
+        m = M()
+        m.topic, m.payload = topic, payload
+        self.on_message(self, None, m)
+
+
+class FakePaho2:
+    """paho-mqtt >= 2.0 shape: has CallbackAPIVersion."""
+
+    class CallbackAPIVersion:
+        VERSION1 = "v1"
+
+    Client = FakePahoClient
+
+
+class FakePaho1:
+    """paho-mqtt 1.x shape: no CallbackAPIVersion, clean_session kwarg."""
+
+    Client = FakePahoClient
+
+
+def _broker(paho, **kw):
+    from fedml_tpu.comm.mqtt_real import PahoMqttBroker
+
+    return PahoMqttBroker("broker.test", 1883, client_id="c0", paho_module=paho, **kw)
+
+
+def test_paho2_constructor_uses_callback_api_version():
+    b = _broker(FakePaho2)
+    assert b._client.ctor_args == ("v1",)
+    assert b._client.ctor_kwargs == {"client_id": "c0"}
+
+
+def test_paho1_constructor_uses_clean_session():
+    b = _broker(FakePaho1)
+    assert b._client.ctor_args == ()
+    assert b._client.ctor_kwargs == {"client_id": "c0", "clean_session": True}
+
+
+def test_username_password_forwarded():
+    b = _broker(FakePaho2, username="u", password="s3cret")
+    assert b._client.userpass == ("u", "s3cret")
+
+
+def test_will_before_connect_and_lazy_single_connect():
+    b = _broker(FakePaho2)
+    b.set_will("c0", "t/status", b"bye")
+    assert b._client.will, "will must be set before any connect"
+    assert b._client.connect_calls == []
+    b.publish("t/a", b"one")
+    b.publish("t/a", b"two")
+    # exactly one connect + loop_start despite two publishes
+    assert len(b._client.connect_calls) == 1
+    assert b._client.loop_started == 1
+    assert b._client.will == ("t/status", b"bye", 2, False)
+    assert [(t, p) for t, p, _q in b._client.published] == [("t/a", b"one"), ("t/a", b"two")]
+    # everything rides QoS 2
+    assert all(q == 2 for _t, _p, q in b._client.published)
+
+
+def test_resubscribe_on_reconnect():
+    """Clean-session reconnects start with zero subscriptions: on_connect
+    must re-issue every subscribe or a broker restart silently drops all
+    round traffic."""
+    b = _broker(FakePaho2)
+    got = []
+    b.subscribe("t/x", lambda t, p: got.append((t, p)))
+    b.subscribe("t/y", lambda t, p: got.append((t, p)))
+    before = list(b._client.subscriptions)
+    assert ("t/x", 2) in before and ("t/y", 2) in before
+    # broker restart: paho fires on_connect again
+    b._client.on_connect(b._client, None, None, 0)
+    after = b._client.subscriptions[len(before):]
+    assert sorted(after) == [("t/x", 2), ("t/y", 2)], after
+
+
+def test_dispatch_routes_to_topic_callbacks():
+    b = _broker(FakePaho2)
+    got_x, got_y = [], []
+    b.subscribe("t/x", lambda t, p: got_x.append(p))
+    b.subscribe("t/y", lambda t, p: got_y.append(p))
+    b._client.deliver("t/x", b"payload-x")
+    assert got_x == [b"payload-x"] and got_y == []
+
+
+def test_disconnect_stops_loop_once():
+    b = _broker(FakePaho2)
+    b.publish("t", b"x")
+    b.disconnect()
+    b.disconnect()  # idempotent
+    assert b._client.loop_stopped == 1
+    assert b._client.disconnected == 1
+
+
+def test_s3_store_with_injected_client():
+    from fedml_tpu.comm.mqtt_real import S3ObjectStore
+
+    blobs = {}
+
+    class FakeS3:
+        def put_object(self, Bucket, Key, Body):
+            blobs[(Bucket, Key)] = Body
+
+        def get_object(self, Bucket, Key):
+            class Body:
+                def __init__(self, b):
+                    self._b = b
+
+                def read(self):
+                    return self._b
+
+            return {"Body": Body(blobs[(Bucket, Key)])}
+
+    store = S3ObjectStore(bucket="bkt", client=FakeS3())
+    key = store.put("model-r1", b"\x01\x02")
+    assert key == "model-r1"
+    assert ("bkt", "fedml_tpu/model-r1") in blobs  # prefix applied
+    assert store.get("model-r1") == b"\x01\x02"
+
+
+def test_comm_manager_rides_fake_paho_end_to_end():
+    """MqttS3CommManager over the paho adapter: ONLINE status published,
+    per-rank topic subscribed, a delivered frame reaches the observer."""
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.mqtt_real import PahoMqttBroker, S3ObjectStore
+    from fedml_tpu.comm.mqtt_s3 import InMemoryObjectStore, MqttS3CommManager
+
+    b = _broker(FakePaho2)
+    store = InMemoryObjectStore()
+    mgr = MqttS3CommManager("run9", 1, broker=b, store=store)
+    # will set before the first connect, ONLINE announced after
+    assert b._client.will[0] == "fedml_run9_status"
+    assert json.loads(b._client.will[1].decode())["status"] == "OFFLINE"
+    online = [p for t, p, _q in b._client.published if t == "fedml_run9_status"]
+    assert online and json.loads(online[0].decode())["status"] == "ONLINE"
+    assert ("fedml_run9_to_1", 2) in b._client.subscriptions
+
+    # outbound: manager publishes through the paho adapter with the D/R marker
+    out = Message(3, sender_id=1, receiver_id=2)
+    out.add_params("k", 1.5)
+    mgr.send_message(out)
+    sent = [(t, p) for t, p, _q in b._client.published if t == "fedml_run9_to_2"]
+    assert len(sent) == 1 and sent[0][1][:1] == b"D"
+
+    # inbound: a frame delivered by paho lands in the inbox and decodes
+    b._client.deliver("fedml_run9_to_1", sent[0][1])
+    data = mgr._inbox.get(timeout=2)
+    m = mgr._decode_bytes(data)
+    assert m.get_type() == 3 and m.get_sender_id() == 1
+    assert float(m.get("k")) == 1.5
+
+
+def test_import_error_without_paho():
+    from fedml_tpu.comm.mqtt_real import PahoMqttBroker
+
+    with pytest.raises(ImportError):
+        PahoMqttBroker("h", paho_module=None)
