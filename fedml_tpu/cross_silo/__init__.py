@@ -132,7 +132,12 @@ class _CrossSiloRunner:
         client = build_cli(cfg, self.dataset, self.model, rank=int(cfg.rank))
         try:
             thread = client.run_in_thread()
-            client.done.wait()
+            # poll instead of a bare wait: if the comm thread dies on a
+            # transport error it never sets done, and the finally below must
+            # still run to release silo followers
+            while not client.done.wait(5.0):
+                if not thread.is_alive():
+                    break
             thread.join(timeout=5.0)
         finally:
             # release distributed-silo followers even on an abnormal end

@@ -38,6 +38,10 @@ _DATASET_SPECS = {
     "cifar100": ((32, 32, 3), 100, 50000, 10000),
     "cinic10": ((32, 32, 3), 10, 90000, 90000),
     "synthetic": ((60,), 10, 20000, 4000),
+    # low-SNR benchmark: multi-modal gaussian cluster mixture whose accuracy
+    # is center-estimation-limited — earned gradually, never saturating
+    # early (SURVEY §7 hard-part 3 evidence; see _synthetic_hard)
+    "synthetic_hard": ((32, 32, 3), 10, 20000, 4000),
     # federated Google Landmarks (reference data/fed_gld/data_loader.py):
     # 23k/160k images over 203/2028 landmark classes, resized 96x96
     "gld23k": ((96, 96, 3), 203, 23080, 2316),
@@ -106,7 +110,10 @@ def _load_image_like(cfg: Config, name: str) -> FederatedDataset:
         if n_test > test_cap:
             log.warning("%s synthetic test set capped at %d samples (was %d)", name, test_cap, n_test)
             n_test = test_cap
-        arrays = _synthetic_classification(name, feat, classes, n_train, n_test, cfg.random_seed)
+        if name == "synthetic_hard":
+            arrays = _synthetic_hard(feat, classes, n_train, n_test, cfg.random_seed)
+        else:
+            arrays = _synthetic_classification(name, feat, classes, n_train, n_test, cfg.random_seed)
     train_x, train_y, test_x, test_y = arrays
     idx_map = part.partition(
         cfg.partition_method, train_y, cfg.client_num_in_total, cfg.partition_alpha, cfg.random_seed
@@ -186,6 +193,38 @@ def _synthetic_classification(name, feat, classes, n_train, n_test, seed):
         y = rng.randint(0, classes, size=n).astype(np.int32)
         x = templates[y] + rng.normal(0, 1.2, size=(n,) + feat).astype(np.float32)
         return x.astype(np.float32), y
+
+    train_x, train_y = gen(n_train)
+    test_x, test_y = gen(n_test)
+    return train_x, train_y, test_x, test_y
+
+
+def _synthetic_hard(feat, classes, n_train, n_test, seed, modes_per_class: int = 4,
+                    center_scale: float = 0.1):
+    """Low-SNR synthetic benchmark (the per-class-gaussian stand-in saturates
+    by round 9 and proves only wiring, not learning capability).
+
+    Each class is a MIXTURE of ``modes_per_class`` gaussian clusters whose
+    centers have per-coordinate scale ``center_scale`` against unit noise —
+    an SNR of 0.1.  The cluster margin is ``center_scale * sqrt(d/2)`` ≈ 3.9
+    sigma for CIFAR shapes, so the Bayes accuracy is ~100%, but ESTIMATING
+    the 40 centers from data needs ~(sqrt(d)/margin)^2 ≈ 200 samples per
+    cluster for a useful decision rule: accuracy is center-estimation-limited
+    and grows smoothly with samples seen (measured: ~67% @ 8k train samples,
+    ~75% @ 16k, 12 epochs — far from its ceiling, no early saturation).
+    ``tests/test_accuracy_hard.py`` locks the expected-accuracy band per
+    seed.  Deterministic in ``seed``.
+    """
+    rng = np.random.RandomState(0x5EED ^ (seed * 2654435761 % (2**31)))
+    d = int(np.prod(feat))
+    n_clusters = classes * modes_per_class
+    centers = rng.normal(0, center_scale, size=(n_clusters, d)).astype(np.float32)
+    cluster_class = (np.arange(n_clusters) % classes).astype(np.int32)
+
+    def gen(n):
+        k = rng.randint(0, n_clusters, size=n)
+        x = centers[k] + rng.normal(0, 1.0, size=(n, d)).astype(np.float32)
+        return x.reshape((n,) + feat).astype(np.float32), cluster_class[k]
 
     train_x, train_y = gen(n_train)
     test_x, test_y = gen(n_test)

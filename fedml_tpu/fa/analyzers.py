@@ -76,8 +76,14 @@ class TrieHHClientAnalyzer(FAClientAnalyzer):
         trie = self.init_msg or {""}
         votes = Counter()
         words = [str(w) for w in np.ravel(data)]
-        # stable per-client seed (hash() is salted per interpreter)
-        rng = np.random.RandomState(zlib.crc32("|".join(words[:4]).encode()) % (2**31))
+        # deterministic but ROUND-VARYING word sample: seeded by the client's
+        # data and a per-analyzer round counter — a fixed per-client seed
+        # would vote the same word forever and starve every other heavy
+        # hitter (hash() itself is salted per interpreter; the trie state is
+        # no good as a seed either, since it stops changing once saturated)
+        self._round_no = getattr(self, "_round_no", -1) + 1
+        seed_src = "|".join(words[:4]) + f"#r{self._round_no}"
+        rng = np.random.RandomState(zlib.crc32(seed_src.encode()) % (2**31))
         if not words:
             return votes
         w = words[rng.randint(len(words))]  # one word per client per round (DP)

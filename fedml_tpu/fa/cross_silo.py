@@ -1,0 +1,235 @@
+"""Federated analytics over the wire — the cross-silo FA runner.
+
+Parity with ``fa/runner.py:5`` (``FARunner`` dispatches
+``training_type='cross_silo'`` to ``fa/cross_silo/fa_server.py`` /
+``fa_client.py``, which mirror the FL managers): the SAME round protocol as
+cross-silo FL — check status, INIT, submissions, aggregate, SYNC, FINISH —
+but the payloads are analytics submissions (counts, tries, candidate sets)
+instead of model weights, and the per-round downlink is the aggregator's
+``init_msg`` (TrieHH's current prefix trie, k-percentile's current bounds)
+instead of global params.
+
+Rides every comm backend the FL managers do (INPROC/TCP/gRPC/MQTT) because
+it reuses the same ``FedMLCommManager`` + ``Message`` machinery and the flat
+message-type namespace (FA uses 20-22).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..comm.comm_manager import FedMLCommManager
+from ..comm.message import Message
+from ..core import rng
+from ..cross_silo import message_define as md
+from ..obs.metrics import MetricsLogger
+from .analyzers import create_analyzer_pair
+from .frame import FAClientAnalyzer, FAServerAggregator
+
+log = logging.getLogger("fedml_tpu.fa.cross_silo")
+
+MSG_TYPE_S2C_FA_ROUND = 20      # init_msg + round_idx (INIT and SYNC alike)
+MSG_TYPE_C2S_FA_SUBMISSION = 21
+MSG_ARG_KEY_FA_PAYLOAD = "fa_payload"
+
+
+def fa_encode(obj):
+    """Analytics payloads are Python containers (sets, Counters, dicts with
+    non-string keys) that the JSON control channel cannot carry — encode them
+    as tagged structures; :func:`fa_decode` restores the exact types."""
+    from collections import Counter
+
+    if isinstance(obj, Counter):
+        return {"__fa__": "counter", "v": [[fa_encode(k), int(c)] for k, c in sorted(obj.items(), key=lambda kv: repr(kv[0]))]}
+    if isinstance(obj, (set, frozenset)):
+        return {"__fa__": "set", "v": [fa_encode(x) for x in sorted(obj, key=repr)]}
+    if isinstance(obj, dict):
+        return {"__fa__": "dict", "v": [[fa_encode(k), fa_encode(v)] for k, v in obj.items()]}
+    if isinstance(obj, tuple):
+        return {"__fa__": "tuple", "v": [fa_encode(x) for x in obj]}
+    if isinstance(obj, list):
+        return [fa_encode(x) for x in obj]
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return {"__fa__": "array", "v": obj.tolist(), "dtype": str(obj.dtype)}
+    return obj
+
+
+def fa_decode(obj):
+    from collections import Counter
+
+    if isinstance(obj, dict) and "__fa__" in obj:
+        tag = obj["__fa__"]
+        if tag == "counter":
+            return Counter({fa_decode(k): int(c) for k, c in obj["v"]})
+        if tag == "set":
+            return {fa_decode(x) for x in obj["v"]}
+        if tag == "dict":
+            return {fa_decode(k): fa_decode(v) for k, v in obj["v"]}
+        if tag == "tuple":
+            return tuple(fa_decode(x) for x in obj["v"])
+        if tag == "array":
+            return np.asarray(obj["v"], dtype=obj["dtype"])
+        raise ValueError(f"unknown fa payload tag {tag!r}")
+    if isinstance(obj, list):
+        return [fa_decode(x) for x in obj]
+    return obj
+
+
+class FAServerManager(FedMLCommManager):
+    """Reference ``FACrossSiloServer``: drive rounds of analytics."""
+
+    def __init__(self, cfg, aggregator: FAServerAggregator,
+                 backend: Optional[str] = None, logger: Optional[MetricsLogger] = None):
+        super().__init__(cfg, rank=0, size=cfg.client_num_in_total + 1, backend=backend)
+        self.aggregator = aggregator
+        self.cfg = cfg
+        self.round_idx = 0
+        self.client_ids = list(range(1, cfg.client_num_in_total + 1))
+        self.per_round = min(cfg.client_num_per_round, len(self.client_ids))
+        self.active_clients: set[int] = set()
+        self.submissions: dict[int, object] = {}
+        self.selected: list[int] = []
+        self.done = threading.Event()
+        self.history: list[dict] = []
+        self.logger = logger or MetricsLogger(stdout=False)
+        self._lock = threading.Lock()
+        self.root_key = rng.root_key(cfg.random_seed)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(md.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_message_client_status)
+        self.register_message_receive_handler(MSG_TYPE_C2S_FA_SUBMISSION, self.handle_message_submission)
+
+    def start(self) -> None:
+        for cid in self.client_ids:
+            self.send_message(Message(md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, 0, cid))
+
+    def handle_message_client_status(self, msg: Message) -> None:
+        if msg.get(md.MSG_ARG_KEY_CLIENT_STATUS) == md.CLIENT_STATUS_ONLINE:
+            self.active_clients.add(msg.get_sender_id())
+        if len(self.active_clients) == len(self.client_ids):
+            self._broadcast_round()
+
+    def _broadcast_round(self) -> None:
+        """Sample this round's clients and send them the aggregator's
+        init_msg (reference FA downlink; trie state, bounds, ...)."""
+        if self.per_round >= len(self.client_ids):
+            self.selected = list(self.client_ids)
+        else:
+            idx = rng.sample_clients_np(self.round_idx, len(self.client_ids), self.per_round)
+            self.selected = [self.client_ids[i] for i in idx]
+        init = self.aggregator.init_msg()
+        for cid in self.selected:
+            out = Message(MSG_TYPE_S2C_FA_ROUND, 0, cid)
+            out.add_params(MSG_ARG_KEY_FA_PAYLOAD, fa_encode(init))
+            out.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            self.send_message(out)
+
+    def handle_message_submission(self, msg: Message) -> None:
+        with self._lock:
+            if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) != self.round_idx:
+                return
+            self.submissions[msg.get_sender_id()] = fa_decode(msg.get(MSG_ARG_KEY_FA_PAYLOAD))
+            if len(self.submissions) < len(self.selected):
+                return
+            subs = [self.submissions[c] for c in sorted(self.submissions)]
+            self.submissions.clear()
+            self.aggregator.aggregate(subs)
+            metrics = {"round": self.round_idx, "submissions": len(subs)}
+            self.logger.log(metrics)
+            self.history.append(metrics)
+            self.round_idx += 1
+            if self.round_idx >= self.cfg.comm_round:
+                for cid in self.client_ids:
+                    self.send_message(Message(md.MSG_TYPE_S2C_FINISH, 0, cid))
+                self.done.set()
+                self.finish()
+                return
+            self._broadcast_round()
+
+    def result(self):
+        return self.aggregator.result()
+
+    def run_until_done(self, timeout: float = 600.0):
+        thread = self.run_in_thread()
+        self.start()
+        if not self.done.wait(timeout):
+            self.finish()
+            raise TimeoutError(f"FA run did not finish in {timeout}s (round {self.round_idx})")
+        thread.join(timeout=5.0)
+        return self.result()
+
+
+class FAClientManager(FedMLCommManager):
+    """Reference ``FACrossSiloClient``: analyze the local shard on request."""
+
+    def __init__(self, cfg, analyzer: FAClientAnalyzer, data: np.ndarray,
+                 rank: int, backend: Optional[str] = None):
+        super().__init__(cfg, rank=rank, size=cfg.client_num_in_total + 1, backend=backend)
+        self.analyzer = analyzer
+        self.data = data
+        self.cfg = cfg
+        self.done = threading.Event()
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.handle_message_check_status)
+        self.register_message_receive_handler(MSG_TYPE_S2C_FA_ROUND, self.handle_message_round)
+        self.register_message_receive_handler(md.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+
+    def handle_message_check_status(self, msg: Message) -> None:
+        reply = Message(md.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        reply.add_params(md.MSG_ARG_KEY_CLIENT_STATUS, md.CLIENT_STATUS_ONLINE)
+        self.send_message(reply)
+
+    def handle_message_round(self, msg: Message) -> None:
+        self.analyzer.set_init_msg(fa_decode(msg.get(MSG_ARG_KEY_FA_PAYLOAD)))
+        sub = self.analyzer.local_analyze(self.data, self.cfg)
+        reply = Message(MSG_TYPE_C2S_FA_SUBMISSION, self.rank, 0)
+        reply.add_params(MSG_ARG_KEY_FA_PAYLOAD, fa_encode(sub))
+        reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
+        self.send_message(reply)
+
+    def handle_message_finish(self, msg: Message) -> None:
+        self.done.set()
+        self.finish()
+
+
+# -- builders + runner --------------------------------------------------------
+
+def build_fa_server(cfg, task: str, backend: Optional[str] = None) -> FAServerManager:
+    _, aggregator = create_analyzer_pair(task, cfg)
+    return FAServerManager(cfg, aggregator, backend=backend)
+
+
+def build_fa_client(cfg, task: str, data: np.ndarray, rank: int,
+                    backend: Optional[str] = None) -> FAClientManager:
+    analyzer, _ = create_analyzer_pair(task, cfg)
+    return FAClientManager(cfg, analyzer, data, rank=rank, backend=backend)
+
+
+def run_fa_process_group(cfg, task: str, client_data: Sequence[np.ndarray],
+                         backend: str = "INPROC", timeout: float = 600.0):
+    """1 FA server + N FA clients on threads over the chosen backend.
+    Returns (result, server)."""
+    if backend == "INPROC":
+        from ..comm.inproc import InProcRouter
+
+        InProcRouter.reset(str(getattr(cfg, "run_id", "0")))
+    server = build_fa_server(cfg, task, backend=backend)
+    clients = [
+        build_fa_client(cfg, task, np.asarray(client_data[r - 1]), rank=r, backend=backend)
+        for r in range(1, cfg.client_num_in_total + 1)
+    ]
+    for c in clients:
+        c.run_in_thread()
+    try:
+        result = server.run_until_done(timeout=timeout)
+    finally:
+        for c in clients:
+            c.finish()
+    return result, server

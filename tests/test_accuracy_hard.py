@@ -1,0 +1,192 @@
+"""Accuracy-story hardening (VERDICT round-2 item 7).
+
+1. The low-SNR ``synthetic_hard`` benchmark has a LOCKED expected-accuracy
+   band: learnable but never trivially saturated (the old stand-in hit 99.95%
+   by round 9, proving only wiring).
+2. BN-statistics aggregation semantics under Dirichlet skew are pinned:
+   ``batch_stats`` leaves are sample-weight averaged exactly like weights
+   (SURVEY §7 hard-part 3 — the behavior the accuracy story depends on).
+3. The real-file CIFAR reader is exercised end-to-end from a generated
+   3-image ``cifar-10-batches-py`` fixture.
+"""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def test_hard_benchmark_band_and_gradual_learning(eight_devices):
+    """FedAvg hetero alpha=0.5 on synthetic_hard: accuracy climbs gradually
+    into a locked band — no early saturation, no failure to learn."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(
+        dataset="synthetic_hard", model="lr", client_num_in_total=8,
+        client_num_per_round=8, comm_round=8, epochs=2, batch_size=32,
+        learning_rate=0.1, synthetic_train_size=8192, synthetic_test_size=2048,
+        partition_method="hetero", partition_alpha=0.5, frequency_of_the_test=2,
+    )
+    fedml_tpu.init(cfg)
+    hist = FedMLRunner(cfg).run()
+    accs = [h["test_acc"] for h in hist if "test_acc" in h]
+    assert len(accs) >= 3
+    # locked band for this seed/recipe (measured 0.656-0.694 over rounds
+    # 2-12; re-lock deliberately if the generator changes)
+    assert 0.55 <= accs[-1] <= 0.85, accs
+    # gradual: later evals keep improving and nothing saturates
+    assert accs[-1] > accs[0] + 0.01, accs
+    assert max(accs) < 0.95, f"benchmark must not saturate: {accs}"
+
+
+def test_hard_benchmark_is_not_trivial_early(eight_devices):
+    """Round-0 accuracy sits far below the band — accuracy must be EARNED
+    across rounds (the old stand-in was >90% after one round)."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(
+        dataset="synthetic_hard", model="lr", client_num_in_total=8,
+        client_num_per_round=8, comm_round=1, epochs=1, batch_size=32,
+        learning_rate=0.1, synthetic_train_size=8192, synthetic_test_size=2048,
+        partition_method="hetero", partition_alpha=0.5, frequency_of_the_test=1,
+    )
+    fedml_tpu.init(cfg)
+    hist = FedMLRunner(cfg).run()
+    assert hist[-1]["test_acc"] < 0.55, hist[-1]
+
+
+def test_hard_benchmark_deterministic():
+    from fedml_tpu.data import loader
+
+    a = loader.load(tiny_config(dataset="synthetic_hard", synthetic_train_size=512,
+                                synthetic_test_size=128))
+    b = loader.load(tiny_config(dataset="synthetic_hard", synthetic_train_size=512,
+                                synthetic_test_size=128))
+    np.testing.assert_array_equal(a.train_x, b.train_x)
+    np.testing.assert_array_equal(a.train_y, b.train_y)
+    # balanced classes (interleaved cluster->class assignment)
+    counts = np.bincount(a.train_y, minlength=10)
+    assert counts.min() > 0.5 * counts.max(), counts
+
+
+def test_bn_stats_aggregated_as_sample_weighted_mean(eight_devices):
+    """Pin the BN-statistics aggregation semantics under alpha=0.5 skew:
+    the new global ``batch_stats`` equal the sample-weighted mean of the
+    clients' post-training stats — the same rule as weights (FedAvg
+    contribution = full variables; SURVEY §7 hard-part 3)."""
+    import flax.linen as nn
+
+    import fedml_tpu
+    from fedml_tpu.core import rng
+    from fedml_tpu.sim.engine import MeshSimulator
+    from fedml_tpu.data import loader
+
+    class TinyBN(nn.Module):
+        classes: int = 10
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(16)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            x = nn.relu(x)
+            if self.is_mutable_collection("params"):
+                nn.Dropout(0.0, deterministic=True)(x)  # init rng shape parity
+            return nn.Dense(self.classes)(x)
+
+    cfg = tiny_config(
+        dataset="synthetic", model="mlp", client_num_in_total=4,
+        client_num_per_round=4, comm_round=1, epochs=1, batch_size=16,
+        partition_method="hetero", partition_alpha=0.5,
+        synthetic_train_size=512, synthetic_test_size=128,
+        frequency_of_the_test=0,
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    sim = MeshSimulator(cfg, ds, TinyBN())
+    assert "batch_stats" in sim.global_vars, "model must carry BN stats"
+    g0 = jax.device_get(sim.global_vars)
+
+    # independently recompute each sampled client's contribution
+    n_total = ds.n_clients
+    m = cfg.client_num_per_round
+    sampled = np.asarray(rng.sample_clients(sim.root_key, 0, n_total, m))
+    rkey = rng.round_key(sim.root_key, jnp.int32(0))
+    contribs, weights = [], []
+    for ci in sampled:
+        k = rng.client_key(rkey, int(ci))
+        out = sim.algorithm.client_update(
+            sim.global_vars, None, sim.server_state,
+            sim._data[0][int(ci)], sim._data[1][int(ci)], sim.counts[int(ci)], k,
+        )
+        contribs.append(jax.device_get(out.contribution))
+        weights.append(float(sim.counts[int(ci)]))
+    w = np.asarray(weights) / np.sum(weights)
+
+    sim.run_round()
+    g1 = jax.device_get(sim.global_vars)
+
+    for key in ("mean", "var"):
+        leaf = g1["batch_stats"]["BatchNorm_0"][key]
+        expected = sum(
+            wi * np.asarray(c["batch_stats"]["BatchNorm_0"][key])
+            for wi, c in zip(w, contribs)
+        )
+        np.testing.assert_allclose(np.asarray(leaf), expected, rtol=2e-4, atol=2e-5)
+        # the skewed clients genuinely disagree (the pin is meaningful)
+        stack = np.stack([np.asarray(c["batch_stats"]["BatchNorm_0"][key]) for c in contribs])
+        assert np.abs(stack - stack[0]).max() > 1e-5
+
+
+def _write_cifar_fixture(root, n_per_batch=1):
+    """Generate a minimal cifar-10-batches-py layout (3 known images)."""
+    d = root / "cifar-10-batches-py"
+    d.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    all_imgs, all_labels = [], []
+    for i in range(1, 6):
+        img = rng.randint(0, 256, size=(n_per_batch, 3072), dtype=np.uint8)
+        labels = [int(rng.randint(0, 10)) for _ in range(n_per_batch)]
+        with open(d / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": img, b"labels": labels}, f)
+        all_imgs.append(img)
+        all_labels.extend(labels)
+    timg = rng.randint(0, 256, size=(2, 3072), dtype=np.uint8)
+    tlabels = [3, 7]
+    with open(d / "test_batch", "wb") as f:
+        pickle.dump({b"data": timg, b"labels": tlabels}, f)
+    return np.concatenate(all_imgs), np.asarray(all_labels), timg, np.asarray(tlabels)
+
+
+def test_cifar_reader_end_to_end(tmp_path):
+    """loader.load(dataset='cifar10') consumes a real cifar-10-batches-py
+    directory: NCHW->NHWC reshape, /255, canonical per-channel normalization,
+    labels intact."""
+    from fedml_tpu.data import loader
+
+    raw_train, train_y, raw_test, test_y = _write_cifar_fixture(tmp_path)
+    cfg = tiny_config(
+        dataset="cifar10", data_cache_dir=str(tmp_path), synthetic_fallback=False,
+        client_num_in_total=2, client_num_per_round=2,
+    )
+    ds = loader.load(cfg)
+    assert ds.train_x.shape == (5, 32, 32, 3)
+    assert ds.test_x.shape == (2, 32, 32, 3)
+    np.testing.assert_array_equal(ds.train_y, train_y)
+    np.testing.assert_array_equal(ds.test_y, test_y)
+    # exact normalization math on a known pixel
+    mean = np.array([0.4914, 0.4822, 0.4465], np.float32)
+    std = np.array([0.2470, 0.2435, 0.2616], np.float32)
+    expected = (raw_train[0].reshape(3, 32, 32).transpose(1, 2, 0) / 255.0 - mean) / std
+    np.testing.assert_allclose(ds.train_x[0], expected, rtol=1e-5)
+    # without the fixture and with synthetic_fallback=False the loader refuses
+    cfg_missing = tiny_config(dataset="cifar10", data_cache_dir=str(tmp_path / "nope"),
+                              synthetic_fallback=False)
+    with pytest.raises(FileNotFoundError):
+        loader.load(cfg_missing)

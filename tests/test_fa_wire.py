@@ -1,0 +1,84 @@
+"""FA over the wire (VERDICT round-2 item 8): analyzers ride the cross-silo
+comm managers — heavy-hitter e2e over INPROC, parity with the simulator."""
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _fa_cfg(**kw):
+    base = dict(
+        client_num_in_total=4,
+        client_num_per_round=4,
+        comm_round=3,
+        run_id="fa-wire",
+    )
+    base.update(kw)
+    return tiny_config(**base)
+
+
+def _heavy_hitter_data():
+    """4 clients; 'aaa' and 'bbb' are globally frequent strings."""
+    rng = np.random.default_rng(0)
+    common = ["aaa", "bbb"]
+    out = []
+    for c in range(4):
+        words = common * 6 + [f"rare{c}{i}" for i in range(3)]
+        rng.shuffle(words)
+        out.append(np.asarray(words))
+    return out
+
+
+def test_triehh_heavy_hitters_over_inproc(eight_devices):
+    """TrieHH over the real message protocol discovers the global heavy
+    hitters without any client revealing its raw strings."""
+    import fedml_tpu
+    from fedml_tpu.fa.cross_silo import run_fa_process_group
+
+    cfg = _fa_cfg(comm_round=10, run_id="fa-hh")
+    fedml_tpu.init(cfg)
+    data = _heavy_hitter_data()
+    result, server = run_fa_process_group(cfg, "heavy_hitter_triehh", data, timeout=60.0)
+    hh = server.aggregator.heavy_hitters()
+    assert "aaa" in hh and "bbb" in hh, hh
+    assert not any(h.startswith("rare") for h in hh), hh
+
+
+def test_fa_wire_matches_simulator(eight_devices):
+    """The wire protocol computes the same result as the single-process
+    simulator for a deterministic aggregate (frequency counts)."""
+    import fedml_tpu
+    from fedml_tpu.fa.analyzers import create_analyzer_pair
+    from fedml_tpu.fa.cross_silo import run_fa_process_group
+    from fedml_tpu.fa.frame import FASimulator
+
+    cfg = _fa_cfg(comm_round=2, run_id="fa-freq")
+    fedml_tpu.init(cfg)
+    data = [np.asarray([c % 3, (c + 1) % 3, 0]) for c in range(4)]
+    wire_result, _server = run_fa_process_group(cfg, "frequency_estimation", data, timeout=60.0)
+
+    analyzer, aggregator = create_analyzer_pair("frequency_estimation", cfg)
+    sim_result = FASimulator(cfg, data, analyzer, aggregator).run()
+    assert dict(wire_result) == dict(sim_result), (wire_result, sim_result)
+
+
+def test_fa_wire_union_and_sampling(eight_devices):
+    """Per-round client sampling + a set-union aggregate over the wire."""
+    import fedml_tpu
+    from fedml_tpu.fa.cross_silo import run_fa_process_group
+
+    cfg = _fa_cfg(client_num_per_round=2, comm_round=4, run_id="fa-union")
+    fedml_tpu.init(cfg)
+    data = [np.asarray([c, 100 + c]) for c in range(4)]
+    result, _server = run_fa_process_group(cfg, "union", data, timeout=60.0)
+    got = set(int(v) for v in result)
+    # expected union over the deterministic per-round sample (same sampler
+    # the server uses)
+    from fedml_tpu.core import rng as _rng
+
+    expected = set()
+    for r in range(cfg.comm_round):
+        for i in _rng.sample_clients_np(r, 4, 2):
+            expected |= {int(v) for v in data[int(i)]}
+    assert got == expected, (got, expected)
