@@ -32,6 +32,10 @@ class FederatedDataset:
     class_num: int
     test_client_idx: Optional[list] = None  # per-client test split (LEAF-style)
     name: str = ""
+    # segmentation datasets (FeTS2021): per-sample integer masks; train_y
+    # then holds the dominant class for partitioning/eval-by-class
+    masks: Optional[np.ndarray] = None
+    test_masks: Optional[np.ndarray] = None
 
     @property
     def n_clients(self) -> int:

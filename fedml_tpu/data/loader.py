@@ -51,7 +51,22 @@ _DATASET_SPECS = {
     "stackoverflow_lr": ((10000,), 500, 50000, 10000),
     # Lending Club loan-status table (reference VFL finance example)
     "lending_club": ((200,), 2, 50000, 10000),
+    # ImageNet class-per-directory layout (reference data_loader.py:375
+    # ILSVRC2012; real sizes are read from disk, the spec seeds the fallback)
+    "ilsvrc2012": ((224, 224, 3), 1000, 1281167, 50000),
+    # UCI tables (reference data/UCI/data_loader_for_susy_and_ro.py)
+    "susy": ((18,), 2, 100000, 20000),
+    "room_occupancy": ((5,), 2, 8143, 2665),
+    # NUS-WIDE 634-dim low-level features, top-5 single-label selection
+    # (reference data/NUS_WIDE/nus_wide_dataset.py)
+    "nus_wide": ((634,), 5, 60000, 40000),
+    # FeTS2021 tumor-segmentation volumes (reference data/FeTS2021/; masks
+    # ride FederatedDataset.masks for the FedSeg simulator)
+    "fets2021": ((64, 64, 4), 4, 2000, 400),
 }
+
+# name normalization for reference spellings
+_DATASET_ALIASES = {"imagenet": "ilsvrc2012", "ilsvrc-2012": "ilsvrc2012"}
 
 _TEXT_SPECS = {
     # name: (seq len, vocab)
@@ -69,11 +84,15 @@ def dataset_spec(name: str):
     None for text/unknown datasets.  Consumers (model_hub's small-input stem
     selection) must use this, not the private table, so the normalization
     contract lives in one place."""
-    return _DATASET_SPECS.get(name.lower())
+    n = name.lower()
+    return _DATASET_SPECS.get(_DATASET_ALIASES.get(n, n))
 
 
 def load(cfg: Config) -> FederatedDataset:
     name = cfg.dataset.lower()
+    name = _DATASET_ALIASES.get(name, name)
+    if name == "fets2021":
+        return _load_fets(cfg)
     if name in _DATASET_SPECS:
         ds = _load_image_like(cfg, name)
     elif name in _TEXT_SPECS:
@@ -124,6 +143,46 @@ def _load_image_like(cfg: Config, name: str) -> FederatedDataset:
     )
 
 
+def _load_fets(cfg: Config) -> FederatedDataset:
+    """FeTS2021: segmentation volumes + masks.  ``train_y`` carries each
+    sample's dominant tissue class (what the Dirichlet partitioner and the
+    classification-style eval consume); the full masks ride
+    ``FederatedDataset.masks`` for the FedSeg simulator."""
+    from . import extra_loaders
+
+    feat, classes, n_train, n_test = _DATASET_SPECS["fets2021"]
+    cache = Path(os.path.expanduser(cfg.data_cache_dir))
+    try:
+        x, m, tx, tm = extra_loaders.load_fets2021(cache / "FeTS2021")
+    except (FileNotFoundError, OSError):
+        if not cfg.synthetic_fallback:
+            raise FileNotFoundError(
+                f"fets2021_prepared.npz not found under {cache}/FeTS2021 and synthetic_fallback=False"
+            )
+        n_train = cfg.synthetic_train_size or n_train
+        n_test = cfg.synthetic_test_size or n_test
+        x, m, tx, tm = extra_loaders.synthesize_fets_like(
+            n_train, n_test, cfg.random_seed, hw=feat[0], modalities=feat[2], classes=classes
+        )
+
+    def dominant(masks):
+        out = np.zeros(len(masks), np.int32)
+        for i, mk in enumerate(masks):
+            fg = mk[mk > 0]
+            out[i] = np.bincount(fg).argmax() if fg.size else 0
+        return out
+
+    y, ty = dominant(m), dominant(tm)
+    idx_map = part.partition(
+        cfg.partition_method, y, cfg.client_num_in_total, cfg.partition_alpha, cfg.random_seed
+    )
+    return FederatedDataset(
+        train_x=x, train_y=y, test_x=tx, test_y=ty, client_idx=idx_map,
+        class_num=int(max(m.max(), tm.max())) + 1, name="fets2021",
+        masks=m, test_masks=tm,
+    )
+
+
 def _try_load_real(name: str, cache: Path):
     try:
         if name == "cifar10":
@@ -138,7 +197,28 @@ def _try_load_real(name: str, cache: Path):
             d = cache / name.upper() / "raw" if (cache / name.upper()).is_dir() else cache / name
             if (d / "train-images-idx3-ubyte").exists():
                 return _load_idx(d)
+        from . import extra_loaders
+
+        if name == "ilsvrc2012":
+            for sub in ("ILSVRC2012", "imagenet", "."):
+                root = cache / sub
+                if (root / "train").is_dir():
+                    tx_, ty_, vx_, vy_, _classes = extra_loaders.load_image_folder(root)
+                    return tx_, ty_, vx_, vy_
+        if name == "susy" and (cache / "SUSY" / "SUSY.csv").exists():
+            return extra_loaders.load_susy(cache / "SUSY")
+        if name == "room_occupancy" and (cache / "room_occupancy" / "datatraining.txt").exists():
+            return extra_loaders.load_room_occupancy(cache / "room_occupancy")
+        if name == "nus_wide" and (cache / "NUS_WIDE").is_dir():
+            return extra_loaders.load_nus_wide(cache / "NUS_WIDE")
     except Exception:
+        # a present-but-unreadable real dataset must be LOUD: silently
+        # flipping to the synthetic stand-in would let a run proceed on fake
+        # data while the user believes the real files were loaded
+        log.exception(
+            "real dataset %r found under %s but failed to load — falling "
+            "back to the synthetic stand-in", name, cache,
+        )
         return None
     return None
 
@@ -218,7 +298,16 @@ def _synthetic_hard(feat, classes, n_train, n_test, seed, modes_per_class: int =
     rng = np.random.RandomState(0x5EED ^ (seed * 2654435761 % (2**31)))
     d = int(np.prod(feat))
     n_clusters = classes * modes_per_class
-    centers = rng.normal(0, center_scale, size=(n_clusters, d)).astype(np.float32)
+    if len(feat) == 3 and feat[0] % 4 == 0 and feat[1] % 4 == 0:
+        # image shapes: LOW-FREQUENCY centers (low-res noise upsampled 4x) so
+        # the class signal is spatially structured — convolutional models can
+        # pool it out of the per-pixel noise, as with natural images (iid
+        # per-pixel centers would make conv inductive bias useless)
+        low = rng.normal(0, center_scale,
+                         size=(n_clusters, feat[0] // 4, feat[1] // 4, feat[2]))
+        centers = np.kron(low, np.ones((1, 4, 4, 1))).reshape(n_clusters, d).astype(np.float32)
+    else:
+        centers = rng.normal(0, center_scale, size=(n_clusters, d)).astype(np.float32)
     cluster_class = (np.arange(n_clusters) % classes).astype(np.int32)
 
     def gen(n):

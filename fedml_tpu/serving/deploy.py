@@ -112,6 +112,15 @@ class EndpointDB:
                 "status TEXT, restarts INTEGER DEFAULT 0, "
                 "PRIMARY KEY (endpoint, idx))"
             )
+            # per-endpoint request telemetry — the signals the autoscaler
+            # acts on, persisted every reconcile sweep so operators can see
+            # WHY a scale decision happened (reference stores request stats
+            # in its device DB for the autoscaler the same way)
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS request_stats ("
+                "endpoint TEXT PRIMARY KEY, requests INTEGER, qps REAL, "
+                "latency_ms_ewm REAL, inflight INTEGER, updated REAL)"
+            )
 
     def _conn(self):
         return sqlite3.connect(self.path)
@@ -157,6 +166,27 @@ class EndpointDB:
     def delete_replica(self, endpoint: str, idx: int) -> None:
         with self._conn() as c:
             c.execute("DELETE FROM replicas WHERE endpoint=? AND idx=?", (endpoint, idx))
+
+    def upsert_stats(self, endpoint: str, requests: int, qps: float,
+                     latency_ms_ewm: Optional[float], inflight: int) -> None:
+        with self._conn() as c:
+            c.execute(
+                "INSERT INTO request_stats VALUES (?,?,?,?,?,?) "
+                "ON CONFLICT(endpoint) DO UPDATE SET requests=excluded.requests, "
+                "qps=excluded.qps, latency_ms_ewm=excluded.latency_ms_ewm, "
+                "inflight=excluded.inflight, updated=excluded.updated",
+                (endpoint, requests, qps, latency_ms_ewm, inflight, time.time()),
+            )
+
+    def stats(self, endpoint: str) -> Optional[dict]:
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT endpoint, requests, qps, latency_ms_ewm, inflight, updated "
+                "FROM request_stats WHERE endpoint=?", (endpoint,)
+            ).fetchone()
+        if row is None:
+            return None
+        return dict(zip(("endpoint", "requests", "qps", "latency_ms_ewm", "inflight", "updated"), row))
 
 
 # ---------------------------------------------------------------------------
@@ -265,6 +295,7 @@ class Endpoint:
         self.ports: dict[int, int] = {}
         self.request_count = 0
         self.inflight = 0
+        self.latency_ms_ewm: Optional[float] = None
         self._last_rate_t = time.time()
         self._last_rate_n = 0
         # guards procs/ports: the reconcile thread mutates them while predict/
@@ -281,6 +312,14 @@ class Endpoint:
         self._last_rate_t = now
         self._last_rate_n = self.request_count
         return rate
+
+    def record_latency(self, seconds: float, alpha: float = 0.3) -> None:
+        ms = seconds * 1000.0
+        with self.lock:
+            self.latency_ms_ewm = (
+                ms if self.latency_ms_ewm is None
+                else alpha * ms + (1 - alpha) * self.latency_ms_ewm
+            )
 
     def ready_ports(self) -> list[int]:
         # snapshot under the lock, probe outside it (probes do HTTP)
@@ -380,10 +419,13 @@ class ModelDeployScheduler:
     def _reconcile_endpoint(self, ep: Endpoint) -> None:
         if ep.closed:
             return
-        # autoscaling first: it updates desired before the diff
+        # autoscaling first: it updates desired before the diff; the same
+        # measured signals are persisted so operators can audit the decision
+        qps = ep.qps()
+        self.db.upsert_stats(ep.name, ep.request_count, qps, ep.latency_ms_ewm, ep.inflight)
         if ep.autoscaler is not None:
             ep.desired = ep.autoscaler.desired(
-                current=max(len(ep.procs), 1), qps=ep.qps(), concurrency=ep.inflight,
+                current=max(len(ep.procs), 1), qps=qps, concurrency=ep.inflight,
             )
         # restart dead replicas (the monitor role)
         with ep.lock:
@@ -445,28 +487,70 @@ class ModelDeployScheduler:
             time.sleep(0.2)
         return False
 
-    def predict(self, endpoint_name: str, request: dict, timeout: float = 30.0) -> dict:
-        """Round-robin over ready replicas with failover (the gateway)."""
+    def _gateway_attempts(self, endpoint_name: str, request: dict):
+        """Shared gateway preamble: counts the request and yields round-robin
+        (endpoint, urllib Request) attempts over the ready replicas."""
         ep = self.endpoints[endpoint_name]
         ports = ep.ready_ports()
         if not ports:
             raise RuntimeError(f"endpoint {endpoint_name!r} has no ready replicas")
         ep.request_count += 1
         start = ep.request_count
-        last_err: Optional[Exception] = None
+        body = json.dumps(request).encode()
         for i in range(len(ports)):
             port = ports[(start + i) % len(ports)]
+            yield ep, urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+
+    def predict(self, endpoint_name: str, request: dict, timeout: float = 30.0) -> dict:
+        """Round-robin over ready replicas with failover (the gateway).
+        Records request latency into the endpoint's EWM (the autoscaler's
+        persisted signal)."""
+        last_err: Optional[Exception] = None
+        for ep, req in self._gateway_attempts(endpoint_name, request):
+            t0 = time.time()
             try:
                 ep.inflight += 1
-                req = urllib.request.Request(
-                    f"http://127.0.0.1:{port}/predict",
-                    data=json.dumps(request).encode(),
-                    headers={"Content-Type": "application/json"},
-                )
                 with urllib.request.urlopen(req, timeout=timeout) as r:
-                    return json.loads(r.read())
+                    out = json.loads(r.read())
+                ep.record_latency(time.time() - t0)
+                return out
             except Exception as e:  # failover to the next replica
                 last_err = e
             finally:
                 ep.inflight -= 1
+        raise RuntimeError(f"all replicas of {endpoint_name!r} failed: {last_err}")
+
+    def predict_stream(self, endpoint_name: str, request: dict, timeout: float = 30.0):
+        """Streaming gateway: forwards ``stream=True`` to a ready replica and
+        yields the newline-delimited JSON chunks as they arrive.  Failover
+        applies only before the first chunk (a partially-consumed stream
+        cannot be replayed)."""
+        last_err: Optional[Exception] = None
+        body = dict(request)
+        body["stream"] = True
+        for ep, req in self._gateway_attempts(endpoint_name, body):
+            t0 = time.time()
+            try:
+                resp = urllib.request.urlopen(req, timeout=timeout)
+            except Exception as e:
+                last_err = e
+                continue
+
+            def gen(ep=ep, resp=resp, t0=t0):
+                ep.inflight += 1
+                try:
+                    with resp:
+                        for line in resp:
+                            line = line.strip()
+                            if line:
+                                yield json.loads(line)
+                finally:
+                    ep.inflight -= 1
+                    ep.record_latency(time.time() - t0)
+
+            return gen()
         raise RuntimeError(f"all replicas of {endpoint_name!r} failed: {last_err}")

@@ -28,6 +28,13 @@ class FedMLPredictor:
     def predict(self, request: dict) -> Any:
         raise NotImplementedError
 
+    def predict_stream(self, request: dict):
+        """Streaming response: an iterator of JSON-serializable chunks
+        (reference ``fedml_inference_runner.py:20-27`` wraps the predictor's
+        generator in a ``StreamingResponse`` when the request sets
+        ``stream``).  Default: one chunk, the plain prediction."""
+        yield self.predict(request)
+
     def ready(self) -> bool:
         return True
 
@@ -60,6 +67,13 @@ class JaxPredictor(FedMLPredictor):
         logits = self._apply(self.variables, self._jnp.asarray(x))
         return {"outputs": np.asarray(logits)[:n].tolist()}
 
+    def predict_stream(self, request: dict):
+        """One chunk per input row — the batched compute runs once, rows
+        stream out as they are sliced (LLM predictors yield tokens here)."""
+        out = self.predict(request)["outputs"]
+        for i, row in enumerate(out):
+            yield {"index": i, "outputs": row}
+
 
 class FedMLInferenceRunner:
     """HTTP runner (``fedml_inference_runner.py``): POST /predict, GET /ready."""
@@ -75,6 +89,11 @@ class FedMLInferenceRunner:
         predictor = self.predictor
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked transfer is an HTTP/1.1 feature; the default HTTP/1.0
+            # status line would make spec-compliant clients deliver the raw
+            # chunk framing as body content
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet
                 pass
 
@@ -102,10 +121,52 @@ class FedMLInferenceRunner:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     request = json.loads(self.rfile.read(length).decode())
+                    if request.get("stream", False):
+                        self._stream(predictor.predict_stream(request))
+                        return
                     result = predictor.predict(request)
                     self._json(200, result)
                 except Exception as e:  # surface the error to the caller
                     self._json(400, {"error": f"{type(e).__name__}: {e}"})
+
+            def _stream(self, chunks) -> None:
+                """Chunked transfer of newline-delimited JSON — the stdlib
+                equivalent of the reference's StreamingResponse
+                (``fedml_inference_runner.py:28``).  The first chunk is
+                materialized BEFORE the headers go out so an immediately-
+                failing predictor still produces a clean 400 (mid-stream
+                failures can only truncate the chunked body — inherent to
+                streaming)."""
+                it = iter(chunks)
+                try:
+                    first = next(it)
+                except StopIteration:
+                    first = None
+                    it = iter(())
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def put(chunk) -> None:
+                    line = (json.dumps(chunk) + "\n").encode()
+                    self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    if first is not None:
+                        put(first)
+                    for chunk in it:
+                        put(chunk)
+                except Exception:
+                    # headers are gone: a 400 written here would inject an
+                    # HTTP status line into the chunked body (clients would
+                    # read it as data or silent truncation).  Drop the
+                    # connection WITHOUT the terminal 0-chunk so the client
+                    # sees an aborted — not cleanly finished — stream.
+                    self.close_connection = True
+                    return
+                self.wfile.write(b"0\r\n\r\n")
 
         return Handler
 

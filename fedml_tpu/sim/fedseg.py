@@ -59,7 +59,12 @@ class FedSegSimulator:
         self.round_idx = 0
         self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
 
-        masks = synthesize_masks(dataset.train_x, dataset.train_y, self.num_classes, cfg.random_seed)
+        # real segmentation datasets (FeTS2021) carry their masks; others get
+        # the deterministic synthesized quadrant masks
+        if getattr(dataset, "masks", None) is not None:
+            masks = np.asarray(dataset.masks, np.int32)
+        else:
+            masks = synthesize_masks(dataset.train_x, dataset.train_y, self.num_classes, cfg.random_seed)
         counts = np.array([len(ix) for ix in dataset.client_idx])
         cap = int(((counts.max() + cfg.batch_size - 1) // cfg.batch_size) * cfg.batch_size)
         xs = np.zeros((dataset.n_clients, cap) + feat, np.float32)
@@ -71,7 +76,10 @@ class FedSegSimulator:
         self.counts = jnp.asarray(counts, jnp.float32)
         self._client_fn = jax.jit(jax.vmap(self._local_train, in_axes=(None, 0, 0, 0)))
 
-        tmask = synthesize_masks(dataset.test_x[:256], dataset.test_y[:256], self.num_classes, cfg.random_seed)
+        if getattr(dataset, "test_masks", None) is not None:
+            tmask = np.asarray(dataset.test_masks[:256], np.int32)
+        else:
+            tmask = synthesize_masks(dataset.test_x[:256], dataset.test_y[:256], self.num_classes, cfg.random_seed)
         self._test = (jnp.asarray(dataset.test_x[:256], jnp.float32), jnp.asarray(tmask))
         self._eval = jax.jit(self._eval_fn)
 

@@ -16,11 +16,15 @@ reference's ``ClientTrainer.update_dataset`` poisoning hook
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger("fedml_tpu.trust.attack")
 
 
 def malicious_mask(m: int, sampled_idx: jax.Array, attacker_ids: Sequence[int]) -> jax.Array:
@@ -104,27 +108,51 @@ def backdoor_pixel_pattern(x: np.ndarray, client_idx: list, poisoned_clients: Se
 
 def edge_case_backdoor(x: np.ndarray, client_idx: list, poisoned_clients: Sequence[int],
                        target_class: int, labels: np.ndarray, frac: float = 0.2,
-                       seed: int = 0):
+                       seed: int = 0, edge_examples: np.ndarray = None):
     """Edge-case backdoor (reference ``backdoor_attack.py`` edge-case mode,
     Wang et al. NeurIPS'20): poison with inputs from the TAIL of the data
     distribution — rare-looking samples a pixel trigger doesn't need — all
-    relabeled to the target.  The reference injects curated natural edge
-    sets (e.g. Southwest airplanes into CIFAR); the dataset-agnostic stand-in
-    here synthesizes tail samples by pushing real samples far along their
-    deviation from the dataset mean (out-of-distribution but structured,
-    unlike uniform noise).  Returns (x', labels')."""
+    relabeled to the target.
+
+    ``edge_examples``: the CANONICAL curated edge sets (Southwest airplanes
+    / ARDIS digits, ``data/edge_case_examples/data_loader.py:460``) when the
+    downloaded files are on disk — poisoned slots are replaced by these
+    natural edge images.  Without them the dataset-agnostic stand-in
+    synthesizes tail samples by pushing real samples far along their
+    deviation from the dataset mean.  Returns (x', labels')."""
     x = x.copy()
     labels = labels.copy()
     rng = np.random.RandomState(seed)
     mean = x.mean(axis=0, keepdims=True)
     scale = 3.0  # how far into the tail the samples are pushed
+    if edge_examples is not None and edge_examples.shape[1:] != x.shape[1:]:
+        log.warning(
+            "edge-case set shape %s != dataset shape %s; falling back to "
+            "synthesized tail samples", edge_examples.shape[1:], x.shape[1:],
+        )
+        edge_examples = None
+    if edge_examples is not None:
+        # match the DESTINATION distribution's scale: the dataset may be
+        # normalized ((x/255-mean)/std for real CIFAR) while the curated
+        # sets are raw [0,1] — the reference applies the dataset transform
+        # to its edge sets; the dataset-agnostic equivalent is moment
+        # matching per channel
+        ax = tuple(range(x.ndim - 1))
+        e = edge_examples.astype(np.float32)
+        e_m, e_s = e.mean(axis=ax), e.std(axis=ax) + 1e-8
+        x_m, x_s = x.mean(axis=ax), x.std(axis=ax) + 1e-8
+        edge_examples = (e - e_m) / e_s * x_s + x_m
     for c in poisoned_clients:
         ix = client_idx[c]
         n_poison = int(len(ix) * frac)
         if n_poison == 0:
             continue
         sel = rng.choice(ix, size=n_poison, replace=False)
-        x[sel] = mean + scale * (x[sel] - mean)  # amplified deviation = tail
+        if edge_examples is not None:
+            pick = rng.randint(0, len(edge_examples), size=n_poison)
+            x[sel] = edge_examples[pick]
+        else:
+            x[sel] = mean + scale * (x[sel] - mean)  # amplified deviation = tail
         labels[sel] = target_class
     return x, labels
 
@@ -183,9 +211,20 @@ class FedMLAttacker:
             )
             return dataclasses.replace(ds, train_x=new_x, train_y=new_y)
         if self.attack_type == "edge_case_backdoor":
+            # use the canonical downloaded edge sets when present on disk
+            from pathlib import Path
+
+            from ...data.extra_loaders import load_edge_case_sets
+
+            extra = getattr(self.cfg, "extra", {}) or {}
+            sets = load_edge_case_sets(
+                Path(os.path.expanduser(getattr(self.cfg, "data_cache_dir", "") or ".")),
+                str(extra.get("edge_case_type", "southwest")),
+            )
             new_x, new_y = edge_case_backdoor(
                 ds.train_x, ds.client_idx, self.attackers,
                 self.target_class, ds.train_y, frac=self.poison_frac,
+                edge_examples=None if sets is None else sets[0],
             )
             return dataclasses.replace(ds, train_x=new_x, train_y=new_y)
         return ds

@@ -1,0 +1,184 @@
+"""Dataset breadth tail (VERDICT round-2 missing #8): ImageNet folder
+reader, UCI tables, NUS-WIDE, FeTS2021 masks, and the canonical edge-case
+poisoned sets — each exercised end-to-end from generated fixtures."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def test_image_folder_reader(tmp_path):
+    from fedml_tpu.data import loader
+
+    rng = np.random.RandomState(0)
+    root = tmp_path / "ILSVRC2012"
+    for split, n in (("train", 3), ("val", 2)):
+        for cls in ("dog", "cat"):
+            d = root / split / cls
+            d.mkdir(parents=True)
+            for i in range(n):
+                np.save(d / f"{i}.npy", rng.rand(8, 8, 3).astype(np.float32))
+    cfg = tiny_config(dataset="ILSVRC2012", data_cache_dir=str(tmp_path),
+                      synthetic_fallback=False, client_num_in_total=2,
+                      client_num_per_round=2, partition_method="homo")
+    ds = loader.load(cfg)
+    assert ds.train_x.shape == (6, 8, 8, 3)
+    assert ds.test_x.shape == (4, 8, 8, 3)
+    assert set(np.unique(ds.train_y)) == {0, 1}  # cat=0, dog=1 (sorted)
+
+
+def test_susy_and_room_occupancy_readers(tmp_path):
+    from fedml_tpu.data import loader
+
+    d = tmp_path / "SUSY"
+    d.mkdir()
+    rng = np.random.RandomState(1)
+    rows = []
+    for i in range(50):
+        rows.append(",".join([str(i % 2)] + [f"{v:.4f}" for v in rng.rand(18)]))
+    (d / "SUSY.csv").write_text("\n".join(rows) + "\n")
+    cfg = tiny_config(dataset="susy", data_cache_dir=str(tmp_path),
+                      synthetic_fallback=False, client_num_in_total=2,
+                      client_num_per_round=2, partition_method="homo")
+    ds = loader.load(cfg)
+    assert ds.train_x.shape == (40, 18) and ds.test_x.shape == (10, 18)
+    assert set(np.unique(ds.train_y)) <= {0, 1}
+
+    ro = tmp_path / "room_occupancy"
+    ro.mkdir()
+    header = '"id","date","Temperature","Humidity","Light","CO2","HumidityRatio","Occupancy"'
+    for fname, n in (("datatraining.txt", 30), ("datatest.txt", 10)):
+        lines = [header]
+        for i in range(n):
+            lines.append(f'"{i}","2015-02-04",{20+i%3},{27.2},{420+i},{700+i},{0.004},{i%2}')
+        (ro / fname).write_text("\n".join(lines) + "\n")
+    cfg2 = tiny_config(dataset="room_occupancy", data_cache_dir=str(tmp_path),
+                       synthetic_fallback=False, client_num_in_total=2,
+                       client_num_per_round=2, partition_method="homo")
+    ds2 = loader.load(cfg2)
+    assert ds2.train_x.shape == (30, 5) and ds2.test_x.shape == (10, 5)
+    assert set(np.unique(ds2.train_y)) == {0, 1}
+
+
+def test_nus_wide_prepared_npz(tmp_path):
+    from fedml_tpu.data import loader
+
+    d = tmp_path / "NUS_WIDE"
+    d.mkdir()
+    rng = np.random.RandomState(2)
+    np.savez(d / "nus_wide_prepared.npz",
+             train_x=rng.rand(40, 634).astype(np.float32),
+             train_y=rng.randint(0, 5, 40).astype(np.int32),
+             test_x=rng.rand(10, 634).astype(np.float32),
+             test_y=rng.randint(0, 5, 10).astype(np.int32))
+    cfg = tiny_config(dataset="nus_wide", data_cache_dir=str(tmp_path),
+                      synthetic_fallback=False, client_num_in_total=2,
+                      client_num_per_round=2, partition_method="homo")
+    ds = loader.load(cfg)
+    assert ds.train_x.shape == (40, 634) and ds.class_num == 5
+
+
+def test_fets2021_masks_flow_to_fedseg(tmp_path):
+    """FeTS volumes: masks ride FederatedDataset.masks; train_y is the
+    dominant tissue class; FedSeg consumes the REAL masks."""
+    from fedml_tpu.data import loader
+
+    d = tmp_path / "FeTS2021"
+    d.mkdir()
+    rng = np.random.RandomState(3)
+    m = np.zeros((12, 16, 16), np.int32)
+    m[:, 4:8, 4:8] = (np.arange(12) % 3 + 1)[:, None, None]
+    np.savez(d / "fets2021_prepared.npz",
+             train_x=rng.rand(12, 16, 16, 4).astype(np.float32), train_m=m,
+             test_x=rng.rand(4, 16, 16, 4).astype(np.float32), test_m=m[:4])
+    cfg = tiny_config(dataset="fets2021", data_cache_dir=str(tmp_path),
+                      synthetic_fallback=False, client_num_in_total=2,
+                      client_num_per_round=2, partition_method="homo")
+    ds = loader.load(cfg)
+    assert ds.masks is not None and ds.masks.shape == (12, 16, 16)
+    np.testing.assert_array_equal(ds.train_y, np.arange(12) % 3 + 1)
+
+    from fedml_tpu.sim.fedseg import FedSegSimulator
+
+    sim = FedSegSimulator(tiny_config(dataset="fets2021", client_num_in_total=2,
+                                      client_num_per_round=2, comm_round=1,
+                                      batch_size=4), ds)
+    # the simulator's stacked masks are the REAL masks, not synthesized
+    # quadrants: client 0's first slot equals its first real mask
+    first_ix = int(ds.client_idx[0][0])
+    np.testing.assert_array_equal(np.asarray(sim._m[0, 0]), m[first_ix])
+    np.testing.assert_array_equal(np.asarray(sim._test[1][0]), m[0])
+
+
+def test_fets2021_synthetic_fallback(eight_devices):
+    from fedml_tpu.data import loader
+
+    cfg = tiny_config(dataset="fets2021", synthetic_train_size=24,
+                      synthetic_test_size=8, client_num_in_total=2,
+                      client_num_per_round=2, partition_method="homo")
+    ds = loader.load(cfg)
+    assert ds.train_x.shape == (24, 64, 64, 4)
+    assert ds.masks.shape == (24, 64, 64)
+    assert ds.masks.max() >= 1  # lesions present
+
+
+def test_edge_case_backdoor_consumes_canonical_sets(tmp_path):
+    """With the Southwest pickles on disk, poisoned slots are the canonical
+    edge images relabeled to the target class (reference
+    edge_case_examples/data_loader.py:460)."""
+    from fedml_tpu.data import loader
+    from fedml_tpu.trust.attack.attacks import FedMLAttacker
+
+    d = tmp_path / "edge_case_examples" / "southwest_cifar10"
+    d.mkdir(parents=True)
+    rng = np.random.RandomState(4)
+    edge = (rng.rand(5, 32, 32, 3) * 255).astype(np.uint8)
+    with open(d / "southwest_images_new_train.pkl", "wb") as f:
+        pickle.dump(edge, f)
+    with open(d / "southwest_images_new_test.pkl", "wb") as f:
+        pickle.dump(edge[:2], f)
+
+    cfg = tiny_config(dataset="cifar10", data_cache_dir=str(tmp_path),
+                      synthetic_train_size=64, synthetic_test_size=16,
+                      client_num_in_total=2, client_num_per_round=2,
+                      enable_attack=True, attack_type="edge_case_backdoor",
+                      poisoned_client_list=(0,),
+                      extra={"attack_target_class": 7, "attack_poison_frac": 0.5})
+    ds = loader.load(cfg)
+    poisoned = FedMLAttacker(cfg).poison_data(ds)
+    # the canonical images are moment-matched to the destination
+    # distribution (the reference applies the dataset transform the same way)
+    e = edge.astype(np.float32) / 255.0
+    ax = (0, 1, 2)
+    x = ds.train_x
+    expected_imgs = (e - e.mean(axis=ax)) / (e.std(axis=ax) + 1e-8) \
+        * (x.std(axis=ax) + 1e-8) + x.mean(axis=ax)
+    hits = 0
+    for i in range(poisoned.train_x.shape[0]):
+        diffs = np.abs(expected_imgs - poisoned.train_x[i]).reshape(5, -1).max(axis=1)
+        if diffs.min() < 1e-4:
+            hits += 1
+            assert poisoned.train_y[i] == 7
+    expected = int(len(ds.client_idx[0]) * 0.5)
+    assert hits == expected, (hits, expected)
+    # scale sanity: poison lives in the same per-channel moment range
+    assert abs(expected_imgs.mean() - x.mean()) < 0.5
+
+
+def test_edge_case_backdoor_falls_back_without_sets(eight_devices):
+    from fedml_tpu.data import loader
+    from fedml_tpu.trust.attack.attacks import FedMLAttacker
+
+    cfg = tiny_config(dataset="cifar10", synthetic_train_size=64,
+                      synthetic_test_size=16, client_num_in_total=2,
+                      client_num_per_round=2, enable_attack=True,
+                      attack_type="edge_case_backdoor", poisoned_client_list=(0,),
+                      extra={"attack_target_class": 3, "attack_poison_frac": 0.5})
+    ds = loader.load(cfg)
+    poisoned = FedMLAttacker(cfg).poison_data(ds)
+    changed = np.abs(poisoned.train_x - ds.train_x).reshape(len(ds.train_x), -1).max(axis=1) > 1e-6
+    assert changed.sum() == int(len(ds.client_idx[0]) * 0.5)
+    assert (poisoned.train_y[changed] == 3).all()
