@@ -44,7 +44,7 @@ def test_deploy_predict_and_kill_recovery(tmp_path, lr_card):
     try:
         ep = sched.deploy("demo", "lr-demo", replicas=1)
         sched.run_in_thread()
-        assert sched.wait_ready("demo", replicas=1, timeout=60)
+        assert sched.wait_ready("demo", replicas=1, timeout=180)
         out = sched.predict("demo", {"inputs": np.zeros((2, 32)).tolist()})
         assert len(out["outputs"]) == 2 and len(out["outputs"][0]) == 10
 
@@ -52,7 +52,7 @@ def test_deploy_predict_and_kill_recovery(tmp_path, lr_card):
         victim = ep.procs[0]
         victim.kill()
         victim.wait(timeout=10)
-        assert sched.wait_ready("demo", replicas=1, timeout=60), "monitor did not restart replica"
+        assert sched.wait_ready("demo", replicas=1, timeout=180), "monitor did not restart replica"
         assert ep.procs[0].pid != victim.pid
         out2 = sched.predict("demo", {"inputs": np.zeros((1, 32)).tolist()})
         assert len(out2["outputs"]) == 1
@@ -67,9 +67,9 @@ def test_scale_up_down(tmp_path, lr_card):
     sched.cards.register(lr_card)
     try:
         sched.deploy("demo", "lr-demo", replicas=1)
-        assert sched.wait_ready("demo", replicas=1, timeout=60)
+        assert sched.wait_ready("demo", replicas=1, timeout=180)
         sched.scale("demo", 2)
-        assert sched.wait_ready("demo", replicas=2, timeout=60)
+        assert sched.wait_ready("demo", replicas=2, timeout=180)
         assert len(sched.db.replicas("demo")) == 2
         sched.scale("demo", 1)
         sched.reconcile_once()
@@ -83,7 +83,7 @@ def test_undeploy_stops_processes(tmp_path, lr_card):
     sched = _scheduler(tmp_path)
     sched.cards.register(lr_card)
     ep = sched.deploy("demo", "lr-demo", replicas=1)
-    assert sched.wait_ready("demo", timeout=60)
+    assert sched.wait_ready("demo", timeout=180)
     proc = ep.procs[0]
     sched.undeploy("demo")
     assert proc.poll() is not None  # process stopped

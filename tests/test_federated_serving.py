@@ -43,7 +43,7 @@ def test_federated_serving_train_then_deploy(tmp_path, eight_devices):
         history, card = server.run(timeout=120.0, artifact_dir=str(tmp_path))
         assert len(history) == 2
         assert card is not None and card.name == "fl-lr"
-        assert sched.wait_ready("ep-demo", timeout=60)
+        assert sched.wait_ready("ep-demo", timeout=180)
         feat = int(ds.train_x.shape[1])
         out = sched.predict("ep-demo", {"inputs": np.zeros((1, feat)).tolist()})
         assert len(out["outputs"][0]) == ds.class_num

@@ -130,7 +130,7 @@ def test_gateway_stream_and_persisted_stats(tmp_path):
     try:
         sched.deploy("demo", "lr-s", replicas=1)
         sched.run_in_thread()
-        assert sched.wait_ready("demo", replicas=1, timeout=60)
+        assert sched.wait_ready("demo", replicas=1, timeout=180)
 
         chunks = list(sched.predict_stream("demo", {"inputs": np.zeros((3, 32)).tolist()}))
         assert [c["index"] for c in chunks] == [0, 1, 2]

@@ -1,0 +1,244 @@
+"""web3_real.py Ledger adapters driven by scripted fakes (the pattern of
+test_mqtt_real.py): every branch of the web3 contract adapter and the Theta
+EdgeStore adapter runs hermetically, including an end-to-end FL message
+exchange through BlockchainCommManager."""
+
+import base64
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# fake web3 module (contract mailbox semantics in memory)
+# ---------------------------------------------------------------------------
+
+class _FakeFn:
+    def __init__(self, chain, name, args):
+        self.chain, self.name, self.args = chain, name, args
+
+    def call(self):
+        assert self.name == "getMessages"
+        return self.chain.messages[self.args[0]:]
+
+    def transact(self, tx):
+        assert self.name == "sendMessage"
+        self.chain.transactions.append(("unlocked", tx["from"]))
+        self.chain.messages.append((self.chain.pending_sender, *self.args))
+        return f"0xhash{len(self.chain.messages)}"
+
+    def build_transaction(self, tx):
+        return {"fn": self, "tx": tx}
+
+
+class _FakeFunctions:
+    def __init__(self, chain):
+        self.chain = chain
+
+    def sendMessage(self, recipient, data):
+        return _FakeFn(self.chain, "sendMessage", (recipient, data))
+
+    def getMessages(self, from_index):
+        return _FakeFn(self.chain, "getMessages", (from_index,))
+
+
+class _FakeChainState:
+    def __init__(self):
+        self.messages = []  # (sender, recipient, data)
+        self.transactions = []
+        self.pending_sender = 0
+        self.nonces = {}
+
+
+class _FakeEth:
+    def __init__(self, chain):
+        self.chain = chain
+        self.account = self
+
+    def contract(self, address, abi):
+        class C:
+            functions = _FakeFunctions(self.chain)
+        return C()
+
+    def get_transaction_count(self, account):
+        return self.chain.nonces.get(account, 0)
+
+    def sign_transaction(self, tx, key):
+        class S:
+            raw_transaction = ("signed", tx, key)
+        return S()
+
+    def send_raw_transaction(self, raw):
+        _tag, built, _key = raw
+        fn = built["fn"]
+        self.chain.transactions.append(("signed", built["tx"]["from"]))
+        self.chain.messages.append((self.chain.pending_sender, *fn.args))
+        return f"0xhash{len(self.chain.messages)}"
+
+    def wait_for_transaction_receipt(self, tx_hash):
+        return {"status": 1, "hash": tx_hash}
+
+
+class FakeWeb3Module:
+    last = None
+
+    class Web3:
+        def __init__(self, provider):
+            self.provider = provider
+            self.chain = FakeWeb3Module.last = FakeWeb3Module.last or _FakeChainState()
+            self.eth = _FakeEth(self.chain)
+
+        @staticmethod
+        def HTTPProvider(url):
+            return ("http", url)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chain():
+    FakeWeb3Module.last = None
+    yield
+    FakeWeb3Module.last = None
+
+
+def test_web3_ledger_append_and_read_unlocked():
+    from fedml_tpu.comm.web3_real import Web3ContractLedger
+
+    led = Web3ContractLedger("http://node", "0xABC", account="0xme",
+                             web3_module=FakeWeb3Module)
+    h0 = led.append_tx(1, 2, "payloadA")
+    h1 = led.append_tx(1, 3, "payloadB")
+    assert (h0, h1) == (0, 1)
+    rows = led.read_since(0)
+    assert [(r["recipient"], r["data"]) for r in rows] == [(2, "payloadA"), (3, "payloadB")]
+    assert led.read_since(1)[0]["data"] == "payloadB"
+    # unlocked-account path used (no key given)
+    assert FakeWeb3Module.last.transactions[0][0] == "unlocked"
+
+
+def test_web3_ledger_signed_path():
+    from fedml_tpu.comm.web3_real import Web3ContractLedger
+
+    led = Web3ContractLedger("http://node", "0xABC", account="0xme",
+                             private_key="0xkey", web3_module=FakeWeb3Module)
+    led.append_tx(1, 2, "x")
+    assert FakeWeb3Module.last.transactions[0][0] == "signed"
+
+
+def test_web3_import_error_without_module(monkeypatch):
+    import fedml_tpu.comm.web3_real as wr
+
+    monkeypatch.setattr(wr, "_web3", None)
+    with pytest.raises(ImportError):
+        wr.Web3ContractLedger("http://node", "0xABC", account="0xme")
+
+
+# ---------------------------------------------------------------------------
+# Theta EdgeStore adapter
+# ---------------------------------------------------------------------------
+
+class FakeEdgeStore:
+    def __init__(self):
+        self.blobs = {}
+
+    def put(self, key, data):
+        self.blobs[key] = data
+        return key
+
+    def get(self, key):
+        return self.blobs[key]
+
+
+def test_theta_ledger_roundtrip():
+    from fedml_tpu.comm.web3_real import ThetaEdgeStoreLedger
+
+    store = FakeEdgeStore()
+    led = ThetaEdgeStoreLedger("run7", http_client=store)
+    assert led.append_tx(1, 2, "aaa") == 0
+    assert led.append_tx(2, 1, "bbb") == 1
+    rows = led.read_since(0)
+    assert [(r["sender"], r["recipient"], r["data"]) for r in rows] == [
+        (1, 2, "aaa"), (2, 1, "bbb"),
+    ]
+    assert led.read_since(1)[0]["data"] == "bbb"
+    # payload blobs (unique keys) and the index live in the store
+    assert sum("/tx-" in k for k in store.blobs) == 2
+
+
+def test_web3_reverted_tx_raises():
+    from fedml_tpu.comm.web3_real import Web3ContractLedger
+
+    led = Web3ContractLedger("http://node", "0xABC", account="0xme",
+                             web3_module=FakeWeb3Module)
+    orig = _FakeEth.wait_for_transaction_receipt
+    _FakeEth.wait_for_transaction_receipt = lambda self, h: {"status": 0, "hash": h}
+    try:
+        with pytest.raises(RuntimeError, match="reverted"):
+            led.append_tx(1, 2, "x")
+    finally:
+        _FakeEth.wait_for_transaction_receipt = orig
+
+
+def test_theta_append_retries_on_clobbered_index():
+    """A racing writer overwrites the index between our write and re-read:
+    the optimistic retry re-merges until our entry survives; unique blob
+    keys mean no payload is ever clobbered."""
+    from fedml_tpu.comm.web3_real import ThetaEdgeStoreLedger
+
+    class RacyStore(FakeEdgeStore):
+        def __init__(self):
+            super().__init__()
+            self.race_once = True
+
+        def put(self, key, data):
+            out = super().put(key, data)
+            if key.endswith("ledger_index") and self.race_once:
+                # simulate a concurrent writer clobbering our index write
+                self.race_once = False
+                import json as _json
+
+                self.blobs[key] = _json.dumps([
+                    {"height": 0, "sender": 9, "recipient": 9, "key": "other/tx"}
+                ]).encode()
+                self.blobs["other/tx"] = b"zzz"
+            return out
+
+    store = RacyStore()
+    led = ThetaEdgeStoreLedger("runR", http_client=store)
+    h = led.append_tx(1, 2, "mine")
+    assert h == 1  # merged AFTER the racer's entry
+    rows = led.read_since(0)
+    assert [(r["sender"], r["data"]) for r in rows] == [(9, "zzz"), (1, "mine")]
+
+
+def test_theta_requires_client():
+    from fedml_tpu.comm.web3_real import ThetaEdgeStoreLedger
+
+    with pytest.raises(ImportError):
+        ThetaEdgeStoreLedger("run7")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: FL messages through BlockchainCommManager over a real adapter
+# ---------------------------------------------------------------------------
+
+def test_comm_manager_rides_theta_ledger():
+    from fedml_tpu.comm.blockchain import BlockchainCommManager
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.web3_real import ThetaEdgeStoreLedger
+
+    store = FakeEdgeStore()
+    led1 = ThetaEdgeStoreLedger("runE", http_client=store)
+    led2 = ThetaEdgeStoreLedger("runE", http_client=store)
+    m1 = BlockchainCommManager("runE", 1, ledger=led1, poll_interval_s=0.02)
+    m2 = BlockchainCommManager("runE", 2, ledger=led2, poll_interval_s=0.02)
+    try:
+        out = Message(3, sender_id=1, receiver_id=2)
+        out.add_params("k", 2.5)
+        m1.send_message(out)
+        data = m2._inbox.get(timeout=5)
+        got = Message.decode(data)
+        assert got.get_type() == 3 and float(got.get("k")) == 2.5
+        # rank 1's own inbox stays empty (transaction addressed to 2)
+        assert m1._inbox.empty()
+    finally:
+        m1.stop_receive_message()
+        m2.stop_receive_message()
