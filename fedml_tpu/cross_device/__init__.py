@@ -17,22 +17,114 @@ platforms and only the transport/client language differ.
 
 from __future__ import annotations
 
+import time
 from pathlib import Path
 from typing import Optional
 
 from ..cross_silo import build_aggregator
+from ..cross_silo import message_define as md
 from ..cross_silo.server import FedMLServerManager
+
+
+class DeviceRegistry:
+    """Device registration + liveness — the fleet-management piece phones
+    need that silos don't (the reference's MLOps device manager tracks
+    BeeHive device status the same way: register on first status report,
+    refresh on every message, stop scheduling silent devices).
+
+    Exclusion is ROUND-based, not wall-clock-based: a device is live while
+    it has participated (uploaded or answered a status probe) within the
+    last ``max_missed_rounds`` rounds.  Wall-clock windows select for
+    stragglers — in a slow round the fastest uploader's timestamp is the
+    OLDEST by broadcast time.  Excluded devices keep receiving status
+    probes, so a recovered phone rejoins the candidate set next round
+    (exclusion is never a one-way door)."""
+
+    def __init__(self, max_missed_rounds: int = 2):
+        self.max_missed_rounds = int(max_missed_rounds)
+        self.devices: dict[int, dict] = {}
+
+    def register(self, device_id: int, os_name: str = "", round_idx: int = 0) -> None:
+        d = self.devices.setdefault(
+            int(device_id),
+            {"os": os_name or "unknown", "registered": time.time(), "last_round": int(round_idx)},
+        )
+        if os_name:
+            d["os"] = os_name
+        d["last_seen"] = time.time()
+        d["last_round"] = max(d.get("last_round", 0), int(round_idx))
+
+    def note_participation(self, device_id: int, round_idx: int) -> None:
+        d = self.devices.get(int(device_id))
+        if d is None:
+            self.register(device_id, round_idx=round_idx)
+        else:
+            d["last_seen"] = time.time()
+            d["last_round"] = max(d.get("last_round", 0), int(round_idx))
+
+    def is_live(self, device_id: int, round_idx: int) -> bool:
+        d = self.devices.get(int(device_id))
+        if d is None:
+            return False
+        return (int(round_idx) - d.get("last_round", 0)) <= self.max_missed_rounds
+
+    def live_ids(self, round_idx: int) -> list[int]:
+        return sorted(i for i in self.devices if self.is_live(i, round_idx))
+
+    def status(self, round_idx: int = 0) -> dict[int, dict]:
+        return {
+            i: {**d, "live": self.is_live(i, round_idx)} for i, d in self.devices.items()
+        }
 
 
 class ServerMNN(FedMLServerManager):
     """Cross-device server: cross-silo protocol + per-round global-model
     artifact dump (the reference's ``global_model_file_path`` MNN file,
-    here the wire format every client language reads)."""
+    here the wire format every client language reads) + device
+    registration/liveness via :class:`DeviceRegistry`."""
 
     def __init__(self, cfg, aggregator, backend: Optional[str] = None, logger=None):
         super().__init__(cfg, aggregator, backend=backend, logger=logger)
         extra = getattr(cfg, "extra", {}) or {}
         self.global_model_file_path = extra.get("global_model_file_path", "")
+        self.registry = DeviceRegistry(
+            max_missed_rounds=int(extra.get("device_max_missed_rounds", 2))
+        )
+
+    # -- device lifecycle -----------------------------------------------------
+    def handle_message_client_status(self, msg) -> None:
+        # registration AND the rejoin path: a probe answer from an excluded
+        # device counts as participation in the current round
+        self.registry.register(
+            msg.get_sender_id(), str(msg.get(md.MSG_ARG_KEY_CLIENT_OS) or ""),
+            round_idx=self.round_idx,
+        )
+        super().handle_message_client_status(msg)
+
+    def handle_message_receive_model(self, msg) -> None:
+        self.registry.note_participation(msg.get_sender_id(), self.round_idx)
+        super().handle_message_receive_model(msg)
+
+    def _candidate_ids(self) -> list[int]:
+        """Schedule over live devices only (a silent phone must not stall
+        rounds); before any device registered, the full roster.  Excluded
+        devices get a status probe each round so a recovered device's reply
+        re-registers it — exclusion is never permanent."""
+        live = [c for c in self.client_ids if self.registry.is_live(c, self.round_idx)]
+        excluded = [c for c in self.client_ids if c not in live]
+        if live:
+            from ..comm.message import Message
+
+            for cid in excluded:
+                try:
+                    self.send_message(Message(md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, 0, cid))
+                except OSError:
+                    pass  # probe to a genuinely-offline device: stays excluded
+        return live or self.client_ids
+
+    def _broadcast_model(self, msg_type: int) -> None:
+        self._write_model_artifact()
+        super()._broadcast_model(msg_type)
 
     def _write_model_artifact(self) -> None:
         if not self.global_model_file_path:
@@ -44,10 +136,6 @@ class ServerMNN(FedMLServerManager):
         path = Path(self.global_model_file_path)
         path.parent.mkdir(parents=True, exist_ok=True)
         path.write_bytes(wire.encode_pytree(jax.device_get(self.aggregator.global_vars)))
-
-    def _broadcast_model(self, msg_type: int) -> None:
-        self._write_model_artifact()
-        super()._broadcast_model(msg_type)
 
 
 def build_cross_device_server(cfg, dataset, model, backend: Optional[str] = None) -> ServerMNN:

@@ -169,6 +169,7 @@ class FedMLServerManager(FedMLCommManager):
         self.quorum_frac = float((getattr(cfg, "extra", {}) or {}).get("straggler_quorum_frac", 0.5) or 0.5)
         self._round_timer: Optional[threading.Timer] = None
         self._agg_lock = threading.Lock()
+        self._init_sent = False
         # set by handlers/timers when the run cannot make progress; surfaced
         # as an exception by run_until_done instead of a silent timeout
         self.failed: Optional[str] = None
@@ -188,12 +189,20 @@ class FedMLServerManager(FedMLCommManager):
     def handle_message_client_status(self, msg: Message) -> None:
         if msg.get(md.MSG_ARG_KEY_CLIENT_STATUS) == md.CLIENT_STATUS_ONLINE:
             self.active_clients.add(msg.get_sender_id())
-        if len(self.active_clients) == len(self.client_ids):
+        # once only: a status reply arriving mid-run (e.g. a liveness probe
+        # answer from a cross-device fleet) must not re-fire round 0
+        if not self._init_sent and len(self.active_clients) == len(self.client_ids):
             self.send_init_msg()
 
     def send_init_msg(self) -> None:
         """Reference ``send_init_msg`` (:48): global model + per-client index."""
+        self._init_sent = True
         self._broadcast_model(md.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _candidate_ids(self) -> list[int]:
+        """The candidate set for this round's selection — subclasses narrow
+        it (cross-device liveness) without mutating shared state."""
+        return self.client_ids
 
     def handle_message_receive_model(self, msg: Message) -> None:
         with self._agg_lock:
@@ -251,7 +260,7 @@ class FedMLServerManager(FedMLCommManager):
     def _broadcast_model(self, msg_type: int) -> None:
         """Select clients, send them the global model for this round, arm the
         straggler timer — shared by round 0 (INIT) and later rounds (SYNC)."""
-        self.selected = self.aggregator.client_selection(self.round_idx, self.client_ids, self.per_round)
+        self.selected = self.aggregator.client_selection(self.round_idx, self._candidate_ids(), self.per_round)
         params = jax.device_get(self.aggregator.global_vars)
         for cid in self.selected:
             msg = Message(msg_type, 0, cid)

@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from .conftest import tiny_config
-from .test_native_client import _wait_listening, _write_shard, native_binary  # noqa: F401
+from .test_native_client import _free_port_block, _wait_listening, _write_shard, native_binary  # noqa: F401
 
 
 def test_cross_device_runner_with_native_fleet(native_binary, tmp_path, eight_devices):
@@ -19,7 +19,8 @@ def test_cross_device_runner_with_native_fleet(native_binary, tmp_path, eight_de
     from fedml_tpu.comm import wire
     from fedml_tpu.runner import FedMLRunner
 
-    base_port = 22790
+    # ephemeral block: a fixed port is one orphaned listener away from flaky
+    base_port = _free_port_block(3)
     artifact = tmp_path / "global_model.wire"
     cfg = tiny_config(
         training_type="cross_device", backend="TCP",
@@ -72,3 +73,110 @@ def _flatten(tree):
             yield from _flatten(v)
     else:
         yield tree
+
+
+def test_device_registry_round_based_liveness():
+    """Devices register on status, refresh via round participation, and are
+    excluded after missing max_missed_rounds — wall-clock-independent, so
+    fast uploaders in slow rounds stay live."""
+    from fedml_tpu.cross_device import DeviceRegistry
+
+    reg = DeviceRegistry(max_missed_rounds=2)
+    reg.register(1, "android", round_idx=0)
+    reg.register(2, "linux", round_idx=0)
+    assert set(reg.live_ids(0)) == {1, 2}
+    assert reg.status(0)[1]["os"] == "android"
+    reg.note_participation(1, 1)
+    reg.note_participation(1, 2)
+    reg.note_participation(1, 3)
+    # device 2 silent since round 0: excluded at round 3 (missed 3 > 2)
+    assert reg.live_ids(3) == [1]
+    # rejoin: a probe answer at round 3 restores it
+    reg.register(2, round_idx=3)
+    assert set(reg.live_ids(3)) == {1, 2}
+    # unknown device participation auto-registers
+    reg.note_participation(7, 3)
+    assert 7 in reg.live_ids(3)
+
+
+def test_cross_device_server_tracks_and_selects_live_devices(eight_devices):
+    """The cross-device server registers devices from status messages and
+    schedules rounds over LIVE devices only."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client
+    from fedml_tpu.cross_device import build_cross_device_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    from .conftest import tiny_config
+
+    cfg = tiny_config(
+        training_type="cross_device", client_num_in_total=2,
+        client_num_per_round=2, comm_round=1, run_id="cd-reg",
+        frequency_of_the_test=1,
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("cd-reg")
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC") for r in (1, 2)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_cross_device_server(cfg, ds, model, backend="INPROC")
+    try:
+        history = server.run_until_done(timeout=120.0)
+    finally:
+        for c in clients:
+            c.finish()
+    assert history and history[-1]["round"] == 0
+    st = server.registry.status(server.round_idx)
+    assert set(st) == {1, 2}
+    assert all(d["live"] for d in st.values())
+    assert all(d["os"] for d in st.values())
+
+
+def test_cross_device_server_excludes_dead_and_probes_for_rejoin(eight_devices):
+    """A device that missed too many rounds is excluded from the candidate
+    set AND receives a status probe; its reply re-registers it."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_aggregator
+    from fedml_tpu.cross_device import ServerMNN
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    from .conftest import tiny_config
+
+    cfg = tiny_config(
+        training_type="cross_device", client_num_in_total=3,
+        client_num_per_round=2, comm_round=2, run_id="cd-dead",
+        frequency_of_the_test=0, extra={"device_max_missed_rounds": 1},
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("cd-dead")
+    server = ServerMNN(cfg, build_aggregator(cfg, ds, model), backend="INPROC")
+    probed = []
+    orig_send = server.send_message
+
+    def spy_send(msg):
+        if msg.get_type() == 6:  # CHECK_CLIENT_STATUS
+            probed.append(msg.get_receiver_id())
+        return orig_send(msg)
+
+    server.send_message = spy_send
+    # devices 1-2 participate through round 5; device 3 silent since round 0
+    server.round_idx = 5
+    server.registry.register(1, "android", round_idx=5)
+    server.registry.register(2, "linux", round_idx=5)
+    server.registry.register(3, "android", round_idx=0)
+    cand = server._candidate_ids()
+    assert cand == [1, 2]          # dead device excluded from scheduling
+    assert probed == [3]           # ...but probed for rejoin
+    # probe answer re-registers it: live again next round
+    server.registry.register(3, round_idx=server.round_idx)
+    probed.clear()
+    assert server._candidate_ids() == [1, 2, 3]
+    assert probed == []
