@@ -32,48 +32,49 @@ class DeviceRegistry:
     BeeHive device status the same way: register on first status report,
     refresh on every message, stop scheduling silent devices).
 
-    Exclusion is ROUND-based, not wall-clock-based: a device is live while
-    it has participated (uploaded or answered a status probe) within the
-    last ``max_missed_rounds`` rounds.  Wall-clock windows select for
-    stragglers — in a slow round the fastest uploader's timestamp is the
-    OLDEST by broadcast time.  Excluded devices keep receiving status
-    probes, so a recovered phone rejoins the candidate set next round
+    A device is excluded only after FAILING TO ANSWER ``max_missed`` of its
+    own consecutive selections — not by wall clock (which marks the fastest
+    uploader of a slow round stale) and not by round count (which would
+    evict healthy devices the sampler simply didn't pick).  Excluded devices
+    keep receiving status probes, so a recovered phone's reply rejoins it
     (exclusion is never a one-way door)."""
 
-    def __init__(self, max_missed_rounds: int = 2):
-        self.max_missed_rounds = int(max_missed_rounds)
+    def __init__(self, max_missed: int = 2):
+        self.max_missed = int(max_missed)
         self.devices: dict[int, dict] = {}
 
-    def register(self, device_id: int, os_name: str = "", round_idx: int = 0) -> None:
+    def register(self, device_id: int, os_name: str = "") -> None:
+        """First status report, or any later participation signal (upload,
+        probe answer): the device is alive — clear its missed counter."""
         d = self.devices.setdefault(
-            int(device_id),
-            {"os": os_name or "unknown", "registered": time.time(), "last_round": int(round_idx)},
+            int(device_id), {"os": os_name or "unknown", "registered": time.time(), "missed": 0},
         )
         if os_name:
             d["os"] = os_name
         d["last_seen"] = time.time()
-        d["last_round"] = max(d.get("last_round", 0), int(round_idx))
+        d["missed"] = 0
 
-    def note_participation(self, device_id: int, round_idx: int) -> None:
+    def note_participation(self, device_id: int, round_idx: int = 0) -> None:
+        self.register(device_id)
+
+    def note_missed_selection(self, device_id: int) -> None:
+        """The device was selected for a round and never uploaded."""
         d = self.devices.get(int(device_id))
-        if d is None:
-            self.register(device_id, round_idx=round_idx)
-        else:
-            d["last_seen"] = time.time()
-            d["last_round"] = max(d.get("last_round", 0), int(round_idx))
+        if d is not None:
+            d["missed"] = d.get("missed", 0) + 1
 
-    def is_live(self, device_id: int, round_idx: int) -> bool:
+    def is_live(self, device_id: int, round_idx: int = 0) -> bool:
         d = self.devices.get(int(device_id))
         if d is None:
             return False
-        return (int(round_idx) - d.get("last_round", 0)) <= self.max_missed_rounds
+        return d.get("missed", 0) <= self.max_missed
 
-    def live_ids(self, round_idx: int) -> list[int]:
-        return sorted(i for i in self.devices if self.is_live(i, round_idx))
+    def live_ids(self, round_idx: int = 0) -> list[int]:
+        return sorted(i for i in self.devices if self.is_live(i))
 
     def status(self, round_idx: int = 0) -> dict[int, dict]:
         return {
-            i: {**d, "live": self.is_live(i, round_idx)} for i, d in self.devices.items()
+            i: {**d, "live": self.is_live(i)} for i, d in self.devices.items()
         }
 
 
@@ -88,38 +89,59 @@ class ServerMNN(FedMLServerManager):
         extra = getattr(cfg, "extra", {}) or {}
         self.global_model_file_path = extra.get("global_model_file_path", "")
         self.registry = DeviceRegistry(
-            max_missed_rounds=int(extra.get("device_max_missed_rounds", 2))
+            max_missed=int(extra.get("device_max_missed_rounds", 2))
         )
+        self._uploaded_this_round: set[int] = set()
 
     # -- device lifecycle -----------------------------------------------------
     def handle_message_client_status(self, msg) -> None:
         # registration AND the rejoin path: a probe answer from an excluded
-        # device counts as participation in the current round
+        # device clears its missed counter
         self.registry.register(
-            msg.get_sender_id(), str(msg.get(md.MSG_ARG_KEY_CLIENT_OS) or ""),
-            round_idx=self.round_idx,
+            msg.get_sender_id(), str(msg.get(md.MSG_ARG_KEY_CLIENT_OS) or "")
         )
         super().handle_message_client_status(msg)
 
     def handle_message_receive_model(self, msg) -> None:
-        self.registry.note_participation(msg.get_sender_id(), self.round_idx)
+        self._uploaded_this_round.add(msg.get_sender_id())
+        self.registry.note_participation(msg.get_sender_id())
         super().handle_message_receive_model(msg)
 
-    def _candidate_ids(self) -> list[int]:
-        """Schedule over live devices only (a silent phone must not stall
-        rounds); before any device registered, the full roster.  Excluded
-        devices get a status probe each round so a recovered device's reply
-        re-registers it — exclusion is never permanent."""
-        live = [c for c in self.client_ids if self.registry.is_live(c, self.round_idx)]
-        excluded = [c for c in self.client_ids if c not in live]
-        if live:
-            from ..comm.message import Message
+    def _probe_async(self, device_ids: list[int]) -> None:
+        """Fire-and-forget status probes on a daemon thread: a probe to a
+        black-holed device can block for the full connect timeout, and the
+        candidate computation runs in the round-critical path (under
+        _agg_lock) — dead devices must not stall live ones.  Best-effort by
+        definition, so EVERY transport error is swallowed (gRPC raises
+        RpcError, not OSError)."""
+        if not device_ids:
+            return
+        from ..comm.message import Message
 
-            for cid in excluded:
+        def probe():
+            for cid in device_ids:
                 try:
                     self.send_message(Message(md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, 0, cid))
-                except OSError:
-                    pass  # probe to a genuinely-offline device: stays excluded
+                except Exception:
+                    pass  # genuinely offline: stays excluded until it answers
+
+        import threading
+
+        threading.Thread(target=probe, daemon=True).start()
+
+    def _candidate_ids(self) -> list[int]:
+        """Close out the PREVIOUS round's attendance (selected devices that
+        never uploaded get a missed-selection strike — devices the sampler
+        didn't pick are untouched), then schedule over live devices; probe
+        every excluded device (even when all are excluded) so a recovered
+        device's reply rejoins it."""
+        for cid in self.selected:
+            if cid not in self._uploaded_this_round:
+                self.registry.note_missed_selection(cid)
+        self._uploaded_this_round = set()
+        live = [c for c in self.client_ids if self.registry.is_live(c)]
+        excluded = [c for c in self.client_ids if c not in live]
+        self._probe_async(excluded)
         return live or self.client_ids
 
     def _broadcast_model(self, msg_type: int) -> None:

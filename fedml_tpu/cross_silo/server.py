@@ -267,7 +267,13 @@ class FedMLServerManager(FedMLCommManager):
             msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
             msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
             msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
-            self.send_message(msg)
+            try:
+                self.send_message(msg)
+            except Exception:
+                # best-effort per client: one unreachable peer must not kill
+                # the receive/timer thread mid-broadcast and hang the run —
+                # quorum + straggler handling own progress for missing clients
+                log.warning("broadcast to client %d failed; continuing", cid, exc_info=True)
         self._arm_straggler_timer()
 
     def send_finish(self) -> None:

@@ -75,28 +75,34 @@ def _flatten(tree):
         yield tree
 
 
-def test_device_registry_round_based_liveness():
-    """Devices register on status, refresh via round participation, and are
-    excluded after missing max_missed_rounds — wall-clock-independent, so
-    fast uploaders in slow rounds stay live."""
+def test_device_registry_missed_selection_liveness():
+    """Exclusion counts consecutive MISSED SELECTIONS only: a healthy device
+    the sampler never picks stays live forever; a device that ignores its
+    own selections is excluded; any participation signal clears the count."""
     from fedml_tpu.cross_device import DeviceRegistry
 
-    reg = DeviceRegistry(max_missed_rounds=2)
-    reg.register(1, "android", round_idx=0)
-    reg.register(2, "linux", round_idx=0)
-    assert set(reg.live_ids(0)) == {1, 2}
-    assert reg.status(0)[1]["os"] == "android"
-    reg.note_participation(1, 1)
-    reg.note_participation(1, 2)
-    reg.note_participation(1, 3)
-    # device 2 silent since round 0: excluded at round 3 (missed 3 > 2)
-    assert reg.live_ids(3) == [1]
-    # rejoin: a probe answer at round 3 restores it
-    reg.register(2, round_idx=3)
-    assert set(reg.live_ids(3)) == {1, 2}
+    reg = DeviceRegistry(max_missed=2)
+    reg.register(1, "android")
+    reg.register(2, "linux")
+    reg.register(3, "android")
+    assert set(reg.live_ids()) == {1, 2, 3}
+    assert reg.status()[1]["os"] == "android"
+    # device 3 never selected: stays live no matter how many rounds pass
+    for _ in range(10):
+        reg.note_missed_selection(2)
+    assert reg.live_ids() == [1, 3]
+    # rejoin: a probe answer clears the strikes
+    reg.register(2)
+    assert set(reg.live_ids()) == {1, 2, 3}
+    # under the threshold: still live
+    reg.note_missed_selection(1)
+    reg.note_missed_selection(1)
+    assert 1 in reg.live_ids()
+    reg.note_missed_selection(1)
+    assert 1 not in reg.live_ids()
     # unknown device participation auto-registers
-    reg.note_participation(7, 3)
-    assert 7 in reg.live_ids(3)
+    reg.note_participation(7)
+    assert 7 in reg.live_ids()
 
 
 def test_cross_device_server_tracks_and_selects_live_devices(eight_devices):
@@ -167,16 +173,27 @@ def test_cross_device_server_excludes_dead_and_probes_for_rejoin(eight_devices):
         return orig_send(msg)
 
     server.send_message = spy_send
-    # devices 1-2 participate through round 5; device 3 silent since round 0
-    server.round_idx = 5
-    server.registry.register(1, "android", round_idx=5)
-    server.registry.register(2, "linux", round_idx=5)
-    server.registry.register(3, "android", round_idx=0)
+    # device 3 ignored its last two selections (max_missed=1 -> excluded)
+    server.registry.register(1, "android")
+    server.registry.register(2, "linux")
+    server.registry.register(3, "android")
+    server.registry.note_missed_selection(3)
+    server.registry.note_missed_selection(3)
     cand = server._candidate_ids()
     assert cand == [1, 2]          # dead device excluded from scheduling
+    import time as _t
+    for _ in range(50):            # probes fire on a daemon thread
+        if probed:
+            break
+        _t.sleep(0.05)
     assert probed == [3]           # ...but probed for rejoin
-    # probe answer re-registers it: live again next round
-    server.registry.register(3, round_idx=server.round_idx)
+    # probe answer clears the strikes: live again next round
+    server.registry.register(3)
     probed.clear()
     assert server._candidate_ids() == [1, 2, 3]
-    assert probed == []
+    # selected-but-silent devices earn a strike at the next candidate pass
+    server.selected = [1, 2]
+    server._uploaded_this_round = {1}
+    server._candidate_ids()
+    assert server.registry.devices[2]["missed"] == 1
+    assert server.registry.devices[1]["missed"] == 0
