@@ -100,9 +100,11 @@ def _free_port_block(n: int, attempts: int = 64) -> int:
         socks = []
         try:
             for off in range(n):
+                # probe EXACTLY what the transport will bind (wildcard, no
+                # REUSEADDR): a loopback probe with REUSEADDR can succeed
+                # where the real 0.0.0.0 bind then fails on a live listener
                 s = socket.socket()
-                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-                s.bind(("127.0.0.1", base + off))
+                s.bind(("0.0.0.0", base + off))
                 socks.append(s)
             return base
         except OSError:
