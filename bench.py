@@ -96,9 +96,11 @@ def bench_llm(peak):
     else:
         d = int(os.environ.get("BENCH_LLM_DMODEL", "2048"))
         L = int(os.environ.get("BENCH_LLM_LAYERS", "8"))
+        remat = os.environ.get("BENCH_LLM_REMAT", "1") not in ("0", "false", "no")
         tcfg = TransformerConfig(
             vocab_size=32000, d_model=d, n_layers=L, n_heads=16, n_kv_heads=16,
-            d_ff=5632, max_seq_len=2048, remat=True, remat_policy="dots",
+            d_ff=5632, max_seq_len=2048, remat=remat,
+            remat_policy=os.environ.get("BENCH_LLM_REMAT_POLICY", "dots"),
         )
         args = LLMTrainArgs(
             batch_size=int(os.environ.get("BENCH_LLM_BATCH", "8")),

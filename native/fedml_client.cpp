@@ -76,22 +76,42 @@ static bool read_frame(int fd, std::vector<uint8_t>* out) {
   return read_exact(fd, out->data(), len);
 }
 
-static void send_frame_to(const std::string& host, int port, const std::vector<uint8_t>& payload) {
+// best_effort: a terminal ack may race the server's listener teardown
+// (the server closes right after broadcasting FINISH; its Python twin
+// treats the FINISHED ack as bookkeeping only) — such a send must not
+// fail the client.
+static void send_frame_to(const std::string& host, int port, const std::vector<uint8_t>& payload,
+                          bool best_effort = false) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) { perror("socket"); exit(1); }
+  if (fd < 0) { if (best_effort) return; perror("socket"); exit(1); }
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons((uint16_t)port);
   inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
-  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) { perror("connect"); exit(1); }
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    if (best_effort) { close(fd); return; }
+    perror("connect"); exit(1);
+  }
+  // mirror the transport's MAX_FRAME_BYTES on the send side (also bounds
+  // the 8 + size arithmetic for the compiler's overflow analysis)
+  if (payload.size() > (1ull << 30)) {
+    fprintf(stderr, "frame of %zu bytes exceeds 1 GB cap\n", payload.size());
+    if (best_effort) { close(fd); return; }
+    exit(1);
+  }
   uint64_t len = payload.size();
   std::vector<uint8_t> framed(8 + payload.size());
   memcpy(framed.data(), &len, 8);
   memcpy(framed.data() + 8, payload.data(), payload.size());
   size_t sent = 0;
   while (sent < framed.size()) {
-    ssize_t w = write(fd, framed.data() + sent, framed.size() - sent);
-    if (w <= 0) { perror("write"); exit(1); }
+    // MSG_NOSIGNAL: a peer that closed mid-race (the best_effort case)
+    // must surface as EPIPE, not a process-killing SIGPIPE
+    ssize_t w = send(fd, framed.data() + sent, framed.size() - sent, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (best_effort) { close(fd); return; }
+      perror("send"); exit(1);
+    }
     sent += (size_t)w;
   }
   close(fd);
@@ -242,7 +262,7 @@ static int run_client(const Args& a) {
       } else if (msg_type == kFinish) {
         auto reply = wire::encode_message(
             control_json(kFinished, a.rank, 0, ""), kEmptyBlobHeader, {});
-        send_frame_to(a.host, a.base_port + 0, reply);
+        send_frame_to(a.host, a.base_port + 0, reply, /*best_effort=*/true);
         done = true;
         break;
       }
