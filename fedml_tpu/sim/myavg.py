@@ -208,6 +208,27 @@ class MyAvgSimulator(MeshSimulator):
         cka_f = LayerFilter(cfg.cka_unselect_layer, cfg.cka_all_select_layer,
                             cfg.cka_any_select_layer)
         self._cka_flags = [bool(cka_f(p)) for p in paths]
+        # filters come from hand-mapped torch state_dict substrings; a typo
+        # (or a flax-vs-torch naming mismatch) silently degenerates MyAvg to
+        # plain FedAvg — every configured substring must match SOME leaf,
+        # and a configured CKA filter must select at least one leaf
+        all_subs = set(cfg.agg_unselect_layer) | set(cfg.agg_all_select_layer) \
+            | set(cfg.agg_any_select_layer) | set(cfg.cka_unselect_layer) \
+            | set(cfg.cka_all_select_layer) | set(cfg.cka_any_select_layer)
+        for spec in cfg.agg_mod_dict.values():
+            for key in ("agg_unselect_layer", "agg_all_select_layer", "agg_any_select_layer"):
+                all_subs |= set(spec.get(key, ()))
+        dead = sorted(s for s in all_subs if not any(s in p for p in paths))
+        if dead:
+            raise ValueError(
+                f"MyAvg layer-filter substrings {dead} match NO model leaf "
+                f"path; known paths: {paths}"
+            )
+        if (cfg.cka_any_select_layer or cfg.cka_all_select_layer) and not any(self._cka_flags):
+            raise ValueError(
+                "cka_*_select_layer is configured but selects zero leaves — "
+                "the CKA personalization would silently never run"
+            )
         self._topk = int(cfg.cka_select_topk)
         self._thresh = (float(cfg.cka_low_thresh), float(cfg.cka_high_thresh))
         # rebuild the jitted round over the override (the parent compiled the

@@ -36,20 +36,21 @@ def main():
         random_seed=0,
         synthetic_train_size=20000,
         synthetic_test_size=4000,
-        # the reference recipe's agg_args, mapped to flax leaf paths:
-        # default rounds share the early/body convs; every 5th round
-        # aggregates everything; CKA personalization on the later layers+head
-        agg_unselect_layer=("head", "block3",),
+        # the reference recipe's agg_args mapped to FLAX leaf paths (resnet20
+        # stage 3 = BasicBlock_6..8, head = Dense_0 — MyAvgSimulator refuses
+        # substrings that match no leaf): default rounds share the early/body
+        # convs; every 5th round aggregates everything; CKA personalization
+        # on stage 3 + head
+        agg_unselect_layer=("Dense_0", "BasicBlock_6", "BasicBlock_7", "BasicBlock_8"),
         agg_mod_list=(5,),
         agg_mod_dict={5: {}},
-        cka_any_select_layer=("head", "block3"),
+        cka_any_select_layer=("Dense_0", "BasicBlock_6", "BasicBlock_7", "BasicBlock_8"),
         cka_select_topk=3,
     )
     fedml_tpu.init(cfg)
     t0 = time.time()
     runner = FedMLRunner(cfg)
     hist = runner.run()
-    sim = runner.runner
     curve = [
         (h["round"], h.get("test_acc"), h.get("personalized_test_acc_mean"))
         for h in hist if "test_acc" in h

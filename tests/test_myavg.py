@@ -166,3 +166,13 @@ def test_cka_partner_selection_prefers_similar_clients(eight_devices):
 def test_myavg_rejects_sp_backend(eight_devices):
     with pytest.raises(NotImplementedError):
         _build(_myavg_cfg(backend_sim="sp"))
+
+
+def test_myavg_refuses_dead_filter_substrings(eight_devices):
+    """A filter substring matching no leaf silently degenerates MyAvg to
+    plain FedAvg (the torch-vs-flax naming trap) — it must refuse loudly."""
+    with pytest.raises(ValueError, match="match NO model leaf"):
+        _build(_myavg_cfg(agg_unselect_layer=("head",)))  # torch name, not flax
+    with pytest.raises(ValueError, match="selects zero leaves"):
+        _build(_myavg_cfg(cka_any_select_layer=("Dense_1",),
+                          cka_unselect_layer=("Dense_1",)))
