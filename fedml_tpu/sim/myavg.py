@@ -224,7 +224,9 @@ class MyAvgSimulator(MeshSimulator):
                 f"MyAvg layer-filter substrings {dead} match NO model leaf "
                 f"path; known paths: {paths}"
             )
-        if (cfg.cka_any_select_layer or cfg.cka_all_select_layer) and not any(self._cka_flags):
+        cka_configured = bool(cfg.cka_any_select_layer or cfg.cka_all_select_layer
+                              or cfg.cka_unselect_layer)
+        if cka_configured and not any(self._cka_flags):
             raise ValueError(
                 "cka_*_select_layer is configured but selects zero leaves — "
                 "the CKA personalization would silently never run"

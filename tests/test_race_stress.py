@@ -1,0 +1,193 @@
+"""Host-side actor-loop race stress (SURVEY §5 'race detection': the
+reference has none and its concurrency safety is ad-hoc; the JAX core is
+functional, so the places that CAN race are the host-side managers).
+
+Each test hammers a manager's message handlers from many threads at once —
+the situation real transports create (gRPC thread pools, MQTT callbacks,
+TCP accept threads) — and asserts the protocol invariants hold: exactly one
+aggregate per round, no double round-advance, no lost or duplicated state.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _storm(fns, repeats=4):
+    """Run every callable in `fns` `repeats` times concurrently."""
+    threads = [
+        threading.Thread(target=fn)
+        for fn in fns for _ in range(repeats)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "storm thread hung"
+
+
+def test_server_duplicate_and_stale_uploads(eight_devices):
+    """Duplicate model uploads (MQTT redelivery) and stale-round arrivals
+    must produce EXACTLY one aggregation per round and never double-advance
+    the round counter."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import build_aggregator, message_define as md
+    from fedml_tpu.cross_silo.server import FedMLServerManager
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=4,
+        client_num_per_round=4, comm_round=3, run_id="race-dup",
+        frequency_of_the_test=0,
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("race-dup")
+    server = FedMLServerManager(cfg, build_aggregator(cfg, ds, model), backend="INPROC")
+
+    agg_calls = []
+    orig_agg = server.aggregator.aggregate
+
+    def counting_agg(round_idx):
+        agg_calls.append(round_idx)
+        return orig_agg(round_idx)
+
+    server.aggregator.aggregate = counting_agg
+    import jax
+
+    params = jax.device_get(server.aggregator.global_vars)
+    server.selected = list(server.client_ids)
+    server.round_idx = 0
+
+    def upload(sender, round_idx):
+        msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, sender, 0)
+        msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+        msg.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, 10.0)
+        msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+        return msg
+
+    # storm: every client uploads round 0 FOUR times each, plus stale
+    # round -1 and future round 7 uploads interleaved
+    fns = []
+    for c in (1, 2, 3, 4):
+        fns.append(lambda c=c: server.handle_message_receive_model(upload(c, 0)))
+        fns.append(lambda c=c: server.handle_message_receive_model(upload(c, -1)))
+        fns.append(lambda c=c: server.handle_message_receive_model(upload(c, 7)))
+    _storm(fns, repeats=3)
+
+    # exactly ONE aggregation happened, for round 0, and the round advanced once
+    assert agg_calls == [0], agg_calls
+    assert server.round_idx == 1
+    # and the next round can still proceed (no corrupted state)
+    for c in (1, 2, 3, 4):
+        server.handle_message_receive_model(upload(c, 1))
+    assert agg_calls == [0, 1]
+    assert server.round_idx == 2
+
+
+def test_fa_server_duplicate_submissions(eight_devices):
+    """Same at-least-once property for the FA wire server."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+    from fedml_tpu.fa.analyzers import create_analyzer_pair
+    from fedml_tpu.fa.cross_silo import (
+        MSG_ARG_KEY_FA_PAYLOAD, MSG_TYPE_C2S_FA_SUBMISSION, FAServerManager, fa_encode,
+    )
+
+    cfg = tiny_config(client_num_in_total=4, client_num_per_round=4,
+                      comm_round=2, run_id="race-fa")
+    fedml_tpu.init(cfg)
+    InProcRouter.reset("race-fa")
+    _, aggregator = create_analyzer_pair("frequency_estimation", cfg)
+    server = FAServerManager(cfg, aggregator, backend="INPROC")
+    server.selected = list(server.client_ids)
+
+    agg_calls = []
+    orig = server.aggregator.aggregate
+
+    def counting(subs):
+        agg_calls.append(len(subs))
+        return orig(subs)
+
+    server.aggregator.aggregate = counting
+
+    def submit(sender, round_idx):
+        msg = Message(MSG_TYPE_C2S_FA_SUBMISSION, sender, 0)
+        msg.add_params(MSG_ARG_KEY_FA_PAYLOAD, fa_encode({int(sender): 1}))
+        msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+        return msg
+
+    fns = [
+        (lambda c=c: server.handle_message_submission(submit(c, 0)))
+        for c in (1, 2, 3, 4)
+    ]
+    _storm(fns, repeats=4)
+    assert agg_calls == [4], agg_calls  # one aggregate, all four clients
+    assert server.round_idx == 1
+
+
+def test_deploy_predict_under_scale_churn(tmp_path):
+    """Concurrent predicts while the reconcile loop scales up and down:
+    every predict either succeeds or fails with the documented no-ready /
+    all-failed errors — never a dict-mutation crash or a wedged lock."""
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.serving.deploy import ModelCard, ModelDeployScheduler, save_params_card
+
+    cfg = tiny_config()
+    fedml_tpu.init(cfg)
+    model = model_hub.create(cfg, 10)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        np.zeros((1, 32), np.float32), train=True,
+    )
+    path = str(tmp_path / "m.wire")
+    save_params_card(variables, path)
+    sched = ModelDeployScheduler(str(tmp_path / "db.sqlite"), reconcile_interval_s=0.2)
+    sched.cards.register(ModelCard(name="lr-r", version="v1", model="lr",
+                                   classes=10, params_path=path))
+    errors = []
+    try:
+        sched.deploy("demo", "lr-r", replicas=1)
+        sched.run_in_thread()
+        assert sched.wait_ready("demo", replicas=1, timeout=180)
+
+        stop = threading.Event()
+
+        def pounder():
+            while not stop.is_set():
+                try:
+                    sched.predict("demo", {"inputs": np.zeros((1, 32)).tolist()},
+                                  timeout=10.0)
+                except RuntimeError:
+                    pass  # documented: no ready replicas / all failed
+                except Exception as e:  # anything else is a race bug
+                    errors.append(repr(e))
+                    return
+
+        pounders = [threading.Thread(target=pounder) for _ in range(4)]
+        for t in pounders:
+            t.start()
+        # churn the replica count under the load
+        for n in (3, 1, 2, 1):
+            sched.scale("demo", n)
+            sched.wait_ready("demo", replicas=1, timeout=180)
+        stop.set()
+        for t in pounders:
+            t.join(timeout=30)
+        assert not errors, errors
+        out = sched.predict("demo", {"inputs": np.zeros((1, 32)).tolist()})
+        assert len(out["outputs"]) == 1
+    finally:
+        sched.stop()
