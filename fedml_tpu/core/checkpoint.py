@@ -45,3 +45,35 @@ class RoundCheckpointer:
 
     def close(self) -> None:
         self.mngr.close()
+
+
+class RoundCheckpointMixin:
+    """Shared round-level save/resume plumbing for simulators.
+
+    A simulator mixes this in and defines:
+    - ``_ckpt_state() -> dict`` — the round-resumable state pytree (also the
+      restore template), and
+    - ``_apply_ckpt_state(state) -> None`` — install a restored state
+      (placement/sharding concerns live here, e.g. the mesh engine re-applies
+      device placement; key arrays are authoritative over config seeds).
+    Requires ``self.cfg`` (checkpoint_dir/resume) and ``self.round_idx``.
+    """
+
+    def _checkpointer(self) -> "RoundCheckpointer":
+        if getattr(self, "_ckpt", None) is None:
+            self._ckpt = RoundCheckpointer(self.cfg.checkpoint_dir)
+        return self._ckpt
+
+    def save_checkpoint(self) -> None:
+        if not self.cfg.checkpoint_dir:
+            return
+        self._checkpointer().save(self.round_idx, self._ckpt_state())
+
+    def try_resume(self) -> bool:
+        if not (self.cfg.checkpoint_dir and getattr(self.cfg, "resume", False)):
+            return False
+        if self._checkpointer().latest_round() is None:
+            return False
+        state = self._ckpt.restore(template=self._ckpt_state())
+        self._apply_ckpt_state(state)
+        return True

@@ -30,7 +30,10 @@ from ..obs.metrics import MetricsLogger
 from . import lora as lora_lib
 
 
-class FedLLMSimulator:
+from ..core.checkpoint import RoundCheckpointMixin
+
+
+class FedLLMSimulator(RoundCheckpointMixin):
     """Federated LoRA over token-sequence clients.
 
     dataset: FederatedDataset whose train_x are token sequences (b, T) and
@@ -135,9 +138,27 @@ class FedLLMSimulator:
         y = jnp.asarray(ds.test_y[:max_samples])
         return {k: float(v) for k, v in self._eval(self.global_lora, x, y).items()}
 
+    # -- round-level checkpoint/resume (reference FedLLM PauseResumeCallback,
+    # spotlight_prj/fedllm/src/trainer_callback.py: each FL round resumes the
+    # trainer at a step offset; here the adapter tree + RNG are the state) ---
+    def _ckpt_state(self) -> dict:
+        return {
+            "global_lora": self.global_lora,
+            "round_idx": self.round_idx,
+            "root_key": self.root_key,
+        }
+
+    def _apply_ckpt_state(self, state: dict) -> None:
+        self.global_lora = jax.tree_util.tree_map(jnp.asarray, state["global_lora"])
+        self.round_idx = int(state["round_idx"])
+        # checkpointed key is authoritative (same contract as MeshSimulator)
+        self.root_key = jnp.asarray(state["root_key"])
+
     def run(self) -> list[dict]:
         history = []
-        for r in range(self.cfg.comm_round):
+        self.try_resume()
+        while self.round_idx < self.cfg.comm_round:
+            r = self.round_idx
             t0 = time.perf_counter()
             metrics = self.run_round()
             metrics.update(round=r, round_time_s=time.perf_counter() - t0)
@@ -147,4 +168,8 @@ class FedLLMSimulator:
                 metrics.update(self.evaluate())
             self.logger.log(metrics)
             history.append(metrics)
+            if self.cfg.checkpoint_every_rounds and (
+                (r + 1) % self.cfg.checkpoint_every_rounds == 0 or r == self.cfg.comm_round - 1
+            ):
+                self.save_checkpoint()
         return history

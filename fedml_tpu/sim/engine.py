@@ -47,7 +47,10 @@ from ..parallel import mesh as meshlib
 from ..obs.metrics import MetricsLogger
 
 
-class MeshSimulator:
+from ..core.checkpoint import RoundCheckpointMixin
+
+
+class MeshSimulator(RoundCheckpointMixin):
     def __init__(
         self,
         cfg: Config,
@@ -333,7 +336,8 @@ class MeshSimulator:
         res = self._eval_fn(self.global_vars, *self._test)
         return {k: float(v) for k, v in res.items()}
 
-    # -- checkpoint / resume (first-class, SURVEY.md §5) ----------------------
+    # -- checkpoint / resume (first-class, SURVEY.md §5; save/resume plumbing
+    # from core.checkpoint.RoundCheckpointMixin) ------------------------------
     def _ckpt_state(self) -> dict:
         state = {
             "global_vars": self.global_vars,
@@ -347,24 +351,7 @@ class MeshSimulator:
             state["defense_history"] = self.defense_history
         return state
 
-    def _checkpointer(self):
-        if getattr(self, "_ckpt", None) is None:
-            from ..core.checkpoint import RoundCheckpointer
-
-            self._ckpt = RoundCheckpointer(self.cfg.checkpoint_dir)
-        return self._ckpt
-
-    def save_checkpoint(self) -> None:
-        if not self.cfg.checkpoint_dir:
-            return
-        self._checkpointer().save(self.round_idx, self._ckpt_state())
-
-    def try_resume(self) -> bool:
-        if not (self.cfg.checkpoint_dir and self.cfg.resume):
-            return False
-        if self._checkpointer().latest_round() is None:
-            return False
-        state = self._ckpt.restore(template=self._ckpt_state())
+    def _apply_ckpt_state(self, state: dict) -> None:
         # re-apply the mesh placement __init__ establishes — restore hands
         # back host arrays, which would otherwise land unsharded on device 0
         self.global_vars = meshlib.replicate(state["global_vars"], self.mesh)
@@ -378,7 +365,6 @@ class MeshSimulator:
             self.client_states = meshlib.shard_leading_axis(state["client_states"], self.mesh)
         if "defense_history" in state:
             self.defense_history = jnp.asarray(state["defense_history"])
-        return True
 
     def _next_boundary(self, r0: int) -> int:
         """First round index > r0 at which the host must intervene (eval,
