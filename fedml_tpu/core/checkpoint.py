@@ -15,11 +15,16 @@ from typing import Any, Optional
 
 import jax
 import numpy as np
-import orbax.checkpoint as ocp
 
 
 class RoundCheckpointer:
     def __init__(self, directory: str, keep: int = 3):
+        # orbax import is deferred to first USE: the simulators import this
+        # module unconditionally, but orbax is only needed when a
+        # checkpoint_dir is actually configured
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
         options = ocp.CheckpointManagerOptions(max_to_keep=keep, create=True)
@@ -28,7 +33,7 @@ class RoundCheckpointer:
     def save(self, round_idx: int, state: dict) -> None:
         """state: pytree dict (global_vars, server_state, client_states, key...)."""
         state = jax.device_get(state)
-        self.mngr.save(round_idx, args=ocp.args.StandardSave(state))
+        self.mngr.save(round_idx, args=self._ocp.args.StandardSave(state))
         self.mngr.wait_until_finished()
 
     def latest_round(self) -> Optional[int]:
@@ -40,7 +45,7 @@ class RoundCheckpointer:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
         if template is not None:
             template = jax.device_get(template)
-            return self.mngr.restore(step, args=ocp.args.StandardRestore(template))
+            return self.mngr.restore(step, args=self._ocp.args.StandardRestore(template))
         return self.mngr.restore(step)
 
     def close(self) -> None:
@@ -77,3 +82,14 @@ class RoundCheckpointMixin:
         state = self._ckpt.restore(template=self._ckpt_state())
         self._apply_ckpt_state(state)
         return True
+
+    def maybe_save_checkpoint(self, completed_round: int) -> None:
+        """Save when the cadence says so: every ``checkpoint_every_rounds``
+        completed rounds and at the final round (one cadence definition for
+        every simulator)."""
+        every = getattr(self.cfg, "checkpoint_every_rounds", 0)
+        if every and (
+            (completed_round + 1) % every == 0
+            or completed_round == self.cfg.comm_round - 1
+        ):
+            self.save_checkpoint()

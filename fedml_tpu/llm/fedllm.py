@@ -168,8 +168,5 @@ class FedLLMSimulator(RoundCheckpointMixin):
                 metrics.update(self.evaluate())
             self.logger.log(metrics)
             history.append(metrics)
-            if self.cfg.checkpoint_every_rounds and (
-                (r + 1) % self.cfg.checkpoint_every_rounds == 0 or r == self.cfg.comm_round - 1
-            ):
-                self.save_checkpoint()
+            self.maybe_save_checkpoint(r)
         return history

@@ -35,6 +35,15 @@ class FedMLPredictor:
         ``stream``).  Default: one chunk, the plain prediction."""
         yield self.predict(request)
 
+    def predict_file(self, request: dict, accept: str) -> str:
+        """Non-JSON Accept header: return a path to a file to serve
+        (reference ``fedml_inference_runner.py:34-36`` wraps the predictor
+        result in a ``FileResponse``).  Predictors producing binary artifacts
+        (images, audio, model files) override this."""
+        raise NotImplementedError(
+            f"this predictor produces JSON only (Accept: {accept!r})"
+        )
+
     def ready(self) -> bool:
         return True
 
@@ -121,6 +130,21 @@ class FedMLInferenceRunner:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     request = json.loads(self.rfile.read(length).decode())
+                    accept = self.headers.get("Accept", "application/json")
+                    # a JSON reply satisfies the request if ANY member of the
+                    # (possibly composite, parameterized) Accept list is JSON
+                    # or a wildcard — 'application/json, text/plain, */*' and
+                    # 'application/json; charset=utf-8' are JSON requests
+                    wants = [m.split(";")[0].strip().lower()
+                             for m in accept.split(",") if m.strip()]
+                    json_ok = not wants or any(
+                        m in ("application/json", "application/*", "*/*", "application/x-ndjson")
+                        for m in wants
+                    )
+                    if not json_ok:
+                        # reference FileResponse path: binary artifact reply
+                        self._file(predictor.predict_file(request, accept), wants[0])
+                        return
                     if request.get("stream", False):
                         self._stream(predictor.predict_stream(request))
                         return
@@ -128,6 +152,24 @@ class FedMLInferenceRunner:
                     self._json(200, result)
                 except Exception as e:  # surface the error to the caller
                     self._json(400, {"error": f"{type(e).__name__}: {e}"})
+
+            def _file(self, path: str, content_type: str) -> None:
+                import os as _os
+                import shutil as _shutil
+
+                size = _os.path.getsize(path)  # pre-header failure -> clean 400
+                with open(path, "rb") as f:
+                    self.send_response(200)
+                    self.send_header("Content-Type", content_type)
+                    self.send_header("Content-Length", str(size))
+                    self.end_headers()
+                    try:
+                        # stream, don't slurp: artifacts can be model files
+                        _shutil.copyfileobj(f, self.wfile)
+                    except Exception:
+                        # headers are gone; a 400 here would corrupt the
+                        # response — drop the connection (same as _stream)
+                        self.close_connection = True
 
             def _stream(self, chunks) -> None:
                 """Chunked transfer of newline-delimited JSON — the stdlib

@@ -420,10 +420,7 @@ class MeshSimulator(RoundCheckpointMixin):
             for metrics in chunk:
                 self.logger.log(metrics)
                 history.append(metrics)
-            if cfg.checkpoint_every_rounds and (
-                (r_last + 1) % cfg.checkpoint_every_rounds == 0 or r_last == cfg.comm_round - 1
-            ):
-                self.save_checkpoint()
+            self.maybe_save_checkpoint(r_last)
         if getattr(cfg, "enable_contribution", False):
             scores = self.assess_contribution()
             if scores is not None:

@@ -153,3 +153,54 @@ def test_gateway_stream_and_persisted_stats(tmp_path):
         assert stats["latency_ms_ewm"] > 0
     finally:
         sched.stop()
+
+
+def test_file_response_for_non_json_accept(tmp_path):
+    """A non-JSON Accept header routes to predict_file and serves the file
+    bytes with the requested content type (reference FileResponse path);
+    JSON-only predictors yield a clean 400."""
+    from fedml_tpu.serving.inference import FedMLInferenceRunner, FedMLPredictor
+
+    art = tmp_path / "out.bin"
+    art.write_bytes(b"\x89artifact")
+
+    class FilePredictor(FedMLPredictor):
+        def predict(self, request):
+            return {"ok": True}
+
+        def predict_file(self, request, accept):
+            return str(art)
+
+    r = FedMLInferenceRunner(FilePredictor(), port=0)
+    r.run(block=False)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{r.port}/predict", data=json.dumps({}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Accept": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["Content-Type"] == "application/octet-stream"
+            assert resp.read() == b"\x89artifact"
+        # JSON accept still hits predict()
+        out = _post(r.port, {})
+        assert out == {"ok": True}
+    finally:
+        r.stop()
+
+    class JsonOnly(FedMLPredictor):
+        def predict(self, request):
+            return {"ok": True}
+
+    r2 = FedMLInferenceRunner(JsonOnly(), port=0)
+    r2.run(block=False)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{r2.port}/predict", data=json.dumps({}).encode(),
+            headers={"Content-Type": "application/json", "Accept": "image/png"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        r2.stop()
