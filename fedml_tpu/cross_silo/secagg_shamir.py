@@ -404,6 +404,7 @@ class SAClientManager(ClientMasterManager):
         self._setup_done = threading.Event()
         self._pending_msg: Optional[Message] = None
         self._lock = threading.Lock()
+        self._shared_out = False
 
     def register_message_receive_handlers(self) -> None:
         super().register_message_receive_handlers()
@@ -431,6 +432,25 @@ class SAClientManager(ClientMasterManager):
         """PK table in: Shamir-share b_u and s_sk, encrypt share (u -> v)
         under the c-key agreement with v, ship through the server
         (reference ``__offline`` :272 + ``_send_secret_share_to_sever``)."""
+        with self._lock:
+            # Share-out must happen exactly once: re-sharing b_u/s_sk under a
+            # FRESH random polynomial (e.g. on an MQTT redelivery of the PK
+            # table) would leave peers holding shares of the same secret from
+            # different polynomials — Shamir reconstruction then silently
+            # yields garbage and the unmasked aggregate is wrong.
+            if self._shared_out:
+                return
+            self._shared_out = True
+        try:
+            self._share_out(msg)
+        except Exception:
+            # the single send failed atomically — no peer holds shares yet, so
+            # a redelivered PK table may safely retry with a fresh polynomial
+            with self._lock:
+                self._shared_out = False
+            raise
+
+    def _share_out(self, msg: Message) -> None:
         table = msg.get(MSG_ARG_KEY_PK_TABLE)
         self.pk_table = {int(u): (int(v[0]), int(v[1])) for u, v in table.items()}
         rng = np.random.RandomState(

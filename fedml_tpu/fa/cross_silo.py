@@ -99,6 +99,7 @@ class FAServerManager(FedMLCommManager):
         self.history: list[dict] = []
         self.logger = logger or MetricsLogger(stdout=False)
         self._lock = threading.Lock()
+        self._round0_sent = False
         self.root_key = rng.root_key(cfg.random_seed)
 
     def register_message_receive_handlers(self) -> None:
@@ -112,8 +113,13 @@ class FAServerManager(FedMLCommManager):
     def handle_message_client_status(self, msg: Message) -> None:
         if msg.get(md.MSG_ARG_KEY_CLIENT_STATUS) == md.CLIENT_STATUS_ONLINE:
             self.active_clients.add(msg.get_sender_id())
-        if len(self.active_clients) == len(self.client_ids):
-            self._broadcast_round()
+        with self._lock:
+            # A redelivered ONLINE status (e.g. MQTT QoS-1 redelivery) must not
+            # re-sample `selected` mid-round; broadcast round 0 exactly once.
+            if self._round0_sent or len(self.active_clients) < len(self.client_ids):
+                return
+            self._round0_sent = True
+        self._broadcast_round()
 
     def _broadcast_round(self) -> None:
         """Sample this round's clients and send them the aggregator's

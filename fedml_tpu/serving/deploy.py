@@ -540,17 +540,61 @@ class ModelDeployScheduler:
                 last_err = e
                 continue
 
-            def gen(ep=ep, resp=resp, t0=t0):
-                ep.inflight += 1
-                try:
-                    with resp:
-                        for line in resp:
-                            line = line.strip()
-                            if line:
-                                yield json.loads(line)
-                finally:
-                    ep.inflight -= 1
-                    ep.record_latency(time.time() - t0)
-
-            return gen()
+            # Count the stream as inflight from the moment the response is
+            # open — a caller that never iterates must not be invisible to the
+            # autoscaler, and abandoning the stream must release the socket at
+            # close(), not at GC.  A plain generator can't guarantee that: its
+            # finally never runs if iteration never starts.
+            ep.inflight += 1
+            return _StreamHandle(ep, resp, t0)
         raise RuntimeError(f"all replicas of {endpoint_name!r} failed: {last_err}")
+
+
+class _StreamHandle:
+    """Iterator over a replica's NDJSON stream whose accounting (inflight,
+    latency EWM, socket close) runs exactly once — on exhaustion, error,
+    explicit close(), or GC — even if the caller never iterates."""
+
+    def __init__(self, ep, resp, t0):
+        self._ep, self._resp, self._t0 = ep, resp, t0
+        self._finished = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        try:
+            for line in self._resp:
+                line = line.strip()
+                if line:
+                    return json.loads(line)
+            self._finish()
+            raise StopIteration
+        except StopIteration:
+            raise
+        except Exception:
+            self._finish()
+            raise
+
+    def _finish(self, record: bool = True) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self._ep.inflight -= 1
+        if record:
+            self._ep.record_latency(time.time() - self._t0)
+        try:
+            self._resp.close()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self._finish()
+
+    def __del__(self):
+        # GC path: skip record_latency — it takes ep.lock, and a finalizer
+        # triggered by cyclic GC may run on a thread that already holds it
+        # (deadlock).  Socket close + lock-free inflight decrement only.
+        self._finish(record=False)

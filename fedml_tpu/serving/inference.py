@@ -179,11 +179,14 @@ class FedMLInferenceRunner:
                 failing predictor still produces a clean 400 (mid-stream
                 failures can only truncate the chunked body — inherent to
                 streaming)."""
+                # dedicated empty-stream sentinel: a predictor may legally
+                # yield a literal None (json 'null' is a valid NDJSON line)
+                _empty = object()
                 it = iter(chunks)
                 try:
                     first = next(it)
                 except StopIteration:
-                    first = None
+                    first = _empty
                     it = iter(())
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
@@ -196,7 +199,7 @@ class FedMLInferenceRunner:
                     self.wfile.flush()
 
                 try:
-                    if first is not None:
+                    if first is not _empty:
                         put(first)
                     for chunk in it:
                         put(chunk)

@@ -155,6 +155,75 @@ def test_gateway_stream_and_persisted_stats(tmp_path):
         sched.stop()
 
 
+def test_none_chunk_is_streamed_not_dropped():
+    """A predictor whose first yielded chunk is a literal None must stream
+    'null' — None is not the empty-stream sentinel (round-3 advisor)."""
+    from fedml_tpu.serving.inference import FedMLInferenceRunner, FedMLPredictor
+
+    class NonePredictor(FedMLPredictor):
+        def predict_stream(self, request):
+            yield None
+            yield {"x": 1}
+
+    r = FedMLInferenceRunner(NonePredictor(), port=0)
+    r.run(block=False)
+    try:
+        chunks = _post(r.port, {"stream": True}, stream=True)
+        assert chunks == [None, {"x": 1}]
+    finally:
+        r.stop()
+
+
+def test_abandoned_gateway_stream_releases_inflight(tmp_path):
+    """predict_stream counts as inflight the moment the response opens, and an
+    abandoned (never-iterated or half-read) stream releases its slot and its
+    socket at close() — not at GC (round-3 advisor)."""
+    import jax
+
+    import fedml_tpu
+    from tests.conftest import tiny_config
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.serving.deploy import ModelCard, ModelDeployScheduler, save_params_card
+
+    cfg = tiny_config()
+    fedml_tpu.init(cfg)
+    model = model_hub.create(cfg, 10)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        np.zeros((1, 32), np.float32), train=True,
+    )
+    path = str(tmp_path / "m.wire")
+    save_params_card(variables, path)
+    sched = ModelDeployScheduler(str(tmp_path / "db.sqlite"), reconcile_interval_s=30)
+    sched.cards.register(ModelCard(name="lr-s", version="v1", model="lr",
+                                   classes=10, params_path=path))
+    try:
+        sched.deploy("demo", "lr-s", replicas=1)
+        assert sched.wait_ready("demo", replicas=1, timeout=180)
+        ep = sched.endpoints["demo"]
+
+        # never iterated: inflight counted on open, released on close()
+        h = sched.predict_stream("demo", {"inputs": np.zeros((2, 32)).tolist()})
+        assert ep.inflight == 1
+        h.close()
+        assert ep.inflight == 0
+
+        # half-read then abandoned
+        h2 = sched.predict_stream("demo", {"inputs": np.zeros((3, 32)).tolist()})
+        assert next(h2)["index"] == 0
+        assert ep.inflight == 1
+        h2.close()
+        assert ep.inflight == 0
+        assert ep.latency_ms_ewm is not None
+
+        # fully drained stream still accounts exactly once
+        assert len(list(sched.predict_stream(
+            "demo", {"inputs": np.zeros((2, 32)).tolist()}))) == 2
+        assert ep.inflight == 0
+    finally:
+        sched.stop()
+
+
 def test_file_response_for_non_json_accept(tmp_path):
     """A non-JSON Accept header routes to predict_file and serves the file
     bytes with the requested content type (reference FileResponse path);
