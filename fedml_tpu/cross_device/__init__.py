@@ -103,13 +103,14 @@ class ServerMNN(FedMLServerManager):
         super().handle_message_client_status(msg)
 
     def handle_message_receive_model(self, msg) -> None:
-        # Attendance must be judged against the round the upload belongs to —
-        # a stale/duplicate upload from a previous round would otherwise shield
-        # a silent device from its missed-selection strike.
+        # ANY upload proves the device is alive (clears its strike counter) —
+        # but attendance credit is only granted for the round the upload
+        # belongs to, so a stale/duplicate upload can't shield a device that
+        # stayed silent THIS round from its missed-selection strike.
+        self.registry.note_participation(msg.get_sender_id())
         with self._agg_lock:
             if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) == self.round_idx:
                 self._uploaded_this_round.add(msg.get_sender_id())
-                self.registry.note_participation(msg.get_sender_id())
         super().handle_message_receive_model(msg)
 
     def _probe_async(self, device_ids: list[int]) -> None:

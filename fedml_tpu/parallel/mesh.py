@@ -71,6 +71,11 @@ def mesh_from_config(cfg, devices=None) -> Mesh:
     return make_mesh((AXIS_CLIENTS,), None, devices)
 
 
+def round_up(n: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` >= ``n`` (client-axis padding math)."""
+    return -(-n // multiple) * multiple
+
+
 def client_sharding(mesh: Mesh, axis: str = AXIS_CLIENTS) -> NamedSharding:
     """Sharding for arrays with a leading stacked-clients dimension."""
     return NamedSharding(mesh, P(axis))

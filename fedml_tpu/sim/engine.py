@@ -83,6 +83,22 @@ class MeshSimulator(RoundCheckpointMixin):
         self.algorithm = (algorithm or create_algorithm(cfg, self.hp)).build(model)
 
         self.mesh = mesh if mesh is not None else meshlib.mesh_from_config(cfg)
+        # Client-axis padding (SURVEY §7 hard-part 2): stacks whose leading
+        # (client) dim is not a multiple of the mesh axis would REPLICATE
+        # (shard_leading_axis's correctness fallback) and serialize all client
+        # compute.  Pad the stack with zero-count dummy rows instead; dummies
+        # are never sampled (sampling stays over n_clients) and never
+        # scattered to, so numerics are untouched.
+        self._client_axis, self._lane_multiple = self._client_axis_info()
+        self._n_real = dataset.n_clients
+        self._n_pad = meshlib.round_up(self._n_real, self._lane_multiple)
+        if self._n_pad > self._n_real:
+            pad = self._n_pad - self._n_real
+            stacked = StackedClientData(
+                x=np.concatenate([stacked.x, np.zeros((pad,) + stacked.x.shape[1:], stacked.x.dtype)]),
+                y=np.concatenate([stacked.y, np.zeros((pad,) + stacked.y.shape[1:], stacked.y.dtype)]),
+                counts=np.concatenate([stacked.counts, np.zeros(pad, stacked.counts.dtype)]),
+            )
         self._data = self._place_data(stacked)
         self.counts = jnp.asarray(stacked.counts)
 
@@ -97,7 +113,7 @@ class MeshSimulator(RoundCheckpointMixin):
         self.server_state = self.algorithm.init_server_state(self.global_vars)
         cs_template = self.algorithm.init_client_state(self.global_vars)
         if cs_template is not None:
-            n = dataset.n_clients
+            n = self._n_pad  # dummy rows are never gathered or scattered
             stacked_cs = jax.tree_util.tree_map(
                 lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), cs_template
             )
@@ -129,6 +145,60 @@ class MeshSimulator(RoundCheckpointMixin):
         self._multi_round_fns: dict[int, Callable] = {}
 
     # ------------------------------------------------------------------
+    def _client_axis_info(self) -> tuple[str, int]:
+        """(axis name, axis size) the stacked-client dim shards over; size 1
+        on the SP backend (no padding needed for a host loop)."""
+        if self.backend == C.SIMULATION_BACKEND_SP:
+            return meshlib.AXIS_CLIENTS, 1
+        axis = (meshlib.AXIS_CLIENTS if meshlib.AXIS_CLIENTS in self.mesh.shape
+                else self.mesh.axis_names[0])
+        return axis, int(self.mesh.shape[axis])
+
+    def _pad_lanes(self, sampled, m: int, m_pad: int):
+        """Extend the sampled id vector with client-0 lanes up to the mesh
+        multiple.  Pad lanes redo client 0's local SGD (same cost as an idle
+        replicated lane, but the real lanes stay sharded); their outputs are
+        sliced away before the server path, so aggregation, trust hooks and
+        metrics see exactly the real ``m`` clients."""
+        if m_pad == m:
+            return sampled
+        return jnp.concatenate([sampled, jnp.zeros(m_pad - m, jnp.int32)])
+
+    def _constrain_lanes(self, tree):
+        """Pin the vmapped-client dim to the clients axis — GSPMD would
+        otherwise be free to replicate the gathered per-lane operands."""
+        if self._lane_multiple <= 1 or tree is None:
+            return tree
+        mesh, axis = self.mesh, self._client_axis
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+            ),
+            tree,
+        )
+
+    @staticmethod
+    def _slice_lanes(tree, m: int):
+        return jax.tree_util.tree_map(lambda a: a[:m], tree)
+
+    def _gather_round_inputs(self, sampled, m, m_pad, counts, data_x, data_y,
+                             client_states, key, round_idx):
+        """Shared per-round gather: pad the sampled ids to the lane multiple,
+        pull each lane's data/state/count/key, and pin the lane dim to the
+        clients axis.  Both the FedAvg-family round and the MyAvg round use
+        this verbatim — lane handling must never diverge between them."""
+        lanes = self._pad_lanes(sampled, m, m_pad)
+        xs = self._constrain_lanes(jnp.take(data_x, lanes, axis=0))
+        ys = self._constrain_lanes(jnp.take(data_y, lanes, axis=0))
+        cnts = jnp.take(counts, lanes)
+        cs = self._constrain_lanes(
+            pt.tree_take(client_states, lanes) if client_states is not None else None
+        )
+        rkey = rng.round_key(key, round_idx)
+        keys = jax.vmap(lambda i: rng.client_key(rkey, i))(lanes)
+        return xs, ys, cnts, cs, rkey, keys
+
+    # ------------------------------------------------------------------
     def _place_data(self, stacked: StackedClientData):
         x = jnp.asarray(stacked.x)
         if self.hp.compute_dtype == "bfloat16" and jnp.issubdtype(x.dtype, jnp.floating):
@@ -147,14 +217,13 @@ class MeshSimulator(RoundCheckpointMixin):
         n_total = self.dataset.n_clients
         m = min(cfg.client_num_per_round, n_total)
 
+        m_pad = meshlib.round_up(m, self._lane_multiple)
+
         def round_fn(global_vars, server_state, client_states, counts, data_x, data_y, round_idx, key, prev_delta):
             sampled = rng.sample_clients(key, round_idx, n_total, m)
-            xs = jnp.take(data_x, sampled, axis=0)
-            ys = jnp.take(data_y, sampled, axis=0)
-            cnts = jnp.take(counts, sampled)
-            cs = pt.tree_take(client_states, sampled) if client_states is not None else None
-            rkey = rng.round_key(key, round_idx)
-            keys = jax.vmap(lambda i: rng.client_key(rkey, i))(sampled)
+            xs, ys, cnts, cs, rkey, keys = self._gather_round_inputs(
+                sampled, m, m_pad, counts, data_x, data_y, client_states, key, round_idx
+            )
 
             def one_client(cstate, x, y, cnt, k):
                 out = algo.client_update(global_vars, cstate, server_state, x, y, cnt, k)
@@ -167,7 +236,12 @@ class MeshSimulator(RoundCheckpointMixin):
                     lambda x, y, cnt, k: one_client(None, x, y, cnt, k)
                 )(xs, ys, cnts, keys)
 
-            weights = cnts.astype(jnp.float32)
+            # drop the pad lanes: everything downstream (trust hooks,
+            # aggregation, scatter, metrics) sees exactly the real m clients
+            contribs = self._slice_lanes(contribs, m)
+            new_cs = self._slice_lanes(new_cs, m) if new_cs is not None else None
+            metrics = self._slice_lanes(metrics, m)
+            weights = cnts[:m].astype(jnp.float32)
             new_global, new_server, new_delta = self._server_path(
                 contribs, weights, sampled, global_vars, server_state, rkey, round_idx, prev_delta
             )
@@ -346,7 +420,9 @@ class MeshSimulator(RoundCheckpointMixin):
             "root_key": self.root_key,
         }
         if self.client_states is not None:
-            state["client_states"] = self.client_states
+            # store only the real clients — pad rows are a property of THIS
+            # mesh; a resume may run on a different device count
+            state["client_states"] = self._slice_lanes(self.client_states, self._n_real)
         if self.defense_history is not None:
             state["defense_history"] = self.defense_history
         return state
@@ -362,7 +438,16 @@ class MeshSimulator(RoundCheckpointMixin):
         # --random_seed silently changing the sampling stream mid-run)
         self.root_key = jnp.asarray(state["root_key"])
         if "client_states" in state:
-            self.client_states = meshlib.shard_leading_axis(state["client_states"], self.mesh)
+            cs = state["client_states"]
+            if self._n_pad > self._n_real:
+                pad = self._n_pad - self._n_real
+                cs = jax.tree_util.tree_map(
+                    lambda a: np.concatenate(
+                        [np.asarray(a), np.zeros((pad,) + a.shape[1:], np.asarray(a).dtype)]
+                    ),
+                    cs,
+                )
+            self.client_states = meshlib.shard_leading_axis(cs, self.mesh)
         if "defense_history" in state:
             self.defense_history = jnp.asarray(state["defense_history"])
 

@@ -176,7 +176,7 @@ class MyAvgSimulator(MeshSimulator):
         # cfg must keep reporting the real optimizer to logging/bookkeeping
         self.cfg = dataclasses.replace(self.cfg, federated_optimizer=orig_name)
 
-        n = dataset.n_clients
+        n = self._n_pad  # engine pads the client axis to the mesh multiple
         stacked = jax.tree_util.tree_map(
             lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), self.global_vars
         )
@@ -274,22 +274,25 @@ class MyAvgSimulator(MeshSimulator):
             pw = weights * ok
             return pw / jnp.maximum(pw.sum(), 1e-12)
 
+        m_pad = meshlib.round_up(m, self._lane_multiple)
+
         def round_fn(global_vars, server_state, client_states, counts, data_x,
                      data_y, round_idx, key, prev_delta):
             sampled = rng.sample_clients(key, round_idx, n_total, m)
-            xs = jnp.take(data_x, sampled, axis=0)
-            ys = jnp.take(data_y, sampled, axis=0)
-            cnts = jnp.take(counts, sampled)
-            personal = pt.tree_take(client_states, sampled)
-            rkey = rng.round_key(key, round_idx)
-            keys = jax.vmap(lambda i: rng.client_key(rkey, i))(sampled)
+            xs, ys, cnts, personal, rkey, keys = self._gather_round_inputs(
+                sampled, m, m_pad, counts, data_x, data_y, client_states, key, round_idx
+            )
 
             def one_client(pvars, x, y, cnt, k):
                 out = algo.client_update(pvars, None, server_state, x, y, cnt, k)
                 return out.contribution, out.metrics
             trained, metrics = jax.vmap(one_client)(personal, xs, ys, cnts, keys)
+            # pad lanes carry client 0's redundant training — drop them so the
+            # CKA gram, partner selection and aggregation stay exactly m x m
+            trained = self._slice_lanes(trained, m)
+            metrics = self._slice_lanes(metrics, m)
 
-            weights = cnts.astype(jnp.float32)
+            weights = cnts[:m].astype(jnp.float32)
             wnorm = weights / jnp.maximum(weights.sum(), 1e-12)
             cid = self._config_id(round_idx)
 
@@ -375,7 +378,11 @@ class MyAvgSimulator(MeshSimulator):
                 make_eval_fn(self.model, self.hp, batch_size=self._eval_bs),
                 in_axes=(0, None, None, None),
             ))
-        res = self._personal_eval_fn(self.client_states, *self._test)
+        # pad rows hold untrained init weights — evaluate real clients only
+        # (the min over clients would otherwise report the dummy rows)
+        res = self._personal_eval_fn(
+            self._slice_lanes(self.client_states, self._n_real), *self._test
+        )
         return {
             "personalized_test_acc_mean": float(jnp.mean(res["test_acc"])),
             "personalized_test_acc_min": float(jnp.min(res["test_acc"])),

@@ -45,6 +45,54 @@ def test_mesh_equals_sp_backend(eight_devices):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
 
 
+def test_mesh_equals_sp_on_undivisible_shapes(eight_devices):
+    """The flagship-recipe shape (clients and clients/round NOT multiples of
+    the mesh axis) must keep exact parity with the SP twin via zero-impact
+    lane/stack padding — and must never hit the REPLICATING fallback (round-3
+    verdict item 2)."""
+    import warnings
+
+    import jax
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    results = {}
+    for backend in ("MESH", "sp"):
+        # 13 clients / 5 per round: neither divides the 8-device clients axis
+        cfg = tiny_config(comm_round=3, backend_sim=backend,
+                          client_num_in_total=13, client_num_per_round=5,
+                          partition_method="hetero", partition_alpha=0.5)
+        fedml_tpu.init(cfg)
+        runner = FedMLRunner(cfg)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)  # REPLICATING warns -> fail
+            runner.run()
+        results[backend] = jax.device_get(runner.runner.global_vars)
+    for a, b in zip(jax.tree_util.tree_leaves(results["MESH"]),
+                    jax.tree_util.tree_leaves(results["sp"])):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_padded_client_stack_shards_evenly(eight_devices):
+    """With 13 clients on an 8-device axis the stacks are padded to 16 rows
+    and actually sharded (2 rows per device), not replicated."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = tiny_config(comm_round=1, client_num_in_total=13, client_num_per_round=5)
+    fedml_tpu.init(cfg)
+    runner = FedMLRunner(cfg)
+    sim = runner.runner
+    assert sim._n_real == 13 and sim._n_pad == 16
+    x = sim._data[0]
+    assert x.shape[0] == 16
+    shard_rows = {s.data.shape[0] for s in x.addressable_shards}
+    assert shard_rows == {2}, f"expected 2 rows/device, got {shard_rows}"
+    # dummy rows carry zero weight so they can never contribute
+    assert float(sim.counts[13:].sum()) == 0.0
+    runner.run()  # and the padded round still runs
+
+
 def test_client_sampling_matches_reference_semantics():
     from fedml_tpu.core import rng
 

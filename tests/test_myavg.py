@@ -89,16 +89,17 @@ def test_personal_models_persist_and_personalize(eight_devices):
     for _ in range(3):
         sim.run_round()
     # non-mod rounds: heads are the clients' own trained leaves -> differ
-    heads = _leaf(sim.client_states, "Dense_1.kernel")
+    # (the stack is padded to the mesh multiple; rows past _n_real are dummies)
+    heads = _leaf(sim.client_states, "Dense_1.kernel")[: sim._n_real]
     assert heads.shape[0] == 5
     spread = np.abs(heads - heads[0]).max()
     assert spread > 1e-6, "personal heads should diverge under hetero data"
     # body was plain-aggregated for everyone -> identical across clients
-    bodies = _leaf(sim.client_states, "Dense_0.kernel")
+    bodies = _leaf(sim.client_states, "Dense_0.kernel")[: sim._n_real]
     np.testing.assert_allclose(bodies, np.broadcast_to(bodies[:1], bodies.shape),
                                rtol=0, atol=1e-6)
     sim.run_round()  # CKA round
-    heads_cka = _leaf(sim.client_states, "Dense_1.kernel")
+    heads_cka = _leaf(sim.client_states, "Dense_1.kernel")[: sim._n_real]
     # personalized: clients differ (top-2 partner sets differ under hetero)
     assert np.abs(heads_cka - heads_cka[0]).max() > 1e-6
     # but each equals old-global + corrected partner-average delta, which is
@@ -152,7 +153,7 @@ def test_cka_partner_selection_prefers_similar_clients(eight_devices):
     sim._data = (sim._data[0], jnp.asarray(y))
     for _ in range(7):
         sim.run_round()
-    heads = _leaf(sim.client_states, "Dense_1.kernel")
+    heads = _leaf(sim.client_states, "Dense_1.kernel")[: sim._n_real]
     flat = heads.reshape(5, -1)
 
     def d(i, j):
