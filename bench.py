@@ -70,9 +70,21 @@ def bench_fedavg(peak):
     sps_chip = samples_per_round * rounds / dt / n_chips
     flops_sample = flopslib.resnet20_cifar_train_flops_per_sample()
     mfu = (sps_chip * flops_sample / peak) if peak else None
+    # Ceilings so the raw number is self-interpreting (PERF.md roofline):
+    # - lane ceiling 0.214: analytic FLOP-weighted MXU output-lane bound for
+    #   ResNet-20's 16/32/64 channels on the 128-wide systolic array.
+    # - attainable 0.150: per-op-trace measured bound — the conv fusions run
+    #   at 0.163 MFU (= their im2col matmul equivalent, 71% of HBM bandwidth)
+    #   and 82% of round time; mandatory BN/relu/residual second passes are
+    #   the rest.  See PERF.md "Where the remaining time goes".
+    lane_ceiling, attainable = 0.214, 0.150
     return {
         "samples_per_sec_chip": round(sps_chip, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_ceiling": lane_ceiling,
+        "mfu_vs_ceiling": round(mfu / lane_ceiling, 3) if mfu is not None else None,
+        "mfu_attainable": attainable,
+        "mfu_vs_attainable": round(mfu / attainable, 3) if mfu is not None else None,
         "rounds_per_sec": round(rounds / dt, 4),
         "clients_total": n_clients,
         "clients_per_round": per_round,
@@ -164,6 +176,15 @@ def _subprocess_bench(mode):
     )
 
 
+#: Regression floors (asserted on real TPU only).  LLM: the BASELINE.md 0.35
+#: target itself — drift below target must fail loudly, not hide in a JSON
+#: field (round-3 verdict item 7).  FedAvg: 0.125 = just under the confirmed
+#: round-3/4 band (0.130-0.137), catching architectural regressions while
+#: tolerating tunnel run-to-run noise.
+LLM_MFU_FLOOR = 0.35
+FEDAVG_MFU_FLOOR = 0.125
+
+
 def main():
     if os.environ.get("BENCH_MODE"):
         _run_one(os.environ["BENCH_MODE"])
@@ -174,6 +195,19 @@ def main():
     llm = _subprocess_bench("llm")
     fedavg = _subprocess_bench("fedavg")
 
+    on_tpu = "TPU" in str(llm.get("device", ""))
+    # one retry per bench before declaring a floor violation: a tunneled chip
+    # has real run-to-run variance and a single cold run must not fail a round
+    if on_tpu and llm["mfu"] is not None and llm["mfu"] < LLM_MFU_FLOOR:
+        llm = _subprocess_bench("llm")
+    if on_tpu and fedavg["mfu"] is not None and fedavg["mfu"] < FEDAVG_MFU_FLOOR:
+        fedavg = _subprocess_bench("fedavg")
+    violations = []
+    if on_tpu and llm["mfu"] is not None and llm["mfu"] < LLM_MFU_FLOOR:
+        violations.append(f"llm mfu {llm['mfu']} < floor {LLM_MFU_FLOOR}")
+    if on_tpu and fedavg["mfu"] is not None and fedavg["mfu"] < FEDAVG_MFU_FLOOR:
+        violations.append(f"fedavg mfu {fedavg['mfu']} < floor {FEDAVG_MFU_FLOOR}")
+
     mfu = llm["mfu"]
     target = 0.35  # BASELINE.md MFU floor
     print(json.dumps({
@@ -181,6 +215,7 @@ def main():
         "value": mfu if mfu is not None else llm["tokens_per_sec_chip"],
         "unit": "MFU" if mfu is not None else "tokens/s/chip (MFU n/a off-TPU)",
         "vs_baseline": round(mfu / target, 3) if mfu is not None else 1.0,
+        "floor_violations": violations,
         "detail": {
             "device": llm.get("device"),
             "chip_peak_tflops": llm.get("chip_peak_tflops"),
@@ -188,6 +223,10 @@ def main():
             "fedavg_cifar10_resnet20": fedavg,
         },
     }))
+    if violations:
+        sys.stdout.flush()
+        print("BENCH FLOOR VIOLATION: " + "; ".join(violations), file=sys.stderr)
+        sys.exit(3)
 
 
 if __name__ == "__main__":

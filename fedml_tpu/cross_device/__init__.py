@@ -103,14 +103,21 @@ class ServerMNN(FedMLServerManager):
         super().handle_message_client_status(msg)
 
     def handle_message_receive_model(self, msg) -> None:
-        # ANY upload proves the device is alive (clears its strike counter) —
-        # but attendance credit is only granted for the round the upload
-        # belongs to, so a stale/duplicate upload can't shield a device that
-        # stayed silent THIS round from its missed-selection strike.
-        self.registry.note_participation(msg.get_sender_id())
+        # Attendance credit only for the current round (a stale duplicate
+        # can't shield a silent device from its missed-selection strike).
+        # Liveness is judged on a recency window: an upload for the current
+        # or immediately previous round proves the device alive (late-but-
+        # alive stragglers keep their strikes cleared), while an OLDER
+        # message — e.g. an MQTT at-least-once redelivery of a dead device's
+        # round-0 upload — is not evidence of life and must not reset the
+        # strike counter.
         with self._agg_lock:
-            if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) == self.round_idx:
+            up_round = msg.get(md.MSG_ARG_KEY_ROUND_INDEX)
+            if up_round == self.round_idx:
                 self._uploaded_this_round.add(msg.get_sender_id())
+            recent = up_round is not None and int(up_round) >= self.round_idx - 1
+        if recent:
+            self.registry.note_participation(msg.get_sender_id())
         super().handle_message_receive_model(msg)
 
     def _probe_async(self, device_ids: list[int]) -> None:

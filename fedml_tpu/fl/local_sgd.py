@@ -101,6 +101,11 @@ def make_local_train_fn(
     def local_train(variables: dict, x: jax.Array, y: jax.Array, count: jax.Array, key: jax.Array, ctx=None):
         params, rest = split_variables(variables)
         opt_state = opt.init(params)
+        # A stateless optimizer (plain SGD: no momentum/adam moments) lets
+        # step_mode=match masking ride a multiply on the updates instead of a
+        # 2-tree select: u*active is bit-identical to the select for a 0/1
+        # mask and fuses into the same FMA pass as apply_updates.
+        stateless_opt = not jax.tree_util.tree_leaves(opt_state)
         cap = x.shape[0]
         bsz = hp.batch_size
         spe = hp.steps_per_epoch
@@ -108,13 +113,26 @@ def make_local_train_fn(
         # per-client step budget (reference: epochs * ceil(len(local)/batch))
         own_steps = hp.epochs * ((count + bsz - 1) // bsz)
 
+        # Per-epoch permutations hoisted OUT of the step scan: the permutation
+        # is constant within an epoch, but recomputing it per step costs a
+        # cap-sized sort per client per step (sorts are multi-pass on TPU and
+        # showed up as real round time in scripts/profile_fedavg.py).  The
+        # flattened (epochs*cap,) table holds epoch e's permutation at offset
+        # e*cap, so each step slices its batch at epoch*cap + step*bsz.
+        all_perms = jax.vmap(
+            lambda e: jax.random.permutation(
+                jax.random.fold_in(jax.random.fold_in(key, e), 1), cap
+            )
+        )(jnp.arange(hp.epochs)).reshape(-1)
+
         def step(carry, s):
             params, rest, opt_state = carry
             epoch = s // spe
             step_in_epoch = s % spe
             ekey = jax.random.fold_in(key, epoch)
-            perm = jax.random.permutation(jax.random.fold_in(ekey, 1), cap)
-            idx = jax.lax.dynamic_slice_in_dim(perm, step_in_epoch * bsz, bsz)
+            idx = jax.lax.dynamic_slice_in_dim(
+                all_perms, epoch * cap + step_in_epoch * bsz, bsz
+            )
             bx = jnp.take(x, idx, axis=0)
             by = jnp.take(y, idx, axis=0)
             if batch_constraint is not None:
@@ -124,15 +142,22 @@ def make_local_train_fn(
             if grad_hook is not None:
                 grads = grad_hook(grads, ctx)
             updates, new_opt = opt.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
             if hp.step_mode == "match":
                 active = s < own_steps
-                new_params = _select_tree(active, new_params, params)
+                if stateless_opt:
+                    updates = jax.tree_util.tree_map(
+                        lambda u: u * active.astype(u.dtype), updates
+                    )
+                    new_params = optax.apply_updates(params, updates)
+                else:
+                    new_params = optax.apply_updates(params, updates)
+                    new_params = _select_tree(active, new_params, params)
+                    new_opt = _select_tree(active, new_opt, opt_state)
                 new_rest = _select_tree(active, new_rest, rest)
-                new_opt = _select_tree(active, new_opt, opt_state)
                 loss = jnp.where(active, loss, 0.0)
                 active_f = active.astype(jnp.float32)
             else:
+                new_params = optax.apply_updates(params, updates)
                 active_f = jnp.float32(1.0)
             return (new_params, new_rest, new_opt), (loss, active_f)
 

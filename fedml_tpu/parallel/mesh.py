@@ -76,6 +76,25 @@ def round_up(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
 
 
+def pad_leading_axis_np(tree, n_target: int):
+    """Zero-pad every leaf's leading axis to ``n_target`` rows (host-side).
+
+    The one place client-axis pad-row semantics live: pad rows are ZEROS
+    (zero-count dummies are never sampled, gathered for real lanes, or
+    scattered to — engine invariants), used both at stack build and at
+    checkpoint restore."""
+    import numpy as np
+
+    def pad(a):
+        a = np.asarray(a)
+        if n_target <= a.shape[0]:
+            return a
+        extra = np.zeros((n_target - a.shape[0],) + a.shape[1:], a.dtype)
+        return np.concatenate([a, extra])
+
+    return jax.tree_util.tree_map(pad, tree)
+
+
 def client_sharding(mesh: Mesh, axis: str = AXIS_CLIENTS) -> NamedSharding:
     """Sharding for arrays with a leading stacked-clients dimension."""
     return NamedSharding(mesh, P(axis))

@@ -93,11 +93,10 @@ class MeshSimulator(RoundCheckpointMixin):
         self._n_real = dataset.n_clients
         self._n_pad = meshlib.round_up(self._n_real, self._lane_multiple)
         if self._n_pad > self._n_real:
-            pad = self._n_pad - self._n_real
             stacked = StackedClientData(
-                x=np.concatenate([stacked.x, np.zeros((pad,) + stacked.x.shape[1:], stacked.x.dtype)]),
-                y=np.concatenate([stacked.y, np.zeros((pad,) + stacked.y.shape[1:], stacked.y.dtype)]),
-                counts=np.concatenate([stacked.counts, np.zeros(pad, stacked.counts.dtype)]),
+                x=meshlib.pad_leading_axis_np(stacked.x, self._n_pad),
+                y=meshlib.pad_leading_axis_np(stacked.y, self._n_pad),
+                counts=meshlib.pad_leading_axis_np(stacked.counts, self._n_pad),
             )
         self._data = self._place_data(stacked)
         self.counts = jnp.asarray(stacked.counts)
@@ -438,15 +437,7 @@ class MeshSimulator(RoundCheckpointMixin):
         # --random_seed silently changing the sampling stream mid-run)
         self.root_key = jnp.asarray(state["root_key"])
         if "client_states" in state:
-            cs = state["client_states"]
-            if self._n_pad > self._n_real:
-                pad = self._n_pad - self._n_real
-                cs = jax.tree_util.tree_map(
-                    lambda a: np.concatenate(
-                        [np.asarray(a), np.zeros((pad,) + a.shape[1:], np.asarray(a).dtype)]
-                    ),
-                    cs,
-                )
+            cs = meshlib.pad_leading_axis_np(state["client_states"], self._n_pad)
             self.client_states = meshlib.shard_leading_axis(cs, self.mesh)
         if "defense_history" in state:
             self.defense_history = jnp.asarray(state["defense_history"])

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Per-op attribution of the FedAvg round via the JAX profiler (round-4).
+
+Traces ONE production jitted round on the real chip, then aggregates the
+device events by hlo_category and by source line, reporting achieved TFLOP/s
+and GB/s per bucket — the evidence base for PERF.md's roofline ("what is the
+round actually spending its time and bandwidth on").
+
+Usage: python scripts/profile_trace.py   (on the TPU; writes /tmp/prof)
+"""
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def build_sim():
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.runner import FedMLRunner
+
+    n_clients, per_round, batch, spc = 128, 64, 128, 512
+    cfg = Config(
+        dataset="cifar10", model="resnet20", client_num_in_total=n_clients,
+        client_num_per_round=per_round, comm_round=50, epochs=1,
+        batch_size=batch, learning_rate=0.03, partition_method="homo",
+        synthetic_train_size=n_clients * spc, synthetic_test_size=1024,
+        frequency_of_the_test=0, compute_dtype="bfloat16", step_mode="match",
+        metrics_jsonl_path="",
+    )
+    fedml_tpu.init(cfg)
+    return FedMLRunner(cfg).runner
+
+
+def main():
+    sim = build_sim()
+
+    def run():
+        out = sim._round_fn(
+            sim.global_vars, sim.server_state, sim.client_states, sim.counts,
+            sim._data[0], sim._data[1], jnp.int32(1), sim.root_key,
+            sim.defense_history,
+        )
+        jax.block_until_ready(out)
+
+    run()  # compile + warm
+    os.makedirs("/tmp/prof", exist_ok=True)
+    with jax.profiler.trace("/tmp/prof"):
+        run()
+
+    latest = max(glob.glob("/tmp/prof/plugins/profile/*/"), key=os.path.getmtime)
+    trace_file = glob.glob(os.path.join(latest, "*.trace.json.gz"))[0]
+    with gzip.open(trace_file) as f:
+        tr = json.load(f)
+
+    pids = {e["pid"]: e["args"].get("name", "")
+            for e in tr.get("traceEvents", [])
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev_pids = {p for p, n in pids.items() if "TPU" in n or "device" in n.lower()}
+
+    cat = collections.defaultdict(lambda: [0, 0, 0, 0])   # ps, flops, bytes, n
+    src = collections.defaultdict(lambda: [0, 0, 0, 0])
+    for e in tr.get("traceEvents", []):
+        a = e.get("args") or {}
+        if e.get("ph") == "X" and e.get("pid") in dev_pids and "hlo_category" in a:
+            c = a["hlo_category"]
+            if c == "while":
+                continue
+            d = int(a.get("device_duration_ps", 0))
+            fl = int(a.get("model_flops", 0) or 0)
+            by = int(a.get("raw_bytes_accessed", 0) or 0)
+            for bucket, key in ((cat, c), (src, a.get("source", "?"))):
+                bucket[key][0] += d
+                bucket[key][1] += fl
+                bucket[key][2] += by
+                bucket[key][3] += 1
+
+    def rows(bucket, top):
+        out = []
+        for k, (d, fl, by, n) in sorted(bucket.items(), key=lambda kv: -kv[1][0])[:top]:
+            out.append({
+                "key": k, "ms": round(d / 1e9, 2), "n": n,
+                "tflops": round(fl / (d / 1e12) / 1e12, 2) if d else 0,
+                "gbps": round(by / (d / 1e12) / 1e9, 1) if d else 0,
+            })
+        return out
+
+    print("TRACE " + json.dumps({
+        "total_ms": round(sum(v[0] for v in cat.values()) / 1e9, 1),
+        "by_category": rows(cat, 8),
+        "by_source": rows(src, 12),
+    }))
+
+
+if __name__ == "__main__":
+    main()
