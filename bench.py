@@ -73,10 +73,10 @@ def bench_fedavg(peak):
     # Ceilings so the raw number is self-interpreting (PERF.md roofline):
     # - lane ceiling 0.214: analytic FLOP-weighted MXU output-lane bound for
     #   ResNet-20's 16/32/64 channels on the 128-wide systolic array.
-    # - attainable 0.150: per-op-trace measured bound — the conv fusions run
-    #   at 0.163 MFU (= their im2col matmul equivalent, 71% of HBM bandwidth)
-    #   and 82% of round time; mandatory BN/relu/residual second passes are
-    #   the rest.  See PERF.md "Where the remaining time goes".
+    # - attainable 0.150: trace-derived estimate — the conv fusions run at
+    #   0.163 MFU while sustaining 71% of HBM bandwidth (82% of round time);
+    #   mandatory BN/relu/residual second passes account for the rest.
+    #   See PERF.md "Per-op attribution".
     lane_ceiling, attainable = 0.214, 0.150
     return {
         "samples_per_sec_chip": round(sps_chip, 1),

@@ -78,7 +78,38 @@ class FedMLCommManager(Observer):
         if b == C.COMM_BACKEND_MQTT_S3:
             from .mqtt_s3 import MqttS3CommManager
 
-            return MqttS3CommManager(getattr(self.cfg, "run_id", "0"), self.rank)
+            extra = getattr(self.cfg, "extra", {}) or {}
+            broker = store = None
+            if extra.get("mqtt_host"):
+                # real MQTT over TCP (in-repo MiniMqttBroker or any external
+                # 3.1.1 broker); payloads ride the HTTP object store when one
+                # is configured (reference: broker + S3, run_cross_silo.sh)
+                from .mqtt_real import TcpMqttBroker
+
+                run_id = getattr(self.cfg, "run_id", "0")
+                broker = TcpMqttBroker(
+                    extra["mqtt_host"], int(extra.get("mqtt_port", 1883)),
+                    client_id=f"{run_id}_{self.rank}",
+                )
+                if not extra.get("object_store_url"):
+                    # a cross-process broker with the per-process in-memory
+                    # store would strand every >8KB payload: the sender
+                    # offloads to ITS store and the receiver can't resolve
+                    # the key.  Small control messages would work, so the
+                    # misconfiguration only explodes at the first model
+                    # broadcast — refuse up front instead.
+                    raise ValueError(
+                        "extra.mqtt_host is set but extra.object_store_url is "
+                        "not; a real broker needs a shared payload store "
+                        "(comm.object_store_http.MiniObjectStoreServer or S3)"
+                    )
+                from .object_store_http import HttpObjectStore
+
+                store = HttpObjectStore(extra["object_store_url"])
+            return MqttS3CommManager(
+                getattr(self.cfg, "run_id", "0"), self.rank,
+                broker=broker, store=store,
+            )
         if b in (C.COMM_BACKEND_WEB3, C.COMM_BACKEND_THETA):
             from .blockchain import BlockchainCommManager
 

@@ -108,6 +108,46 @@ class PahoMqttBroker:
             self._connected = False
 
 
+class TcpMqttBroker:
+    """The ``InMemoryBroker`` interface over a REAL MQTT 3.1.1 TCP session
+    (:class:`~fedml_tpu.comm.mqtt_wire.SocketMqttClient` — stdlib sockets,
+    no fakes).  Same lazy-connect + will-before-connect contract as
+    :class:`PahoMqttBroker`; reconnect/re-subscribe is handled inside the
+    wire client (clean-session replay)."""
+
+    def __init__(self, host: str, port: int, client_id: str,
+                 keepalive: float = 30.0):
+        from .mqtt_wire import SocketMqttClient
+
+        self._client = SocketMqttClient(host, port, client_id, keepalive=keepalive)
+        self._connected = False
+        self._lock = threading.Lock()
+
+    def _ensure_connected(self) -> None:
+        with self._lock:
+            if not self._connected:
+                self._client.connect()
+                self._connected = True
+
+    # -- InMemoryBroker interface -------------------------------------------
+    def publish(self, topic: str, payload: bytes) -> None:
+        self._ensure_connected()
+        self._client.publish(topic, payload, qos=1)
+
+    def subscribe(self, topic: str, cb: Callable[[str, bytes], None]) -> None:
+        self._client.subscribe(topic, cb)
+        self._ensure_connected()
+
+    def set_will(self, client_id: str, topic: str, payload: bytes) -> None:
+        self._client.will_set(topic, payload, qos=1)
+
+    def disconnect(self) -> None:
+        with self._lock:
+            if self._connected:
+                self._client.disconnect()
+                self._connected = False
+
+
 class S3ObjectStore:
     """boto3-backed implementation of the InMemoryObjectStore interface
     (reference ``remote_storage.py`` S3 upload/download of model payloads)."""

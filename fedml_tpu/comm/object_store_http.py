@@ -1,0 +1,96 @@
+"""Minimal HTTP object store (the in-repo S3 role) + its client.
+
+The reference offloads large payloads to real S3
+(``core/distributed/communication/mqtt_s3/remote_storage.py``); this build
+has zero egress, so the same control/payload split is proven against an
+in-repo HTTP store speaking real sockets: PUT stores bytes, GET returns
+them — the minimal surface ``MqttS3CommManager`` needs from its store.
+boto3-backed :class:`~fedml_tpu.comm.mqtt_real.S3ObjectStore` keeps the
+same interface for real deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class MiniObjectStoreServer:
+    """Threaded HTTP store: ``PUT /key`` -> 200, ``GET /key`` -> bytes/404."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self._blobs: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def start(self) -> int:
+        blobs, lock = self._blobs, self._lock
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence per-request stderr noise
+                pass
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(n)
+                with lock:
+                    blobs[self.path.lstrip("/")] = data
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                with lock:
+                    data = blobs.get(self.path.lstrip("/"))
+                if data is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+class HttpObjectStore:
+    """Client side of :class:`MiniObjectStoreServer` — the
+    ``InMemoryObjectStore`` interface (``put``/``get``) over real HTTP."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def put(self, key: str, data: bytes) -> str:
+        req = urllib.request.Request(
+            f"{self.base_url}/{key}", data=data, method="PUT",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            if r.status != 200:
+                raise RuntimeError(f"object store PUT {key} -> {r.status}")
+        return key
+
+    def get(self, key: str) -> bytes:
+        with urllib.request.urlopen(
+            f"{self.base_url}/{key}", timeout=self.timeout
+        ) as r:
+            return r.read()

@@ -115,7 +115,10 @@ class ServerMNN(FedMLServerManager):
             up_round = msg.get(md.MSG_ARG_KEY_ROUND_INDEX)
             if up_round == self.round_idx:
                 self._uploaded_this_round.add(msg.get_sender_id())
-            recent = up_round is not None and int(up_round) >= self.round_idx - 1
+            try:  # a malformed/hostile ROUND_INDEX must not kill the handler
+                recent = up_round is not None and int(up_round) >= self.round_idx - 1
+            except (TypeError, ValueError):
+                recent = False
         if recent:
             self.registry.note_participation(msg.get_sender_id())
         super().handle_message_receive_model(msg)

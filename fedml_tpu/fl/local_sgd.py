@@ -130,9 +130,12 @@ def make_local_train_fn(
             epoch = s // spe
             step_in_epoch = s % spe
             ekey = jax.random.fold_in(key, epoch)
-            idx = jax.lax.dynamic_slice_in_dim(
-                all_perms, epoch * cap + step_in_epoch * bsz, bsz
-            )
+            # clamp the slice start inside the epoch's own block — the old
+            # per-epoch dynamic_slice clamped at cap-bsz, and when cap is not
+            # a batch multiple an unclamped flat offset would read into the
+            # NEXT epoch's permutation
+            start = jnp.minimum(step_in_epoch * bsz, cap - bsz)
+            idx = jax.lax.dynamic_slice_in_dim(all_perms, epoch * cap + start, bsz)
             bx = jnp.take(x, idx, axis=0)
             by = jnp.take(y, idx, axis=0)
             if batch_constraint is not None:

@@ -9,7 +9,12 @@ the whole chain.  Per-op time = chain time / reps.
 
 Times, for each ResNet-20 stage shape at n=64 clients x batch 128:
   conv_g    — grouped conv (feature_group_count=n): the vmapped-model form
-  mm_eq     — im2col-equivalent batched matmul (the lane-ceiling form)
+  mm_eq     — batched matmul over im2col-SHAPED operands.  NOTE: this
+              materializes the (M, 9*cin) patch matrix, i.e. 9x the input
+              traffic of a direct conv, and uses square K=N=9*cin (chain
+              shape stability) — a reference point for the im2col-matmul
+              bandwidth regime, NOT a lane-equivalent conv ceiling.  The
+              ceiling argument lives in PERF.md (trace rate + roofline).
   bn_relu   — conv_g + train-mode batch-norm + relu (the fused stage cost)
 """
 import json
