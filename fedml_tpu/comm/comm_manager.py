@@ -79,6 +79,7 @@ class FedMLCommManager(Observer):
             from .mqtt_s3 import MqttS3CommManager
 
             extra = getattr(self.cfg, "extra", {}) or {}
+            run_id = getattr(self.cfg, "run_id", "0")
             broker = store = None
             if extra.get("mqtt_host"):
                 # real MQTT over TCP (in-repo MiniMqttBroker or any external
@@ -86,7 +87,6 @@ class FedMLCommManager(Observer):
                 # is configured (reference: broker + S3, run_cross_silo.sh)
                 from .mqtt_real import TcpMqttBroker
 
-                run_id = getattr(self.cfg, "run_id", "0")
                 broker = TcpMqttBroker(
                     extra["mqtt_host"], int(extra.get("mqtt_port", 1883)),
                     client_id=f"{run_id}_{self.rank}",
@@ -107,7 +107,7 @@ class FedMLCommManager(Observer):
 
                 store = HttpObjectStore(extra["object_store_url"])
             return MqttS3CommManager(
-                getattr(self.cfg, "run_id", "0"), self.rank,
+                run_id, self.rank,
                 broker=broker, store=store,
             )
         if b in (C.COMM_BACKEND_WEB3, C.COMM_BACKEND_THETA):

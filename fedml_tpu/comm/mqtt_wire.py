@@ -338,6 +338,11 @@ class SocketMqttClient:
         self._acks: dict[int, threading.Event] = {}
         self._connected = threading.Event()
         self._stopping = False
+        # connection generation: each connect() bumps it, and reader/ping
+        # threads exit when their generation is stale — a re-connect after
+        # disconnect() must not revive the OLD threads (they would clobber
+        # _connected and dial a competing session under the same client id)
+        self._gen = 0
         self.reconnects = 0
 
     # -- lifecycle -----------------------------------------------------------
@@ -349,9 +354,11 @@ class SocketMqttClient:
         # lazy-connect contract); clear the stop flag or the fresh reader and
         # ping threads would exit immediately and PUBACKs would never arrive
         self._stopping = False
+        self._gen += 1
+        gen = self._gen
         self._do_connect()
-        threading.Thread(target=self._reader_loop, daemon=True).start()
-        threading.Thread(target=self._ping_loop, daemon=True).start()
+        threading.Thread(target=self._reader_loop, args=(gen,), daemon=True).start()
+        threading.Thread(target=self._ping_loop, args=(gen,), daemon=True).start()
 
     def _do_connect(self) -> None:
         sock = socket.create_connection((self.host, self.port), timeout=10)
@@ -401,8 +408,8 @@ class SocketMqttClient:
         self._sock = None
 
     # -- io loops ------------------------------------------------------------
-    def _reader_loop(self) -> None:
-        while not self._stopping:
+    def _reader_loop(self, gen: int) -> None:
+        while not self._stopping and gen == self._gen:
             sock = self._sock
             if sock is None or not self._connected.is_set():
                 time.sleep(0.01)
@@ -410,10 +417,10 @@ class SocketMqttClient:
             try:
                 ptype, flags, body = _read_packet(sock)
             except (ConnectionError, OSError, ValueError):
-                if self._stopping:
-                    return
+                if self._stopping or gen != self._gen:
+                    return  # retired generation: a newer connect() owns state
                 self._connected.clear()
-                self._reconnect()
+                self._reconnect(gen)
                 continue
             if ptype == PUBLISH:
                 self._handle_publish(flags, body)
@@ -427,8 +434,8 @@ class SocketMqttClient:
             else:
                 log.warning("client %s: unexpected packet type %d", self.client_id, ptype)
 
-    def _reconnect(self) -> None:
-        while not self._stopping:
+    def _reconnect(self, gen: int) -> None:
+        while not self._stopping and gen == self._gen:
             time.sleep(self.reconnect_delay)
             try:
                 self._do_connect()
@@ -437,11 +444,11 @@ class SocketMqttClient:
             except OSError as e:
                 log.debug("client %s reconnect failed: %s", self.client_id, e)
 
-    def _ping_loop(self) -> None:
+    def _ping_loop(self, gen: int) -> None:
         interval = max(self.keepalive / 2.0, 0.5)
-        while not self._stopping:
+        while not self._stopping and gen == self._gen:
             time.sleep(interval)
-            if self._connected.is_set():
+            if self._connected.is_set() and gen == self._gen:
                 try:
                     self._send(_packet(PINGREQ, 0, b""))
                 except OSError:
@@ -492,21 +499,28 @@ class SocketMqttClient:
         for attempt in (0, 1):
             if not self._connected.wait(timeout):
                 raise TimeoutError(f"client {self.client_id}: not connected")
-            try:
-                if qos == 0:
+            if qos == 0:
+                try:
                     self._send(_packet(PUBLISH, 0, _enc_str(topic) + payload))
                     return
-                with self._wlock:
-                    pid = self._next_pid
-                    self._next_pid = pid % 65535 + 1
-                ev = threading.Event()
-                self._acks[pid] = ev
+                except OSError:
+                    continue  # reader loop reconnects; one retry
+            with self._wlock:
+                pid = self._next_pid
+                self._next_pid = pid % 65535 + 1
+            ev = threading.Event()
+            self._acks[pid] = ev
+            try:
                 dup = 0x08 if attempt else 0
                 body = _enc_str(topic) + struct.pack(">H", pid) + payload
                 self._send(_packet(PUBLISH, dup | 0x02, body))
                 if ev.wait(timeout):
                     return
-                self._acks.pop(pid, None)
             except OSError:
                 pass  # fall through to the retry (reader loop reconnects)
+            finally:
+                # always retire the pending entry: a stranded Event would leak
+                # per failed publish, and after the pid wrap a fresh PUBACK
+                # could route to a stale entry
+                self._acks.pop(pid, None)
         raise TimeoutError(f"client {self.client_id}: no PUBACK for {topic}")

@@ -100,6 +100,14 @@ def make_local_train_fn(
 
     def local_train(variables: dict, x: jax.Array, y: jax.Array, count: jax.Array, key: jax.Array, ctx=None):
         params, rest = split_variables(variables)
+        if x.shape[0] < hp.batch_size:
+            # the old per-epoch dynamic_slice rejected this at trace time
+            # (slice size > dim); keep the refusal explicit
+            raise ValueError(
+                f"client shard capacity {x.shape[0]} is smaller than "
+                f"batch_size {hp.batch_size}; pad the shard (stack_clients "
+                "with multiple_of=batch_size) or lower the batch size"
+            )
         opt_state = opt.init(params)
         # A stateless optimizer (plain SGD: no momentum/adam moments) lets
         # step_mode=match masking ride a multiply on the updates instead of a
@@ -133,7 +141,7 @@ def make_local_train_fn(
             # clamp the slice start inside the epoch's own block — the old
             # per-epoch dynamic_slice clamped at cap-bsz, and when cap is not
             # a batch multiple an unclamped flat offset would read into the
-            # NEXT epoch's permutation
+            # NEXT epoch's permutation (cap >= bsz is asserted above)
             start = jnp.minimum(step_in_epoch * bsz, cap - bsz)
             idx = jax.lax.dynamic_slice_in_dim(all_perms, epoch * cap + start, bsz)
             bx = jnp.take(x, idx, axis=0)

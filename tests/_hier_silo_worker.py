@@ -1,0 +1,144 @@
+"""Worker entry for the hierarchical cross-silo e2e test (spawned by
+tests/test_hier_silo.py).  Usage:
+
+    python tests/_hier_silo_worker.py <role> <tcp_base_port> <coord_port>
+
+Roles (the full reference stack shape, SURVEY.md §3.3 /
+``cross_silo/client/client_launcher.py:46``):
+
+  server — FL server over TCP (rank 0); waits for both client listeners
+           before starting; prints MULTIHOST_RESULT with the final global
+           checksum.
+  silo1  — plain single-process silo (rank 1) over TCP.
+  siloA  — silo-2 MASTER (rank 2) over TCP; its local SGD spans 2 processes
+           via jax.distributed (4+4 virtual CPU devices, global data mesh).
+  siloB  — silo-2 follower: no FL transport, lockstep collective training
+           until the master's CMD_FINISH.
+"""
+
+import json
+import os
+import socket
+import sys
+import time
+
+
+def main():
+    role, base_port, coord_port = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+
+    dist = role in ("siloA", "siloB")
+    cfg = Config(
+        training_type="cross_silo",
+        dataset="synthetic",
+        model="lr",
+        client_num_in_total=2,
+        client_num_per_round=2,
+        comm_round=2,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.1,
+        synthetic_train_size=256,
+        synthetic_test_size=64,
+        partition_method="homo",
+        frequency_of_the_test=1,
+        compute_dtype="float32",
+        random_seed=0,
+        backend="TCP",
+        extra={
+            "tcp_base_port": base_port,
+            **({"coordinator_address": f"localhost:{coord_port}",
+                "num_processes": 2,
+                "process_id": 0 if role == "siloA" else 1} if dist else {}),
+        },
+    )
+    fedml_tpu.init(cfg)
+    if dist:
+        from fedml_tpu.parallel import multihost
+
+        multihost.ensure_initialized(cfg)
+        assert jax.process_count() == 2
+
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+
+    if role == "server":
+        from fedml_tpu.cross_silo import build_server
+
+        # both client listeners must be up before the status broadcast (the
+        # TCP transport has no retry; probe exactly as the transport connects)
+        for rank in (1, 2):
+            deadline = time.time() + 120
+            while True:
+                try:
+                    socket.create_connection(("127.0.0.1", base_port + rank), timeout=1).close()
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise RuntimeError(f"client rank {rank} never listened")
+                    time.sleep(0.2)
+        server = build_server(cfg, ds, model, backend="TCP")
+        history = server.run_until_done(timeout=240.0)
+        flat = np.concatenate([
+            np.asarray(l, dtype=np.float64).ravel()
+            for l in jax.tree_util.tree_leaves(jax.device_get(server.aggregator.global_vars))
+        ])
+        print("MULTIHOST_RESULT " + json.dumps({
+            "role": role,
+            "rounds": len(history),
+            "checksum": float(flat.sum()),
+            "l2": float(np.sqrt((flat ** 2).sum())),
+            "test_acc": history[-1].get("test_acc"),
+        }), flush=True)
+        return
+
+    if role == "silo1":
+        from fedml_tpu.cross_silo import build_client
+
+        client = build_client(cfg, ds, model, rank=1, backend="TCP")
+        client.run_in_thread()
+        assert client.done.wait(timeout=240.0), "silo1 never saw FINISH"
+        print("MULTIHOST_RESULT " + json.dumps({"role": role, "done": True}), flush=True)
+        return
+
+    ix = ds.client_idx[1]  # silo 2's shard for both of its processes
+    x, y = ds.train_x[ix], ds.train_y[ix]
+
+    if role == "siloA":
+        from fedml_tpu.cross_silo.client import ClientMasterManager
+        from fedml_tpu.cross_silo.silo_dist import DistributedSiloTrainer
+
+        trainer = DistributedSiloTrainer(cfg, model, x, y)
+        client = ClientMasterManager(cfg, trainer, rank=2, backend="TCP")
+        client.run_in_thread()
+        assert client.done.wait(timeout=240.0), "siloA never saw FINISH"
+        print("MULTIHOST_RESULT " + json.dumps(
+            {"role": role, "rounds": client.rounds_trained}), flush=True)
+        return
+
+    if role == "siloB":
+        from fedml_tpu.cross_silo.silo_dist import run_silo_follower
+
+        rounds = run_silo_follower(cfg, model, x, y)
+        print("MULTIHOST_RESULT " + json.dumps({"role": role, "rounds": rounds}), flush=True)
+        return
+
+    raise SystemExit(f"unknown role {role!r}")
+
+
+if __name__ == "__main__":
+    main()

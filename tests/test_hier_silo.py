@@ -1,0 +1,101 @@
+"""Hierarchical cross-silo end-to-end over a real transport (round-3 verdict
+item 4): one FL server + 2 silos over TCP, one silo spanning 2 OS processes
+via jax.distributed — the full reference stack shape
+(``cross_silo/client/client_launcher.py:46``,
+``fedml_client_master_manager.py:200-212``) in one test, with numerics
+parity against the flat single-process cross-silo run.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _flat_reference():
+    """The identical FL run, flat: server + 2 plain clients, one process."""
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    from .conftest import tiny_config
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=2, client_num_per_round=2,
+        comm_round=2, batch_size=16, synthetic_train_size=256,
+        synthetic_test_size=64, frequency_of_the_test=1, run_id="hier-ref",
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("hier-ref")
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC") for r in (1, 2)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    try:
+        history = server.run_until_done(timeout=180.0)
+    finally:
+        for c in clients:
+            c.finish()
+    flat = np.concatenate([
+        np.asarray(l, dtype=np.float64).ravel()
+        for l in jax.tree_util.tree_leaves(jax.device_get(server.aggregator.global_vars))
+    ])
+    return float(flat.sum()), float(np.sqrt((flat ** 2).sum())), history[-1].get("test_acc")
+
+
+def test_hierarchical_silo_over_tcp_matches_flat(eight_devices):
+    base_port, coord_port = _free_port(), _free_port()
+    worker = os.path.join(_REPO, "tests", "_hier_silo_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_PLATFORM_NAME")}
+
+    def spawn(role):
+        return subprocess.Popen(
+            [sys.executable, worker, role, str(base_port), str(coord_port)],
+            env=env, cwd=_REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
+    # clients first (TCP listeners bind at construction); the server worker
+    # itself waits for both listeners before broadcasting status checks
+    procs = {r: spawn(r) for r in ("silo1", "siloA", "siloB", "server")}
+    outs = {}
+    for role, p in procs.items():
+        out, _ = p.communicate(timeout=420)
+        outs[role] = out
+        assert p.returncode == 0, f"{role}:\n{out[-3000:]}"
+
+    results = {}
+    for role, out in outs.items():
+        for line in out.splitlines():
+            if line.startswith("MULTIHOST_RESULT "):
+                results[role] = json.loads(line[len("MULTIHOST_RESULT "):])
+    assert set(results) == {"server", "silo1", "siloA", "siloB"}, outs["server"][-2000:]
+
+    assert results["server"]["rounds"] == 2
+    assert results["silo1"]["done"] is True
+    assert results["siloA"]["rounds"] == 2          # silo master trained each round
+    assert results["siloB"]["rounds"] == 2          # follower joined every collective
+
+    ref_sum, ref_l2, ref_acc = _flat_reference()
+    assert results["server"]["checksum"] == pytest.approx(ref_sum, rel=1e-5, abs=1e-5)
+    assert results["server"]["l2"] == pytest.approx(ref_l2, rel=1e-5, abs=1e-5)
+    assert results["server"]["test_acc"] == pytest.approx(ref_acc, abs=1e-6)
