@@ -55,7 +55,12 @@ def cmd_build(args) -> int:
 def cmd_agent(args) -> int:
     from fedml_tpu.sched.agent import FedMLAgent
 
-    agent = FedMLAgent(args.spool)
+    capacity = {"num_devices": args.num_devices}
+    if args.device_type:
+        capacity["device_type"] = args.device_type
+    if args.mem_gb:
+        capacity["mem_gb"] = args.mem_gb
+    agent = FedMLAgent(args.spool, agent_id=args.agent_id, capacity=capacity)
     print(f"agent watching {args.spool}", file=sys.stderr)
     try:
         agent.run_forever(poll_s=args.poll)
@@ -358,6 +363,13 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("agent", help="start a worker agent on the spool")
     p.add_argument("--poll", type=float, default=0.5)
+    p.add_argument("--agent-id", default="", help="stable agent id (default: agent_<pid>)")
+    p.add_argument("--num-devices", type=int, default=1,
+                   help="devices this agent offers (matched against job computing.minimum_num_gpus)")
+    p.add_argument("--device-type", default="",
+                   help="device type label (matched against computing.request_gpu_type)")
+    p.add_argument("--mem-gb", type=float, default=0,
+                   help="memory capacity in GB (0 = unlimited)")
     p.set_defaults(fn=cmd_agent)
 
     p = sub.add_parser("jobs", help="list job statuses")

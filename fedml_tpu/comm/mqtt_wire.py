@@ -351,11 +351,14 @@ class SocketMqttClient:
 
     def connect(self) -> None:
         # a client may be re-connected after disconnect() (the adapter's
-        # lazy-connect contract); clear the stop flag or the fresh reader and
-        # ping threads would exit immediately and PUBACKs would never arrive
-        self._stopping = False
+        # lazy-connect contract).  Order matters: retire the old generation
+        # BEFORE clearing the stop flag — the other way round, a parked old
+        # reader could pass both loop guards in the window between the two
+        # writes and attach to the new socket (two readers on one socket
+        # interleave partial reads and corrupt the framing).
         self._gen += 1
         gen = self._gen
+        self._stopping = False
         self._do_connect()
         threading.Thread(target=self._reader_loop, args=(gen,), daemon=True).start()
         threading.Thread(target=self._ping_loop, args=(gen,), daemon=True).start()
@@ -437,6 +440,11 @@ class SocketMqttClient:
     def _reconnect(self, gen: int) -> None:
         while not self._stopping and gen == self._gen:
             time.sleep(self.reconnect_delay)
+            # re-check AFTER the sleep: a disconnect()+connect() during the
+            # delay owns the state now — dialing here would open a second
+            # session under the same client id and get both kicked
+            if self._stopping or gen != self._gen:
+                return
             try:
                 self._do_connect()
                 self.reconnects += 1

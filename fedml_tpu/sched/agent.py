@@ -61,24 +61,84 @@ class JobDB:
             return [dict(r) for r in c.execute("SELECT * FROM jobs")]
 
 
-class FedMLAgent:
-    """One worker agent bound to a spool directory."""
+def parse_requirements(computing: Optional[dict]) -> tuple[int, str, float]:
+    """The job ``computing`` contract, in ONE place (agent claim check and
+    spool matcher must agree): (devices, device type, min memory GB)."""
+    comp = computing or {}
+    return (
+        int(comp.get("minimum_num_gpus", 1)),
+        str(comp.get("request_gpu_type", "") or ""),
+        float(comp.get("minimum_memory_gb", 0) or 0),
+    )
 
-    def __init__(self, spool_dir: str, env: Optional[dict] = None):
+
+def satisfies(req: tuple[int, str, float], capacity: dict, free_devices: int) -> bool:
+    """Can an agent with ``capacity`` and ``free_devices`` run ``req`` now?"""
+    need_dev, need_type, need_mem = req
+    if need_dev > free_devices:
+        return False
+    if need_type and need_type != str(capacity.get("device_type", "")):
+        return False
+    if need_mem > float(capacity.get("mem_gb", float("inf"))):
+        return False
+    return True
+
+
+class FedMLAgent:
+    """One worker agent bound to a spool directory.
+
+    ``capacity`` registers what this agent can run (reference: edges report
+    their resources and ``scheduler_matcher.py:6`` matches requests against
+    them): ``num_devices``, ``device_type``, ``mem_gb``.  The agent writes a
+    heartbeat record into ``spool/agents/<id>.json`` every sweep and only
+    claims packages whose ``computing`` requirements it satisfies with its
+    currently-free devices — an oversized job stays queued for a bigger
+    agent instead of being grabbed by whoever polls first."""
+
+    def __init__(self, spool_dir: str, env: Optional[dict] = None,
+                 agent_id: str = "", capacity: Optional[dict] = None):
         self.spool = Path(spool_dir)
         self.queue = self.spool / "queue"
         self.runs = self.spool / "runs"
+        self.agents_dir = self.spool / "agents"
         self.queue.mkdir(parents=True, exist_ok=True)
         self.runs.mkdir(parents=True, exist_ok=True)
+        self.agents_dir.mkdir(parents=True, exist_ok=True)
         self.db = JobDB(str(self.spool / "jobs.sqlite"))
         self.env = env
+        self.agent_id = agent_id or f"agent_{os.getpid()}"
+        self.capacity = dict(capacity or {"num_devices": 1})
         self._procs: dict[str, subprocess.Popen] = {}
+        self._alloc: dict[str, int] = {}  # run_id -> devices held
         self._running = False
+        self._register()
+
+    # -- capacity registration / matching ------------------------------------
+    def _register(self) -> None:
+        record = {
+            "id": self.agent_id,
+            **self.capacity,
+            "free_devices": self.free_devices(),
+            "running": sorted(self._alloc),
+            "heartbeat": time.time(),
+        }
+        tmp = self.agents_dir / f".{self.agent_id}.tmp"
+        tmp.write_text(json.dumps(record))
+        tmp.replace(self.agents_dir / f"{self.agent_id}.json")
+
+    def free_devices(self) -> int:
+        return int(self.capacity.get("num_devices", 1)) - sum(self._alloc.values())
+
+    def fits(self, manifest: dict) -> bool:
+        """Does this agent currently satisfy the job's computing section?"""
+        return satisfies(parse_requirements(manifest.get("computing")),
+                         self.capacity, self.free_devices())
 
     # -- package pipeline (reference run_impl :480) --------------------------
-    def process_package(self, pkg: Path) -> str:
+    def process_package(self, pkg: Path, manifest: Optional[dict] = None) -> str:
         with zipfile.ZipFile(pkg) as z:
-            manifest = json.loads(z.read("__fedml_job__.json"))
+            if manifest is None:
+                manifest = json.loads(z.read("__fedml_job__.json"))
             run_id = manifest["run_id"]
             run_dir = self.runs / run_id
             run_dir.mkdir(parents=True, exist_ok=True)
@@ -104,6 +164,7 @@ class FedMLAgent:
             manifest["job"], shell=True, cwd=run_dir, stdout=logf, stderr=logf, env=env
         )
         self._procs[run_id] = proc
+        self._alloc[run_id] = parse_requirements(manifest.get("computing"))[0]
         self.db.upsert(run_id, status="RUNNING", pid=proc.pid, started=time.time())
         return run_id
 
@@ -113,9 +174,16 @@ class FedMLAgent:
         claimed = []
         for pkg in sorted(self.queue.glob("*.zip")):
             try:
-                claimed.append(self.process_package(pkg))
+                with zipfile.ZipFile(pkg) as z:
+                    manifest = json.loads(z.read("__fedml_job__.json"))
+            except (FileNotFoundError, zipfile.BadZipFile, KeyError):
+                continue  # claimed by another agent / still being written
+            if not self.fits(manifest):
+                continue  # stays queued for an agent that satisfies it
+            try:
+                claimed.append(self.process_package(pkg, manifest=manifest))
             except FileNotFoundError:
-                continue  # another agent claimed it
+                continue  # another agent claimed it between check and claim
         for run_id, proc in list(self._procs.items()):
             rc = proc.poll()
             if rc is not None:
@@ -125,6 +193,8 @@ class FedMLAgent:
                     returncode=rc, finished=time.time(),
                 )
                 del self._procs[run_id]
+                self._alloc.pop(run_id, None)  # free the devices
+        self._register()  # heartbeat + free-capacity refresh
         return claimed
 
     def run_forever(self, poll_s: float = 0.5) -> None:
@@ -163,16 +233,36 @@ class FedMLAgent:
 
 
 def match_resources(jobs: list[dict], agents: list[dict]) -> dict[str, str]:
-    """Minimal scheduler matcher (reference ``scheduler_matcher.py:6``): match
-    each job's requested device count against agents' free devices,
-    first-fit decreasing."""
+    """Scheduler matcher (reference ``scheduler_matcher.py:6``): assign each
+    job to an agent satisfying its ``computing`` section — device count
+    against free devices, requested device type exact-match, minimum memory —
+    first-fit decreasing on device demand.  Unmatchable jobs are absent from
+    the result (they stay queued)."""
     assignment: dict[str, str] = {}
-    free = {a["id"]: int(a.get("num_devices", 1)) for a in agents}
-    for job in sorted(jobs, key=lambda j: -int(j.get("computing", {}).get("minimum_num_gpus", 1))):
-        need = int(job.get("computing", {}).get("minimum_num_gpus", 1))
+    free = {a["id"]: int(a.get("free_devices", a.get("num_devices", 1))) for a in agents}
+    info = {a["id"]: a for a in agents}
+    reqs = {j["run_id"]: parse_requirements(j.get("computing")) for j in jobs}
+    for job in sorted(jobs, key=lambda j: -reqs[j["run_id"]][0]):
+        req = reqs[job["run_id"]]
         for aid, avail in sorted(free.items(), key=lambda kv: -kv[1]):
-            if avail >= need:
+            if satisfies(req, info[aid], avail):
                 assignment[job["run_id"]] = aid
-                free[aid] -= need
+                free[aid] -= req[0]
                 break
     return assignment
+
+
+def registered_agents(spool_dir: str, max_age_s: float = 60.0) -> list[dict]:
+    """Read live agent capacity records from ``spool/agents/`` (stale
+    heartbeats are dropped — a dead agent must not attract assignments)."""
+    out = []
+    agents_dir = Path(spool_dir) / "agents"
+    now = time.time()
+    for p in sorted(agents_dir.glob("*.json")):
+        try:
+            rec = json.loads(p.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if now - float(rec.get("heartbeat", 0)) <= max_age_s:
+            out.append(rec)
+    return out

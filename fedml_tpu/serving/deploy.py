@@ -254,44 +254,77 @@ def probe_ready(port: int, timeout: float = 1.0) -> bool:
         return False
 
 
-class ReplicaHandler:
-    """Spawns/stops one replica process (reference device_replica_handler)."""
+class ReplicaRuntime:
+    """The runtime seam: HOW a replica executes (reference
+    ``device_model_deployment.py``'s role — there a docker/triton container,
+    here a subprocess by default).  The scheduler/controller above this
+    interface is runtime-agnostic: a container implementation plugs in by
+    injecting another ReplicaRuntime into :class:`ModelDeployScheduler`.
 
-    def __init__(self, card: ModelCard):
-        self.card = card
+    Handles are opaque to the scheduler; it only ever passes them back into
+    this interface."""
 
-    def start(self) -> tuple[subprocess.Popen, int]:
+    def start(self, card: ModelCard) -> tuple[object, int]:
+        """Launch one replica of ``card``; return (handle, http_port)."""
+        raise NotImplementedError
+
+    def stop(self, handle) -> None:
+        raise NotImplementedError
+
+    def poll(self, handle) -> Optional[int]:
+        """None while running; the exit code once the replica died."""
+        raise NotImplementedError
+
+    def replica_id(self, handle) -> int:
+        """Stable numeric id for the DB row (pid / container number)."""
+        raise NotImplementedError
+
+
+class ProcessReplicaRuntime(ReplicaRuntime):
+    """Default runtime: one ``serving.worker`` subprocess per replica
+    (reference device_replica_handler's spawn/stop)."""
+
+    def start(self, card: ModelCard) -> tuple[subprocess.Popen, int]:
         port = _free_port()
         proc = subprocess.Popen(
             [sys.executable, "-m", "fedml_tpu.serving.worker",
-             "--model", self.card.model, "--classes", str(self.card.classes),
-             "--params", self.card.params_path, "--port", str(port)],
+             "--model", card.model, "--classes", str(card.classes),
+             "--params", card.params_path, "--port", str(port)],
             cwd=_REPO_ROOT,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
         )
         return proc, port
 
-    @staticmethod
-    def stop(proc: Optional[subprocess.Popen]) -> None:
-        if proc is not None and proc.poll() is None:
-            proc.terminate()
+    def stop(self, handle: Optional[subprocess.Popen]) -> None:
+        if handle is not None and handle.poll() is None:
+            handle.terminate()
             try:
-                proc.wait(timeout=5)
+                handle.wait(timeout=5)
             except subprocess.TimeoutExpired:
-                proc.kill()
+                handle.kill()
+
+    def poll(self, handle: subprocess.Popen) -> Optional[int]:
+        return handle.poll()
+
+    def replica_id(self, handle: subprocess.Popen) -> int:
+        return handle.pid
 
 
 class Endpoint:
     """Desired-state record + live process table for one deployed model."""
 
-    def __init__(self, name: str, card: ModelCard, desired: int, autoscale: Optional[AutoscalePolicy]):
+    def __init__(self, name: str, card: ModelCard, desired: int,
+                 autoscale: Optional[AutoscalePolicy],
+                 runtime: Optional[ReplicaRuntime] = None):
         self.name = name
         self.card = card
         self.desired = desired
-        self.handler = ReplicaHandler(card)
+        self.runtime = runtime or ProcessReplicaRuntime()
         self.autoscaler = Autoscaler(autoscale) if autoscale else None
-        self.procs: dict[int, subprocess.Popen] = {}
+        # opaque runtime handles by replica index (Popen for the default
+        # process runtime; container records for an injected runtime)
+        self.procs: dict[int, object] = {}
         self.ports: dict[int, int] = {}
         self.request_count = 0
         self.inflight = 0
@@ -326,7 +359,8 @@ class Endpoint:
         with self.lock:
             live = [
                 p for idx, p in sorted(self.ports.items())
-                if self.procs.get(idx) is not None and self.procs[idx].poll() is None
+                if self.procs.get(idx) is not None
+                and self.runtime.poll(self.procs[idx]) is None
             ]
         return [p for p in live if probe_ready(p)]
 
@@ -336,10 +370,12 @@ class ModelDeployScheduler:
     device_server_runner reduced to a library): deploy -> reconcile loop ->
     scale/undeploy."""
 
-    def __init__(self, db_path: str, reconcile_interval_s: float = 1.0):
+    def __init__(self, db_path: str, reconcile_interval_s: float = 1.0,
+                 runtime: Optional[ReplicaRuntime] = None):
         self.db = EndpointDB(db_path)
         self.cards = ModelCardRepo()
         self.endpoints: dict[str, Endpoint] = {}
+        self.runtime = runtime  # None -> each Endpoint gets the process default
         self.reconcile_interval_s = reconcile_interval_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -353,7 +389,7 @@ class ModelDeployScheduler:
     def deploy(self, endpoint_name: str, model_name: str, version: Optional[str] = None,
                replicas: int = 1, autoscale: Optional[AutoscalePolicy] = None) -> Endpoint:
         card = self.cards.get(model_name, version)
-        ep = Endpoint(endpoint_name, card, replicas, autoscale)
+        ep = Endpoint(endpoint_name, card, replicas, autoscale, runtime=self.runtime)
         with self._lock:
             self.endpoints[endpoint_name] = ep
         self.db.upsert_endpoint(endpoint_name, card.model, card.version, replicas, "DEPLOYING")
@@ -383,7 +419,7 @@ class ModelDeployScheduler:
                 ep.procs.clear()
                 ep.ports.clear()
             for idx, proc in stopping:
-                ReplicaHandler.stop(proc)
+                ep.runtime.stop(proc)
                 self.db.delete_replica(endpoint_name, idx)
             self.db.upsert_endpoint(endpoint_name, ep.card.model, ep.card.version, 0, "UNDEPLOYED")
 
@@ -396,7 +432,7 @@ class ModelDeployScheduler:
         """Start one replica and register it; if the endpoint was undeployed
         while the process was starting, stop it again instead of leaking it.
         Returns False when the endpoint is gone (caller abandons the sweep)."""
-        proc, port = ep.handler.start()
+        proc, port = ep.runtime.start(ep.card)
         with ep.lock:
             if ep.closed:
                 abandoned = True
@@ -405,9 +441,9 @@ class ModelDeployScheduler:
                 ep.procs[idx] = proc
                 ep.ports[idx] = port
         if abandoned:
-            ReplicaHandler.stop(proc)
+            ep.runtime.stop(proc)
             return False
-        self.db.upsert_replica(ep.name, idx, proc.pid, port, status)
+        self.db.upsert_replica(ep.name, idx, ep.runtime.replica_id(proc), port, status)
         return True
 
     def _reconcile_impl(self) -> None:
@@ -430,12 +466,17 @@ class ModelDeployScheduler:
         # restart dead replicas (the monitor role)
         with ep.lock:
             dead = [
-                (idx, proc.returncode) for idx, proc in ep.procs.items()
-                if proc.poll() is not None and idx < ep.desired
+                (idx, ep.procs[idx], rc) for idx, proc in ep.procs.items()
+                if (rc := ep.runtime.poll(proc)) is not None and idx < ep.desired
             ]
-        for idx, rc in dead:
+        for idx, handle, rc in dead:
             log.warning("endpoint %s replica %d died (rc=%s); restarting",
                         ep.name, idx, rc)
+            # release the dead handle through the seam BEFORE replacing it:
+            # for the process runtime this is a no-op on an exited Popen, but
+            # a container runtime must get the chance to remove the exited
+            # container (ports/disk/records) or they accumulate per restart
+            ep.runtime.stop(handle)
             if not self._install_replica(ep, idx, "RESTARTING"):
                 return  # endpoint undeployed mid-sweep: abandon it entirely
         # start missing replicas
@@ -451,7 +492,7 @@ class ModelDeployScheduler:
                 for idx in [i for i in ep.procs if i >= ep.desired]
             ]
         for idx, proc, _port in extras:
-            ReplicaHandler.stop(proc)
+            ep.runtime.stop(proc)
             self.db.delete_replica(ep.name, idx)
         if ep.closed:  # best-effort probe-skip; undeploy's terminal DB write
             return      # is serialized after this sweep via _reconcile_lock

@@ -71,6 +71,79 @@ def test_resource_matcher():
     assert asg["small"] in ("a8", "a1")
 
 
+def test_resource_matcher_type_and_memory():
+    """Matcher honors device type and memory (reference scheduler_matcher):
+    unmatchable jobs stay out of the assignment."""
+    from fedml_tpu.sched.agent import match_resources
+
+    jobs = [
+        {"run_id": "tpu-job", "computing": {"minimum_num_gpus": 2, "request_gpu_type": "tpu-v5e"}},
+        {"run_id": "mem-hog", "computing": {"minimum_num_gpus": 1, "minimum_memory_gb": 64}},
+        {"run_id": "impossible", "computing": {"minimum_num_gpus": 99}},
+    ]
+    agents = [
+        {"id": "cpu-box", "num_devices": 8, "device_type": "cpu", "mem_gb": 16},
+        {"id": "tpu-box", "num_devices": 4, "device_type": "tpu-v5e", "mem_gb": 128},
+    ]
+    asg = match_resources(jobs, agents)
+    assert asg["tpu-job"] == "tpu-box"          # type must match exactly
+    assert asg["mem-hog"] == "tpu-box"          # only box with 64+ GB
+    assert "impossible" not in asg              # nobody has 99 devices
+    # free_devices (not raw capacity) is what the matcher consumes
+    asg2 = match_resources(
+        [{"run_id": "j", "computing": {"minimum_num_gpus": 4}}],
+        [{"id": "busy", "num_devices": 8, "free_devices": 2}],
+    )
+    assert asg2 == {}
+
+
+def test_agent_claims_only_fitting_jobs(tmp_path):
+    """An agent must leave a too-big job in the queue for a bigger agent
+    (round-3 verdict item 5a: 'any agent takes any job' is the gap)."""
+    import yaml
+
+    from fedml_tpu.sched.agent import FedMLAgent, registered_agents
+    from fedml_tpu.sched.launch import FedMLLaunchManager
+
+    spool = tmp_path / "spool"
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("print('ok')\n")
+    import sys
+
+    job = {
+        "workspace": "ws", "job": f"{sys.executable} main.py",
+        "computing": {"minimum_num_gpus": 4, "request_gpu_type": "tpu-v5e"},
+    }
+    ypath = tmp_path / "job.yaml"
+    ypath.write_text(yaml.safe_dump(job))
+    mgr = FedMLLaunchManager(str(spool))
+    run_id = mgr.launch_job(str(ypath))
+
+    small = FedMLAgent(str(spool), agent_id="small",
+                       capacity={"num_devices": 1, "device_type": "tpu-v5e"})
+    wrong_type = FedMLAgent(str(spool), agent_id="wrongtype",
+                            capacity={"num_devices": 8, "device_type": "cpu"})
+    assert small.sweep_once() == [] and wrong_type.sweep_once() == []
+    assert mgr.list_queue() == [run_id], "job must stay queued"
+
+    big = FedMLAgent(str(spool), agent_id="big",
+                     capacity={"num_devices": 8, "device_type": "tpu-v5e"})
+    assert big.free_devices() == 8
+    claimed = big.sweep_once()
+    assert claimed == [run_id]
+    assert big.free_devices() == 4  # 4 devices held while the job runs
+    row = big.wait_for(run_id, timeout=60)
+    assert row["status"] == "FINISHED"
+    big.sweep_once()
+    assert big.free_devices() == 8  # released on reap
+
+    # all three agents registered capacity + heartbeat in the spool
+    recs = {r["id"]: r for r in registered_agents(str(spool))}
+    assert set(recs) == {"small", "wrongtype", "big"}
+    assert recs["big"]["num_devices"] == 8
+
+
 def test_cli_env_version_and_launch(tmp_path):
     from fedml_tpu import cli
 
