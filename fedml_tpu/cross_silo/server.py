@@ -173,12 +173,30 @@ class FedMLServerManager(FedMLCommManager):
         # set by handlers/timers when the run cannot make progress; surfaced
         # as an exception by run_until_done instead of a silent timeout
         self.failed: Optional[str] = None
+        # remote observability (reference mlops_metrics over MQTT): telemetry
+        # rides THIS comm manager — client shippers target rank 0
+        self.obs_collector = None
+        extra = getattr(cfg, "extra", {}) or {}
+        if extra.get("enable_remote_obs"):
+            from ..obs.remote import ObsCollector
+
+            self.obs_collector = ObsCollector(
+                extra.get("obs_jsonl_path") or None
+            ).attach(self)
 
     # -- protocol ------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(md.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_message_client_status)
         self.register_message_receive_handler(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_message_receive_model)
         self.register_message_receive_handler(md.MSG_TYPE_C2S_FINISHED, self.handle_message_client_finished)
+        # ALWAYS accept OBS batches: a client configured with
+        # enable_remote_obs against a server without it must not crash the
+        # receive loop (KeyError on unhandled type) — telemetry is
+        # best-effort on BOTH ends, so without a collector it is dropped
+        from ..obs.remote import MSG_TYPE_C2S_OBS
+
+        if MSG_TYPE_C2S_OBS not in self.message_handler_dict:
+            self.register_message_receive_handler(MSG_TYPE_C2S_OBS, lambda _msg: None)
 
     def start(self) -> None:
         """Ask every client for status (reference connection_ready path)."""
@@ -284,6 +302,11 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_client_finished(self, msg: Message) -> None:
         pass  # bookkeeping only
+
+    def finish(self) -> None:
+        super().finish()
+        if self.obs_collector is not None:
+            self.obs_collector.close()  # release the JSONL append handle
 
     # -- runner API ----------------------------------------------------------
     def run_until_done(self, timeout: float = 600.0) -> list[dict]:

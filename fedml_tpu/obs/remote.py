@@ -1,0 +1,158 @@
+"""Remote observability: ship metrics/events/log batches over any comm
+backend to a server-side collector.
+
+Parity with the reference's MLOps telemetry plane: ``MLOpsMetrics``
+(``core/mlops/mlops_metrics.py``) publishes metrics/events over MQTT to a
+backend, and ``mlops_runtime_log_daemon.py`` POSTs batched log lines.  Here
+the SAME transports the FL protocol already rides carry the telemetry:
+
+- :class:`RemoteObsShipper` (client side) buffers metric records, span
+  events, and raw log-line batches, and flushes them as one OBS message
+  (``MSG_TYPE_C2S_OBS``) to rank 0 through any ``send(Message)`` callable —
+  INPROC, gRPC, TCP, or real MQTT alike.  The :class:`~fedml_tpu.obs.sampler.
+  RuntimeLogDaemon` plugs in directly via ``shipper.log_lines`` as its sink.
+- :class:`ObsCollector` (server side) registers on an existing comm manager,
+  aggregates per-sender, and persists every record to a JSONL file — the
+  cross-silo run becomes observable from the server without any extra
+  connection or port.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+from ..comm.message import Message
+
+#: C2S observability batch (cross-silo protocol ids 0-8 are taken;
+#: collectors register this on the same comm manager as the FL protocol)
+MSG_TYPE_C2S_OBS = 9
+
+MSG_ARG_KEY_OBS_BATCH = "obs_batch"
+
+
+class RemoteObsShipper:
+    """Buffer + batch telemetry records and ship them through ``send``.
+
+    ``send`` is any callable taking a :class:`Message` (typically a comm
+    manager's ``send_message``).  Records are flushed when ``flush_every``
+    accumulate, every ``flush_interval_s`` (daemon thread), and at
+    ``close()``.  Shipping never raises into the training path: transport
+    errors drop the batch and keep the run alive (telemetry is best-effort,
+    the reference's MQTT publisher behaves the same way).
+    """
+
+    def __init__(self, send: Callable[[Message], None], rank: int,
+                 flush_every: int = 16, flush_interval_s: float = 2.0,
+                 receiver_id: int = 0):
+        self._send = send
+        self.rank = rank
+        self.receiver_id = receiver_id
+        self.flush_every = flush_every
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.shipped = 0
+        self.dropped = 0
+        if flush_interval_s > 0:
+            t = threading.Thread(target=self._flush_loop, args=(flush_interval_s,),
+                                 daemon=True)
+            t.start()
+
+    # -- record kinds ---------------------------------------------------------
+    def metric(self, record: dict) -> None:
+        self._push({"kind": "metric", **record})
+
+    def event(self, name: str, phase: str, value=None, **extra) -> None:
+        self._push({"kind": "event", "event": name, "phase": phase,
+                    "value": value, **extra})
+
+    def log_lines(self, lines: list[str]) -> None:
+        """RuntimeLogDaemon sink signature: one record per batch of lines."""
+        self._push({"kind": "log", "lines": list(lines)})
+
+    def _push(self, record: dict) -> None:
+        record.setdefault("ts", time.time())
+        with self._lock:
+            self._buf.append(record)
+            ready = len(self._buf) >= self.flush_every
+        if ready:
+            self.flush()
+
+    # -- shipping -------------------------------------------------------------
+    def flush(self) -> int:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return 0
+        msg = Message(MSG_TYPE_C2S_OBS, self.rank, self.receiver_id)
+        msg.add_params(MSG_ARG_KEY_OBS_BATCH, json.dumps(batch))
+        try:
+            self._send(msg)
+            self.shipped += len(batch)
+            return len(batch)
+        except Exception:
+            # best-effort: telemetry loss must never take down training
+            self.dropped += len(batch)
+            return 0
+
+    def _flush_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            self.flush()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.flush()
+
+
+class ObsCollector:
+    """Server-side telemetry aggregation + JSONL persistence.
+
+    ``attach(comm_manager)`` registers the OBS handler on an existing
+    manager (FL protocol and telemetry share one transport); records land in
+    ``by_sender`` and, when ``jsonl_path`` is set, one JSON object per line
+    tagged with the sender rank."""
+
+    def __init__(self, jsonl_path: Optional[str] = None):
+        self.jsonl_path = jsonl_path
+        self.by_sender: dict[int, list[dict]] = {}
+        self._lock = threading.Lock()
+        self._fh = open(jsonl_path, "a") if jsonl_path else None
+
+    def attach(self, comm_manager) -> "ObsCollector":
+        comm_manager.register_message_receive_handler(MSG_TYPE_C2S_OBS, self.handle)
+        return self
+
+    def handle(self, msg: Message) -> None:
+        sender = int(msg.get_sender_id())
+        try:
+            batch = json.loads(msg.get(MSG_ARG_KEY_OBS_BATCH))
+        except (TypeError, ValueError):
+            return  # malformed telemetry must never disturb the FL server
+        with self._lock:
+            self.by_sender.setdefault(sender, []).extend(batch)
+            if self._fh:
+                for rec in batch:
+                    self._fh.write(json.dumps({"sender": sender, **rec}) + "\n")
+                self._fh.flush()
+
+    # -- queries --------------------------------------------------------------
+    def records(self, sender: Optional[int] = None, kind: Optional[str] = None) -> list[dict]:
+        with self._lock:
+            if sender is not None:
+                pool = list(self.by_sender.get(sender, []))
+            else:
+                pool = [r for recs in self.by_sender.values() for r in recs]
+        return [r for r in pool if kind is None or r.get("kind") == kind]
+
+    def counts(self) -> dict[int, int]:
+        with self._lock:
+            return {s: len(r) for s, r in self.by_sender.items()}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None

@@ -73,13 +73,16 @@ def parse_requirements(computing: Optional[dict]) -> tuple[int, str, float]:
 
 
 def satisfies(req: tuple[int, str, float], capacity: dict, free_devices: int) -> bool:
-    """Can an agent with ``capacity`` and ``free_devices`` run ``req`` now?"""
+    """Can an agent with ``capacity`` and ``free_devices`` run ``req`` now?
+    A ``mem_gb`` of 0/absent means unlimited (the CLI's documented
+    contract) — an agent that declares no memory bound accepts any job."""
     need_dev, need_type, need_mem = req
     if need_dev > free_devices:
         return False
     if need_type and need_type != str(capacity.get("device_type", "")):
         return False
-    if need_mem > float(capacity.get("mem_gb", float("inf"))):
+    mem_cap = float(capacity.get("mem_gb", 0) or 0) or float("inf")
+    if need_mem > mem_cap:
         return False
     return True
 
@@ -110,6 +113,10 @@ class FedMLAgent:
         self.capacity = dict(capacity or {"num_devices": 1})
         self._procs: dict[str, subprocess.Popen] = {}
         self._alloc: dict[str, int] = {}  # run_id -> devices held
+        # parsed-manifest cache keyed by (name, size, mtime): unfitting
+        # packages stay queued across many polls and must not be re-opened
+        # and re-parsed twice a second forever
+        self._manifest_cache: dict[tuple, dict] = {}
         self._running = False
         self._register()
 
@@ -172,10 +179,17 @@ class FedMLAgent:
         """One scheduling pass: claim queued packages + reap finished jobs
         (the JobMonitor role, ``job_monitor.py:337``)."""
         claimed = []
+        seen_keys = set()
         for pkg in sorted(self.queue.glob("*.zip")):
             try:
-                with zipfile.ZipFile(pkg) as z:
-                    manifest = json.loads(z.read("__fedml_job__.json"))
+                st = pkg.stat()
+                key = (pkg.name, st.st_size, st.st_mtime_ns)
+                seen_keys.add(key)
+                manifest = self._manifest_cache.get(key)
+                if manifest is None:
+                    with zipfile.ZipFile(pkg) as z:
+                        manifest = json.loads(z.read("__fedml_job__.json"))
+                    self._manifest_cache[key] = manifest
             except (FileNotFoundError, zipfile.BadZipFile, KeyError):
                 continue  # claimed by another agent / still being written
             if not self.fits(manifest):
@@ -194,6 +208,10 @@ class FedMLAgent:
                 )
                 del self._procs[run_id]
                 self._alloc.pop(run_id, None)  # free the devices
+        # drop cache entries for packages no longer in the queue
+        self._manifest_cache = {
+            k: v for k, v in self._manifest_cache.items() if k in seen_keys
+        }
         self._register()  # heartbeat + free-capacity refresh
         return claimed
 

@@ -215,6 +215,8 @@ def test_deploy_through_injected_runtime(tmp_path, lr_card):
         sched.undeploy("ct")
         live = [c for c in rt.started if c.exit_code is None]
         assert not live, "undeploy must stop every container"
-        assert sched.db.stats("ct") is None or True  # terminal state recorded
+        row = sched.db.endpoint("ct")
+        assert row is not None and row["status"] == "UNDEPLOYED", row
+        assert sched.db.replicas("ct") == []
     finally:
         sched.stop()
