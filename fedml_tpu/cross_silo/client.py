@@ -119,12 +119,30 @@ class ClientMasterManager(FedMLCommManager):
         self.rounds_trained = 0
         # remote observability: per-round events (+ anything the caller
         # ships via self.obs — perf samples, RuntimeLogDaemon batches) ride
-        # the FL transport to the server's ObsCollector
+        # the FL transport to the server's ObsCollector.  The train events
+        # wrap trainer.train itself (not one subclass handler) so SecAgg/FHE
+        # client managers — which override the train-and-send path — ship
+        # the same telemetry.
         self.obs = None
         if (getattr(cfg, "extra", {}) or {}).get("enable_remote_obs"):
             from ..obs.remote import RemoteObsShipper
 
             self.obs = RemoteObsShipper(self.send_message, rank)
+            inner_train = self.trainer.train
+
+            def train_with_obs(global_vars, round_idx, seed_key, client_idx=0):
+                self.obs.event("train", "started", round_idx=int(round_idx),
+                               client_idx=int(client_idx))
+                out = inner_train(global_vars, round_idx, seed_key, client_idx)
+                self.obs.event("train", "ended", round_idx=int(round_idx),
+                               client_idx=int(client_idx),
+                               num_samples=float(out[1]))
+                # ship now: round telemetry is only useful while the round is
+                # in flight, and the final interval flush can race teardown
+                self.obs.flush()
+                return out
+
+            self.trainer.train = train_with_obs
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.handle_message_check_status)
@@ -148,17 +166,8 @@ class ClientMasterManager(FedMLCommManager):
         round_idx = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
         params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
         client_idx = int(msg.get(md.MSG_ARG_KEY_CLIENT_INDEX, self.rank - 1))
-        if self.obs is not None:
-            self.obs.event("train", "started", round_idx=round_idx, client_idx=client_idx)
         new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key, client_idx)
         self.rounds_trained += 1
-        if self.obs is not None:
-            self.obs.event("train", "ended", round_idx=round_idx,
-                           client_idx=client_idx, num_samples=n_samples)
-            # round telemetry is only useful while the round is in flight —
-            # ship it now rather than waiting out the batch/interval (the
-            # final interval flush can race server teardown)
-            self.obs.flush()
         reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, new_vars)
         reply.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, n_samples)

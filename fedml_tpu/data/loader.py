@@ -93,6 +93,8 @@ def load(cfg: Config) -> FederatedDataset:
     name = _DATASET_ALIASES.get(name, name)
     if name == "fets2021":
         return _load_fets(cfg)
+    if name == "synthetic_condshift":
+        return _load_condshift(cfg)
     if name in _DATASET_SPECS:
         ds = _load_image_like(cfg, name)
     elif name in _TEXT_SPECS:
@@ -258,6 +260,74 @@ def _load_idx(d: Path):
         read_labels(d / "train-labels-idx1-ubyte"),
         read_images(d / "t10k-images-idx3-ubyte"),
         read_labels(d / "t10k-labels-idx1-ubyte"),
+    )
+
+
+def _load_condshift(cfg: Config) -> FederatedDataset:
+    """Conditional-shift benchmark: client-dependent class conditionals where
+    layer-selective personalization (MyAvg) should beat plain FedAvg.
+
+    Clients belong to ``condshift_clusters`` clusters (``cfg.extra``,
+    default 2).  All clusters share the SAME feature prototypes (a shared
+    body can learn the prototype subspace from everyone's data), but each
+    cluster maps prototypes to labels through its own permutation — the
+    class-conditional p(x|y) differs per cluster while p(x) matches.  A
+    single global head therefore averages contradictory label mappings
+    (FedAvg caps near 1/clusters of its potential), while a personal head
+    trained with same-cluster partners resolves its cluster's mapping.
+    Per-client test shards (``test_client_idx``) follow each client's own
+    cluster conditional — the quantity personalization optimizes.
+
+    Fork-research counterpart: the MyAvg paper's motivating setting
+    (``my_research/.../MyAvgAPI_7.py`` personalizes heads because clients'
+    label semantics differ); this generator makes that setting measurable.
+    """
+    rng = np.random.RandomState(0xC04D ^ (cfg.random_seed * 2654435761 % (2**31)))
+    d, classes = 64, 6
+    n_clients = cfg.client_num_in_total
+    extra = getattr(cfg, "extra", {}) or {}
+    clusters = int(extra.get("condshift_clusters", 2))
+    if not 1 <= clusters <= 6:
+        # np.roll wraps at classes=6: more clusters would silently alias
+        # earlier label permutations and measure LESS shift than configured
+        raise ValueError(
+            f"condshift_clusters={clusters} out of range [1, 6] "
+            "(label permutations alias beyond the class count)"
+        )
+    per_client = int((cfg.synthetic_train_size or 4800) // max(n_clients, 1))
+    test_per_client = int((cfg.synthetic_test_size or 1200) // max(n_clients, 1))
+    scale = float(extra.get("condshift_scale", 0.9))
+
+    # shared prototype directions (unit-ish), one per class
+    protos = rng.normal(0, 1.0, size=(classes, d)).astype(np.float32)
+    protos /= np.linalg.norm(protos, axis=1, keepdims=True)
+    # cluster c maps prototype p -> label perms[c][p]; cluster 0 = identity,
+    # the rest are rotations (derangements) of the label set
+    perms = [np.roll(np.arange(classes), c) for c in range(clusters)]
+
+    def gen(cluster: int, n: int):
+        p = rng.randint(0, classes, size=n)
+        x = scale * protos[p] + rng.normal(0, 1.0, size=(n, d)).astype(np.float32)
+        y = perms[cluster][p].astype(np.int32)
+        return x.astype(np.float32), y
+
+    xs, ys, txs, tys = [], [], [], []
+    client_idx, test_client_idx = [], []
+    tr_off = te_off = 0
+    for cid in range(n_clients):
+        cluster = cid % clusters
+        x, y = gen(cluster, per_client)
+        tx, ty = gen(cluster, test_per_client)
+        xs.append(x); ys.append(y); txs.append(tx); tys.append(ty)
+        client_idx.append(np.arange(tr_off, tr_off + per_client))
+        test_client_idx.append(np.arange(te_off, te_off + test_per_client))
+        tr_off += per_client
+        te_off += test_per_client
+    return FederatedDataset(
+        train_x=np.concatenate(xs), train_y=np.concatenate(ys),
+        test_x=np.concatenate(txs), test_y=np.concatenate(tys),
+        client_idx=client_idx, test_client_idx=test_client_idx,
+        class_num=classes, name="synthetic_condshift",
     )
 
 

@@ -119,16 +119,21 @@ class FedMLRunner:
         if opt in self._SPECIAL_SIM_OPTIMIZERS:
             # trust flags must never be silent no-ops (see
             # _check_unimplemented_flags): these simulators don't wire the
-            # trust pipeline yet, so refuse rather than ignore
-            active = [
-                f for f in _IMPLEMENTED_TRUST_FLAGS if getattr(self.cfg, f, False)
-            ]
-            if active:
-                raise NotImplementedError(
-                    f"trust features {active} are not yet wired into the "
-                    f"{opt!r} simulator (supported on the FedAvg-family mesh "
-                    "engine); refusing to run without them"
-                )
+            # trust pipeline yet, so refuse rather than ignore.  MyAvg is the
+            # exception — it routes attack/defense/DP through the engine's
+            # trust hooks and enforces its own finer-grained policy
+            # (sim/myavg.py refuses secagg/fhe/contribution and
+            # aggregation-replacing defenses itself).
+            if opt not in C.FEDERATED_OPTIMIZER_MYAVG_ALIASES:
+                active = [
+                    f for f in _IMPLEMENTED_TRUST_FLAGS if getattr(self.cfg, f, False)
+                ]
+                if active:
+                    raise NotImplementedError(
+                        f"trust features {active} are not yet wired into the "
+                        f"{opt!r} simulator (supported on the FedAvg-family mesh "
+                        "engine); refusing to run without them"
+                    )
             if self.client_trainer is not None or self.server_aggregator is not None:
                 raise ValueError(
                     f"custom client_trainer/server_aggregator are not used by "

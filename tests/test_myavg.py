@@ -164,6 +164,117 @@ def test_cka_partner_selection_prefers_similar_clients(eight_devices):
     assert across > within, (within, across)
 
 
+def test_myavg_composes_with_defense_and_dp(eight_devices):
+    """Round-3 verdict item 9: transforming defenses and DP ride the MyAvg
+    round through the same trust hooks as the engine round."""
+    sim = _build(_myavg_cfg(
+        comm_round=4, learning_rate=0.3,
+        enable_defense=True, defense_type="norm_diff_clipping", norm_bound=50.0,
+        enable_dp=True, dp_solution_type="ldp", mechanism_type="gaussian",
+        epsilon=50.0, delta=1e-5, sensitivity=0.01,
+    ))
+    assert sim.trust is not None and sim.trust.defense is not None
+    history = sim.run()
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    pers = sim.evaluate_personalized()
+    assert pers["personalized_test_acc_mean"] > 0.3, pers
+
+
+def test_myavg_defense_zero_weight_excludes_partner(eight_devices):
+    """A defense that zeroes a client's weight removes it from the global
+    aggregate AND from everyone's CKA partner pool (the weights flow into
+    partner_select)."""
+    import fedml_tpu
+    from fedml_tpu.sim.myavg import MyAvgSimulator
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.trust.pipeline import TrustPipeline
+
+    cfg = _myavg_cfg(comm_round=2)
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+
+    class ZeroClient0(TrustPipeline):
+        def __init__(self):
+            super().__init__(cfg)
+
+        @property
+        def active(self):
+            return True
+
+        def on_aggregation(self, contribs, weights, global_vars, key, prev_delta=None):
+            return contribs, weights.at[0].set(0.0), None
+
+    sim = MyAvgSimulator(cfg, ds, model)
+    sim.trust = ZeroClient0()
+    sim._round_fn = jax.jit(sim._make_round_fn())
+    sim._multi_round_fns = {}
+    before = _leaf(sim.client_states, "Dense_0.kernel")[: sim._n_real].copy()
+    sim.run_round()
+    # client 0's weight is zero: the shared body still updates (other
+    # clients aggregate), and the round runs without NaNs
+    after = _leaf(sim.client_states, "Dense_0.kernel")[: sim._n_real]
+    assert np.isfinite(after).all()
+    assert np.abs(after - before).max() > 0
+
+
+def test_myavg_refuses_aggregation_replacing_defense(eight_devices):
+    """Defenses that collapse the per-client deltas into one aggregate
+    (on_agg overrides) are refused; weight-masking Krum is fine and runs."""
+    with pytest.raises(NotImplementedError, match="replaces the|per-client"):
+        _build(_myavg_cfg(enable_defense=True, defense_type="geometric_median"))
+    # krum masks weights in before() — composes, and the round runs
+    sim = _build(_myavg_cfg(comm_round=2, enable_defense=True,
+                            defense_type="krum", krum_param_m=3,
+                            byzantine_client_num=1))
+    sim.run_round()
+
+
+def test_myavg_still_refuses_secagg(eight_devices):
+    with pytest.raises(NotImplementedError, match="secagg"):
+        _build(_myavg_cfg(enable_secagg=True))
+
+
+def test_condshift_personalization_beats_fedavg(eight_devices):
+    """The MyAvg-wins benchmark (round-3 verdict item 8), CI-sized: under
+    cluster-dependent class conditionals, layer-selective personalization
+    scored on per-client test shards beats FedAvg by a wide margin (full
+    recipe + ablations: scripts/myavg_condshift.py -> MYAVG_r4.json)."""
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.runner import FedMLRunner
+
+    base = dict(
+        dataset="synthetic_condshift", model="mlp",
+        client_num_in_total=10, client_num_per_round=10, comm_round=25,
+        epochs=2, batch_size=32, learning_rate=0.5,
+        synthetic_train_size=1500, synthetic_test_size=2000,
+        frequency_of_the_test=25, random_seed=0, compute_dtype="float32",
+        extra={"condshift_clusters": 2, "condshift_scale": 2.5},
+    )
+    cfg = Config(federated_optimizer="FedAvg", **base)
+    fedml_tpu.init(cfg)
+    h = FedMLRunner(cfg).run()
+    fed_acc = [x["test_acc"] for x in h if "test_acc" in x][-1]
+
+    cfg2 = Config(federated_optimizer="MyAvg",
+                  agg_unselect_layer=("Dense_1",),
+                  agg_mod_list=(9999,), agg_mod_dict={9999: {}},
+                  cka_any_select_layer=("Dense_1",), cka_select_topk=4,
+                  **base)
+    fedml_tpu.init(cfg2)
+    r2 = FedMLRunner(cfg2)
+    r2.run()
+    pers = r2.runner.evaluate_personalized()
+
+    # FedAvg is capped by contradictory label mappings (~0.5 structural);
+    # personalization resolves each client's own conditional
+    assert fed_acc < 0.55, fed_acc
+    assert pers["personalized_test_acc_mean"] > fed_acc + 0.2, (pers, fed_acc)
+    assert pers["personalized_test_acc_min"] > 0.55, pers
+
+
 def test_myavg_rejects_sp_backend(eight_devices):
     with pytest.raises(NotImplementedError):
         _build(_myavg_cfg(backend_sim="sp"))

@@ -49,6 +49,36 @@ def test_shipper_batches_and_collector_aggregates(tmp_path):
     assert all(l["sender"] == 7 for l in lines) and len(lines) == 2
 
 
+def test_secagg_clients_ship_train_telemetry(eight_devices):
+    """The obs instrumentation wraps trainer.train itself, so protocol
+    variants that override the train-and-send path (SecAgg here) ship the
+    same per-round events as the plain client manager."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo.lightsecagg import run_lightsecagg_process_group
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=4, client_num_per_round=4,
+        comm_round=2, learning_rate=0.3, frequency_of_the_test=0,
+        run_id="obs-lsa", enable_secagg=True,
+    )
+    cfg.extra = {"enable_remote_obs": True}
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("obs-lsa")
+    history, server = run_lightsecagg_process_group(cfg, ds, model, timeout=120.0)
+    assert len(history) == 2
+    col = server.obs_collector
+    assert col is not None
+    for rank in (1, 2, 3, 4):
+        ended = [e for e in col.records(sender=rank, kind="event")
+                 if e["phase"] == "ended"]
+        assert len(ended) == 2, (rank, col.counts())
+
+
 def test_cross_silo_round_events_arrive_server_side(tmp_path, eight_devices):
     """E2E: with enable_remote_obs, every client's per-round train events,
     its perf-sampler metrics, and its log-daemon line batches all arrive at
