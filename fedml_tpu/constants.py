@@ -48,6 +48,8 @@ FEDERATED_OPTIMIZER_SPLIT_NN = "split_nn"
 FEDERATED_OPTIMIZER_FEDGKT = "FedGKT"
 FEDERATED_OPTIMIZER_FEDNAS = "FedNAS"
 FEDERATED_OPTIMIZER_FEDSEG = "FedSeg"
+# federated LoRA finetuning (reference spotlight_prj/fedllm run_fedllm.py)
+FEDERATED_OPTIMIZER_FEDLLM = "FedLLM"
 # Fork research: CKA layer-selective personalized aggregation
 # (my_research/.../MyAvgAPI_7.py; simulator.py:88-95 dispatches "MyAgg-*")
 FEDERATED_OPTIMIZER_MYAVG = "MyAvg"

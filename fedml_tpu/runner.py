@@ -94,6 +94,7 @@ class FedMLRunner:
         C.FEDERATED_OPTIMIZER_FEDNAS,
         C.FEDERATED_OPTIMIZER_FEDSEG,
         C.FEDERATED_OPTIMIZER_TURBO_AGGREGATE,
+        C.FEDERATED_OPTIMIZER_FEDLLM,
         *C.FEDERATED_OPTIMIZER_MYAVG_ALIASES,
     }
     # these build their own model pair internally; model_hub model is unused
@@ -104,6 +105,7 @@ class FedMLRunner:
         C.FEDERATED_OPTIMIZER_FEDGAN,
         C.FEDERATED_OPTIMIZER_FEDNAS,
         C.FEDERATED_OPTIMIZER_FEDSEG,
+        C.FEDERATED_OPTIMIZER_FEDLLM,
     }
 
     def _init_simulation_runner(self):
@@ -193,6 +195,13 @@ class FedMLRunner:
             from .sim.myavg import MyAvgSimulator
 
             return MyAvgSimulator(self.cfg, dataset, model)
+        if opt == C.FEDERATED_OPTIMIZER_FEDLLM:
+            # config-driven FedLLM (reference spotlight_prj/fedllm
+            # run_fedllm.py is launched from a job yaml); the transformer is
+            # built internally from extra.llm_* keys / tiny defaults
+            from .llm.fedllm import FedLLMSimulator
+
+            return FedLLMSimulator(self.cfg, dataset)
         from .sim.engine import MeshSimulator
 
         return MeshSimulator(self.cfg, dataset, model, algorithm=self.client_trainer)

@@ -340,6 +340,12 @@ class MyAvgSimulator(MeshSimulator):
             metrics = self._slice_lanes(metrics, m)
 
             weights = cnts[:m].astype(jnp.float32)
+            # the clients' RETAINED local models: trust hooks transform only
+            # the SHIPPED copy (LDP noise / defense clipping applies to the
+            # transmitted update, never to client-local state — otherwise a
+            # personal head that never aggregates would random-walk under a
+            # fresh noise draw every sampled round)
+            retained = trained
             if self.trust is not None:
                 # same hook chain as the engine round (attack simulation +
                 # LDP on the stacked trained models; defense before()
@@ -366,8 +372,9 @@ class MyAvgSimulator(MeshSimulator):
 
             g_leaves = jax.tree_util.tree_leaves(global_vars)
             t_leaves = jax.tree_util.tree_leaves(trained)
+            r_leaves = jax.tree_util.tree_leaves(retained)
             new_g_leaves, new_p_leaves = [], []
-            for li, (g, t) in enumerate(zip(g_leaves, t_leaves)):
+            for li, (g, t, t_clean) in enumerate(zip(g_leaves, t_leaves, r_leaves)):
                 agg_on = jnp.take(agg_table[li], cid)  # {0,1} this round
                 delta = (t - g[None]).astype(jnp.float32)
                 bshape = (m,) + (1,) * g.ndim
@@ -409,9 +416,11 @@ class MyAvgSimulator(MeshSimulator):
                     pers_delta = jnp.broadcast_to(g_all[None], (m,) + g.shape)
 
                 # aggregated layers: personal <- old global + personalized
-                # delta; unaggregated: client keeps its locally trained leaf
-                # (strict=False load semantics, MyAvgAPI_7.py:320-326)
-                new_p = jnp.where(agg_on > 0, (g[None] + pers_delta).astype(t.dtype), t)
+                # delta (server-computed from the SHIPPED updates — trust
+                # transforms legitimately flow in here); unaggregated: the
+                # client keeps its CLEAN locally trained leaf (strict=False
+                # load semantics, MyAvgAPI_7.py:320-326)
+                new_p = jnp.where(agg_on > 0, (g[None] + pers_delta).astype(t.dtype), t_clean)
                 new_g_leaves.append(new_g)
                 new_p_leaves.append(new_p)
 

@@ -1,0 +1,67 @@
+"""Recipe gallery smoke test (round-3 verdict item 10): every YAML under
+``examples/`` must parse through the reference-style sectioned loader and
+run 2 rounds end-to-end via FedMLRunner — the gallery is the discoverable
+YAML vocabulary (reference ``examples/federate/...``), and a recipe that
+rots breaks here."""
+
+import dataclasses
+import glob
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = sorted(glob.glob(os.path.join(_REPO, "examples", "*", "fedml_config.yaml")))
+
+# smoke-size overrides: the gallery documents full-size recipes; CI runs
+# them tiny (the YAML vocabulary and dispatch are what is under test)
+_SMOKE = dict(
+    comm_round=2,
+    frequency_of_the_test=2,
+    synthetic_train_size=512,
+    synthetic_test_size=128,
+    client_num_in_total=4,
+    client_num_per_round=4,
+    batch_size=16,
+    checkpoint_dir="",
+    metrics_jsonl_path="",
+)
+# per-recipe overrides: shape fields that must survive the shrink, and conv
+# models swapped to "lr" in CI — a conv-model mesh round compiles for
+# minutes on this 1-core virtual-CPU box (env artifact; the resnet path is
+# exercised on the real chip by bench.py and the zoo tests).  The YAML
+# vocabulary, optimizer dispatch, and round loop are what this test pins.
+_KEEP = {
+    "myavg_condshift_mlp": {"client_num_in_total": 10, "client_num_per_round": 10,
+                            "synthetic_train_size": 1500, "synthetic_test_size": 2000},
+    "sim_hierarchical_cifar10": {"client_num_in_total": 8, "client_num_per_round": 8,
+                                 "model": "lr"},
+    "sp_fedavg_cifar10_resnet20": {"model": "lr"},
+    "sp_fedopt_cifar10_resnet20": {"model": "lr"},
+    "sp_fedsgd_eftopk_cifar10_resnet20": {"model": "lr"},
+}
+
+
+def test_gallery_is_populated():
+    assert len(_EXAMPLES) >= 8, _EXAMPLES
+
+
+@pytest.mark.parametrize("yaml_path", _EXAMPLES,
+                         ids=[os.path.basename(os.path.dirname(p)) for p in _EXAMPLES])
+def test_example_recipe_smokes(yaml_path, eight_devices, tmp_path):
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    cfg = fedml_tpu.arguments.add_args(["--cf", yaml_path])
+    name = os.path.basename(os.path.dirname(yaml_path))
+    over = dict(_SMOKE)
+    over.update(_KEEP.get(name, {}))
+    over["data_cache_dir"] = str(tmp_path)  # never read real data in CI
+    cfg = dataclasses.replace(cfg, **over)
+    fedml_tpu.init(cfg)
+    runner = FedMLRunner(cfg)
+    history = runner.run()
+    assert history, f"{name}: empty history"
+    last = history[-1]
+    assert any(k.startswith("train_loss") or k in ("round", "test_acc", "test_ppl")
+               for k in last), (name, last)

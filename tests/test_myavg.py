@@ -219,6 +219,23 @@ def test_myavg_defense_zero_weight_excludes_partner(eight_devices):
     assert np.abs(after - before).max() > 0
 
 
+def test_ldp_noise_never_touches_retained_personal_state(eight_devices):
+    """LDP noise applies to the SHIPPED update only: a personal head that
+    never aggregates must be bit-identical with and without DP after a round
+    (the retained local model is not part of the privacy surface)."""
+    heads = {}
+    for dp in (False, True):
+        kw = dict(comm_round=2)
+        if dp:
+            kw.update(enable_dp=True, dp_solution_type="ldp",
+                      mechanism_type="gaussian", epsilon=0.5, delta=1e-5,
+                      sensitivity=1.0)  # LOUD noise: a leak would be visible
+        sim = _build(_myavg_cfg(**kw))
+        sim.run_round()  # round 0: default filter -> head unaggregated
+        heads[dp] = _leaf(sim.client_states, "Dense_1.kernel")[: sim._n_real]
+    np.testing.assert_array_equal(heads[False], heads[True])
+
+
 def test_myavg_refuses_aggregation_replacing_defense(eight_devices):
     """Defenses that collapse the per-client deltas into one aggregate
     (on_agg overrides) are refused; weight-masking Krum is fine and runs."""
