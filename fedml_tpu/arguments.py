@@ -215,7 +215,12 @@ def config_from_sections(sections: dict) -> Config:
         if k in _ALIASES and _ALIASES[k] is None:
             continue
         k = _ALIASES.get(k, k)
-        if k in _FIELD_NAMES and k != "extra":
+        if k == "extra" and isinstance(v, dict):
+            # a literal `extra:` block in any section holds free-form knobs —
+            # MERGE its contents (the old behavior nested it as
+            # cfg.extra['extra'], silently disabling every documented knob)
+            extra.update(v)
+        elif k in _FIELD_NAMES and k != "extra":
             kwargs[k] = v
         else:
             extra[k] = v
