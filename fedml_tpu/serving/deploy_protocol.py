@@ -92,8 +92,13 @@ class DeployWorkerManager(FedMLCommManager):
         card = ModelCard(**json.loads(msg.get(ARG_CARD)))
         replicas = int(msg.get(ARG_REPLICAS))
         try:
-            self.sched.cards.register(card)
-            self.sched.deploy(name, card.name, card.version, replicas=replicas)
+            if name in self.sched.endpoints:
+                # a redelivered/duplicate DEPLOY must not overwrite the live
+                # Endpoint record (the old replica processes would leak)
+                self.sched.scale(name, replicas)
+            else:
+                self.sched.cards.register(card)
+                self.sched.deploy(name, card.name, card.version, replicas=replicas)
         except Exception:
             log.exception("worker %d: deploy %s failed", self.rank, name)
         self._report(name)
@@ -203,21 +208,22 @@ class DeployMasterManager(FedMLCommManager):
             time.sleep(0.05)
         raise TimeoutError(f"only {len(self.workers)}/{n} workers reported online")
 
-    def place(self, replicas: int, ignore_endpoint: Optional[str] = None) -> dict[int, int]:
+    def place(self, replicas: int, endpoint: str) -> dict[int, int]:
         """Capacity-weighted round-robin split (reference splits a
         deployment's replicas across selected edges).  Free capacity accounts
-        for every OTHER endpoint's current placement — concurrent endpoints
-        must not over-commit the cluster past its advertised capacity.
-        ``ignore_endpoint`` excludes an endpoint being re-placed (scale)."""
+        for every OTHER endpoint's current placement, and the winning
+        placement is COMMITTED to ``self.placements[endpoint]`` inside the
+        same locked section — concurrent deploys cannot both see the same
+        free slot and over-commit the cluster."""
         with self._lock:
             workers = dict(self.workers)
             if not workers:
                 raise RuntimeError("no workers online")
             free = {r: int(w["capacity"]) for r, w in workers.items()}
-            for name, placement in self.placements.items():
-                if name == ignore_endpoint:
-                    continue
-                for r, n in placement.items():
+            for name, held in self.placements.items():
+                if name == endpoint:
+                    continue  # an endpoint being re-placed frees its own slots
+                for r, n in held.items():
                     free[r] = free.get(r, 0) - n
             placement = {r: 0 for r in workers}
             order = sorted(workers)
@@ -231,16 +237,26 @@ class DeployMasterManager(FedMLCommManager):
                     free[r] -= 1
                     placed += 1
             self._place_rr = i
-        if placed < replicas:
-            raise RuntimeError(
-                f"cluster capacity exhausted: placed {placed}/{replicas} replicas"
-            )
-        return {r: n for r, n in placement.items() if n > 0}
+            if placed < replicas:
+                raise RuntimeError(
+                    f"cluster capacity exhausted: placed {placed}/{replicas} replicas"
+                )
+            placement = {r: n for r, n in placement.items() if n > 0}
+            self.placements[endpoint] = placement
+        return placement
 
     def deploy(self, endpoint: str, card: ModelCard, replicas: int = 1) -> dict[int, int]:
-        placement = self.place(replicas)
-        self.placements[endpoint] = placement
-        self.cards[endpoint] = card
+        with self._lock:
+            if endpoint in self.placements:
+                # re-deploying over a live name would orphan replicas on
+                # workers the new placement omits (they'd keep serving the
+                # OLD card through the routing table)
+                raise ValueError(
+                    f"endpoint {endpoint!r} is already deployed; scale() it "
+                    "or undeploy() first"
+                )
+            self.cards[endpoint] = card
+        placement = self.place(replicas, endpoint)
         for rank, n in placement.items():
             msg = Message(MSG_TYPE_M2W_DEPLOY, 0, rank)
             msg.add_params(ARG_ENDPOINT, endpoint)
@@ -250,12 +266,12 @@ class DeployMasterManager(FedMLCommManager):
         return placement
 
     def scale(self, endpoint: str, replicas: int) -> dict[int, int]:
-        card = self.cards.get(endpoint)
+        with self._lock:
+            card = self.cards.get(endpoint)
+            old = dict(self.placements.get(endpoint, {}))
         if card is None:
             raise KeyError(f"endpoint {endpoint!r} was never deployed")
-        placement = self.place(replicas, ignore_endpoint=endpoint)
-        old = self.placements.get(endpoint, {})
-        self.placements[endpoint] = placement
+        placement = self.place(replicas, endpoint)
         for rank in set(old) | set(placement):
             n = placement.get(rank, 0)
             msg = Message(MSG_TYPE_M2W_SCALE, 0, rank)
@@ -271,9 +287,9 @@ class DeployMasterManager(FedMLCommManager):
         # broadcast to EVERY known worker, not just the current placement:
         # re-placements (scale) may have left endpoint records on workers no
         # longer in the table, and a worker without the endpoint no-ops
-        self.placements.pop(endpoint, None)
-        self.cards.pop(endpoint, None)
         with self._lock:
+            self.placements.pop(endpoint, None)
+            self.cards.pop(endpoint, None)
             ranks = list(self.workers)
             self.endpoints.pop(endpoint, None)
         for rank in ranks:
