@@ -208,44 +208,48 @@ class DeployMasterManager(FedMLCommManager):
             time.sleep(0.05)
         raise TimeoutError(f"only {len(self.workers)}/{n} workers reported online")
 
-    def place(self, replicas: int, endpoint: str) -> dict[int, int]:
+    def _place_locked(self, replicas: int, endpoint: str) -> dict[int, int]:
         """Capacity-weighted round-robin split (reference splits a
-        deployment's replicas across selected edges).  Free capacity accounts
-        for every OTHER endpoint's current placement, and the winning
-        placement is COMMITTED to ``self.placements[endpoint]`` inside the
-        same locked section — concurrent deploys cannot both see the same
-        free slot and over-commit the cluster."""
-        with self._lock:
-            workers = dict(self.workers)
-            if not workers:
-                raise RuntimeError("no workers online")
-            free = {r: int(w["capacity"]) for r, w in workers.items()}
-            for name, held in self.placements.items():
-                if name == endpoint:
-                    continue  # an endpoint being re-placed frees its own slots
-                for r, n in held.items():
-                    free[r] = free.get(r, 0) - n
-            placement = {r: 0 for r in workers}
-            order = sorted(workers)
-            i = self._place_rr
-            placed = 0
-            while placed < replicas and any(f > 0 for f in free.values()):
-                r = order[i % len(order)]
-                i += 1
-                if free[r] > 0:
-                    placement[r] += 1
-                    free[r] -= 1
-                    placed += 1
-            self._place_rr = i
-            if placed < replicas:
-                raise RuntimeError(
-                    f"cluster capacity exhausted: placed {placed}/{replicas} replicas"
-                )
-            placement = {r: n for r, n in placement.items() if n > 0}
-            self.placements[endpoint] = placement
+        deployment's replicas across selected edges).  Caller holds _lock.
+        Free capacity accounts for every OTHER endpoint's current placement;
+        the winning placement is COMMITTED to ``self.placements[endpoint]``
+        before the lock is released, so concurrent deploys cannot both see
+        the same free slot and over-commit the cluster.  Raises WITHOUT
+        mutating state when capacity is short."""
+        workers = dict(self.workers)
+        if not workers:
+            raise RuntimeError("no workers online")
+        free = {r: int(w["capacity"]) for r, w in workers.items()}
+        for name, held in self.placements.items():
+            if name == endpoint:
+                continue  # an endpoint being re-placed frees its own slots
+            for r, n in held.items():
+                free[r] = free.get(r, 0) - n
+        placement = {r: 0 for r in workers}
+        order = sorted(workers)
+        i = self._place_rr
+        placed = 0
+        while placed < replicas and any(f > 0 for f in free.values()):
+            r = order[i % len(order)]
+            i += 1
+            if free[r] > 0:
+                placement[r] += 1
+                free[r] -= 1
+                placed += 1
+        self._place_rr = i
+        if placed < replicas:
+            raise RuntimeError(
+                f"cluster capacity exhausted: placed {placed}/{replicas} replicas"
+            )
+        placement = {r: n for r, n in placement.items() if n > 0}
+        self.placements[endpoint] = placement
         return placement
 
     def deploy(self, endpoint: str, card: ModelCard, replicas: int = 1) -> dict[int, int]:
+        # ONE critical section for guard + placement + card commit: racing
+        # duplicate deploys must not both pass the guard, and a failed
+        # placement must leave NO state behind (messages go out after the
+        # lock — workers' replies re-enter handlers that take _lock)
         with self._lock:
             if endpoint in self.placements:
                 # re-deploying over a live name would orphan replicas on
@@ -255,8 +259,8 @@ class DeployMasterManager(FedMLCommManager):
                     f"endpoint {endpoint!r} is already deployed; scale() it "
                     "or undeploy() first"
                 )
+            placement = self._place_locked(replicas, endpoint)
             self.cards[endpoint] = card
-        placement = self.place(replicas, endpoint)
         for rank, n in placement.items():
             msg = Message(MSG_TYPE_M2W_DEPLOY, 0, rank)
             msg.add_params(ARG_ENDPOINT, endpoint)
@@ -268,10 +272,13 @@ class DeployMasterManager(FedMLCommManager):
     def scale(self, endpoint: str, replicas: int) -> dict[int, int]:
         with self._lock:
             card = self.cards.get(endpoint)
+            if card is None:
+                # also covers scale-after-undeploy racing: once undeploy
+                # popped the card, a late scale must refuse instead of
+                # resurrecting a placement with no card behind it
+                raise KeyError(f"endpoint {endpoint!r} was never deployed")
             old = dict(self.placements.get(endpoint, {}))
-        if card is None:
-            raise KeyError(f"endpoint {endpoint!r} was never deployed")
-        placement = self.place(replicas, endpoint)
+            placement = self._place_locked(replicas, endpoint)
         for rank in set(old) | set(placement):
             n = placement.get(rank, 0)
             msg = Message(MSG_TYPE_M2W_SCALE, 0, rank)
