@@ -149,6 +149,25 @@ class FedMLAggregator:
         idx = rng.sample_clients_np(round_idx, len(client_ids), per_round)
         return [client_ids[i] for i in idx]
 
+    def data_silo_selection(self, round_idx: int, data_silo_num_in_total: int,
+                            client_num_in_total: int) -> list[int]:
+        """Reference ``data_silo_selection`` (``fedml_aggregator.py:113``)
+        bit-parity: each participating client draws a DISTINCT data-silo
+        index (round-seeded ``np.random.choice`` without replacement);
+        identity when the counts match; more clients than silos is rejected
+        exactly as upstream's assert does."""
+        if data_silo_num_in_total < client_num_in_total:
+            raise ValueError(
+                f"data_silo_num_in_total ({data_silo_num_in_total}) must be "
+                f">= client_num_in_total ({client_num_in_total})"
+            )
+        if data_silo_num_in_total == client_num_in_total:
+            return list(range(data_silo_num_in_total))
+        import numpy as np
+
+        r = np.random.RandomState(round_idx)
+        return r.choice(data_silo_num_in_total, client_num_in_total, replace=False).tolist()
+
 
 class FedMLServerManager(FedMLCommManager):
     def __init__(self, cfg, aggregator: FedMLAggregator, backend: Optional[str] = None,

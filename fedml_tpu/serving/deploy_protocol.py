@@ -236,11 +236,13 @@ class DeployMasterManager(FedMLCommManager):
                 placement[r] += 1
                 free[r] -= 1
                 placed += 1
-        self._place_rr = i
         if placed < replicas:
+            # raise BEFORE committing the cursor or the placement: a failed
+            # attempt must leave no state (a retry sees identical conditions)
             raise RuntimeError(
                 f"cluster capacity exhausted: placed {placed}/{replicas} replicas"
             )
+        self._place_rr = i
         placement = {r: n for r, n in placement.items() if n > 0}
         self.placements[endpoint] = placement
         return placement

@@ -184,6 +184,34 @@ def test_cross_silo_full_protocol(eight_devices):
     assert accs[-1] > 0.4, accs
 
 
+def test_data_silo_selection(eight_devices):
+    """Reference fedml_aggregator.data_silo_selection parity: identity when
+    silo count == client count, round-seeded assignment otherwise."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo import build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = _cs_config(run_id="cs-dss")
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    agg = build_server(cfg, ds, model, backend="INPROC").aggregator
+    assert agg.data_silo_selection(0, 4, 4) == [0, 1, 2, 3]
+    sel = agg.data_silo_selection(3, 30, 6)
+    assert len(sel) == 6 and len(set(sel)) == 6  # distinct (no replacement)
+    assert all(0 <= s < 30 for s in sel)
+    assert sel == agg.data_silo_selection(3, 30, 6)  # round-deterministic
+    # round-seeded: the assignment must actually vary across rounds
+    assert any(agg.data_silo_selection(r, 30, 6) != sel for r in range(4, 10))
+    # bit-parity with the reference's seeded draw
+    np.random.seed(3)
+    assert sel == np.random.choice(30, 6, replace=False).tolist()
+    # more clients than silos is rejected (upstream assert)
+    with pytest.raises(ValueError, match="must be"):
+        agg.data_silo_selection(0, 2, 6)
+
+
 def test_cross_silo_via_runner(eight_devices):
     import fedml_tpu
     from fedml_tpu.runner import FedMLRunner
