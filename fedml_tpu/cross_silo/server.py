@@ -163,8 +163,6 @@ class FedMLAggregator:
             )
         if data_silo_num_in_total == client_num_in_total:
             return list(range(data_silo_num_in_total))
-        import numpy as np
-
         r = np.random.RandomState(round_idx)
         return r.choice(data_silo_num_in_total, client_num_in_total, replace=False).tolist()
 
