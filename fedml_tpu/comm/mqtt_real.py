@@ -132,6 +132,13 @@ class TcpMqttBroker:
     # -- InMemoryBroker interface -------------------------------------------
     def publish(self, topic: str, payload: bytes) -> None:
         self._ensure_connected()
+        # At-least-once on purpose: the wire client and MiniMqttBroker DO
+        # speak full QoS2 (paho at qos=2 interoperates), but with clean
+        # sessions QoS2 narrows rather than closes the loss window — a drop
+        # between the subscriber's PUBREC and the broker's PUBREL strands a
+        # stashed message the QoS1 path would already have delivered.  The
+        # FL protocol handlers are redelivery-tolerant by design (round-
+        # index gates, once-flags), so duplicates are the safe failure mode.
         self._client.publish(topic, payload, qos=1)
 
     def subscribe(self, topic: str, cb: Callable[[str, bytes], None]) -> None:

@@ -7,10 +7,12 @@ has zero egress and no paho-mqtt wheel, so the same proof is made in-repo:
 
 - :class:`MiniMqttBroker` — a real MQTT 3.1.1 broker over TCP: CONNECT (with
   last-will + session takeover), SUBSCRIBE/UNSUBSCRIBE with ``+``/``#``
-  wildcards, PUBLISH QoS 0/1 (PUBACK), PINGREQ/PINGRESP, graceful vs abrupt
-  disconnect semantics (the will fires only on abrupt loss).
+  wildcards, PUBLISH QoS 0/1/2 (PUBACK; full PUBREC/PUBREL/PUBCOMP
+  exactly-once on both legs — the reference publishes everything at QoS2),
+  PINGREQ/PINGRESP, graceful vs abrupt disconnect semantics (the will fires
+  only on abrupt loss).
 - :class:`SocketMqttClient` — a real client with automatic reconnect and
-  re-subscribe, keepalive pings, QoS-1 publish acknowledged end-to-end.
+  re-subscribe, keepalive pings, QoS-1/2 publishes acknowledged end-to-end.
 
 Every byte crosses a real socket in real MQTT framing, so the serialization,
 reconnect, and resubscribe behavior the round-3 verdict flagged as unproven
@@ -30,6 +32,7 @@ from typing import Callable, Optional
 log = logging.getLogger("fedml_tpu.mqtt")
 
 CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
+PUBREC, PUBREL, PUBCOMP = 5, 6, 7
 SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 8, 9, 10, 11
 PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
 
@@ -114,6 +117,9 @@ class _BrokerSession:
         self.alive = True
         self._wlock = threading.Lock()
         self._next_pid = 1
+        # QoS2 exactly-once state: inbound PUBLISHes stashed until PUBREL
+        # (pid -> (topic, payload))
+        self._qos2_in: dict[int, tuple[str, bytes]] = {}
 
     def send(self, data: bytes) -> None:
         with self._wlock:
@@ -155,6 +161,13 @@ class _BrokerSession:
                     self._handle_publish(flags, body)
                 elif ptype == PUBACK:
                     pass  # at-least-once: no broker-side redelivery queue
+                elif ptype == PUBREL:
+                    self._handle_pubrel(body)
+                elif ptype == PUBREC:
+                    (pid,) = struct.unpack_from(">H", body, 0)
+                    self.send(_packet(PUBREL, 0x02, struct.pack(">H", pid)))
+                elif ptype == PUBCOMP:
+                    pass  # outbound QoS2 handshake complete
                 elif ptype == SUBSCRIBE:
                     self._handle_subscribe(body)
                 elif ptype == UNSUBSCRIBE:
@@ -190,11 +203,27 @@ class _BrokerSession:
     def _handle_publish(self, flags: int, body: bytes) -> None:
         qos = (flags >> 1) & 0x03
         topic, off = _take_str(body, 0)
-        if qos:
+        if qos == 2:
+            # exactly-once inbound: stash until PUBREL; a redelivered
+            # PUBLISH with the same pid just refreshes the stash (no double
+            # route), and PUBREC is re-sent idempotently
+            (pid,) = struct.unpack_from(">H", body, off)
+            off += 2
+            self._qos2_in[pid] = (topic, body[off:])
+            self.send(_packet(PUBREC, 0, struct.pack(">H", pid)))
+            return
+        if qos == 1:
             (pid,) = struct.unpack_from(">H", body, off)
             off += 2
             self.send(_packet(PUBACK, 0, struct.pack(">H", pid)))
         self.broker._route(topic, body[off:], qos)
+
+    def _handle_pubrel(self, body: bytes) -> None:
+        (pid,) = struct.unpack_from(">H", body, 0)
+        stashed = self._qos2_in.pop(pid, None)
+        if stashed is not None:  # duplicate PUBREL after release: no re-route
+            self.broker._route(stashed[0], stashed[1], 2)
+        self.send(_packet(PUBCOMP, 0, struct.pack(">H", pid)))
 
     def _handle_subscribe(self, body: bytes) -> None:
         (pid,) = struct.unpack_from(">H", body, 0)
@@ -202,7 +231,7 @@ class _BrokerSession:
         granted = bytearray()
         while off < len(body):
             filt, off = _take_str(body, off)
-            qos = min(body[off] & 0x03, 1)
+            qos = min(body[off] & 0x03, 2)
             off += 1
             with self.broker._lock:
                 self.subs = [s for s in self.subs if s[0] != filt] + [(filt, qos)]
@@ -336,6 +365,11 @@ class SocketMqttClient:
         self._slock = threading.Lock()
         self._next_pid = 1
         self._acks: dict[int, threading.Event] = {}
+        # QoS2 state: outbound pid -> stage event pair; inbound stash until
+        # the broker's PUBREL releases it (exactly-once dispatch)
+        self._qos2_recs: dict[int, threading.Event] = {}
+        self._qos2_comps: dict[int, threading.Event] = {}
+        self._qos2_in: dict[int, tuple[str, bytes]] = {}
         self._connected = threading.Event()
         self._stopping = False
         # connection generation: each connect() bumps it, and reader/ping
@@ -432,6 +466,25 @@ class SocketMqttClient:
                 ev = self._acks.pop(pid, None)
                 if ev:
                     ev.set()
+            elif ptype == PUBREC:
+                (pid,) = struct.unpack_from(">H", body, 0)
+                ev = self._qos2_recs.pop(pid, None)
+                if ev:
+                    ev.set()  # publish() sends the PUBREL (its thread owns retry)
+            elif ptype == PUBCOMP:
+                (pid,) = struct.unpack_from(">H", body, 0)
+                ev = self._qos2_comps.pop(pid, None)
+                if ev:
+                    ev.set()
+            elif ptype == PUBREL:
+                (pid,) = struct.unpack_from(">H", body, 0)
+                stashed = self._qos2_in.pop(pid, None)
+                try:
+                    self._send(_packet(PUBCOMP, 0, struct.pack(">H", pid)))
+                except OSError:
+                    pass
+                if stashed is not None:  # duplicate PUBREL: no re-dispatch
+                    self._dispatch(*stashed)
             elif ptype in (SUBACK, UNSUBACK, PINGRESP):
                 pass
             else:
@@ -465,14 +518,26 @@ class SocketMqttClient:
     def _handle_publish(self, flags: int, body: bytes) -> None:
         qos = (flags >> 1) & 0x03
         topic, off = _take_str(body, 0)
-        if qos:
+        if qos == 2:
+            # exactly-once inbound: stash until the broker's PUBREL releases
+            (pid,) = struct.unpack_from(">H", body, off)
+            off += 2
+            self._qos2_in[pid] = (topic, body[off:])
+            try:
+                self._send(_packet(PUBREC, 0, struct.pack(">H", pid)))
+            except OSError:
+                pass
+            return
+        if qos == 1:
             (pid,) = struct.unpack_from(">H", body, off)
             off += 2
             try:
                 self._send(_packet(PUBACK, 0, struct.pack(">H", pid)))
             except OSError:
                 pass
-        payload = body[off:]
+        self._dispatch(topic, body[off:])
+
+    def _dispatch(self, topic: str, payload: bytes) -> None:
         with self._slock:
             cbs = [cb for t, cb in self._subs.items() if topic_matches(t, topic)]
         for cb in cbs:
@@ -499,36 +564,64 @@ class SocketMqttClient:
         with self._wlock:
             pid = self._next_pid
             self._next_pid = pid % 65535 + 1
-        body = struct.pack(">H", pid) + _enc_str(topic) + bytes([1])
+        body = struct.pack(">H", pid) + _enc_str(topic) + bytes([2])
         self._send(_packet(SUBSCRIBE, 0x02, body))
 
     def publish(self, topic: str, payload: bytes, qos: int = 1,
                 timeout: float = 10.0) -> None:
+        # ONE packet id for all attempts: MQTT DUP redelivery must reuse the
+        # pid — the receiver's exactly-once dedup (and the broker's QoS2
+        # stash) key on it, so a fresh pid per retry would deliver twice
+        with self._wlock:
+            pid = self._next_pid
+            self._next_pid = pid % 65535 + 1
+        body = _enc_str(topic) + struct.pack(">H", pid) + payload
+        rec_seen = False  # QoS2 stage: once PUBREC arrived, retries resend
+        #                   PUBREL only — re-publishing after the broker
+        #                   routed would not be deduped by a clean session
         for attempt in (0, 1):
             if not self._connected.wait(timeout):
                 raise TimeoutError(f"client {self.client_id}: not connected")
+            dup = 0x08 if attempt else 0
             if qos == 0:
                 try:
                     self._send(_packet(PUBLISH, 0, _enc_str(topic) + payload))
                     return
                 except OSError:
                     continue  # reader loop reconnects; one retry
-            with self._wlock:
-                pid = self._next_pid
-                self._next_pid = pid % 65535 + 1
-            ev = threading.Event()
-            self._acks[pid] = ev
+            if qos == 1:
+                ev = threading.Event()
+                self._acks[pid] = ev
+                try:
+                    self._send(_packet(PUBLISH, dup | 0x02, body))
+                    if ev.wait(timeout):
+                        return
+                except OSError:
+                    pass  # fall through to the retry (reader loop reconnects)
+                finally:
+                    # always retire the pending entry: a stranded Event would
+                    # leak per failed publish, and after the pid wrap a fresh
+                    # PUBACK could route to a stale entry
+                    self._acks.pop(pid, None)
+                continue
+            # QoS2 exactly-once: PUBLISH -> PUBREC -> PUBREL -> PUBCOMP
+            rec, comp = threading.Event(), threading.Event()
+            self._qos2_recs[pid] = rec
+            self._qos2_comps[pid] = comp
             try:
-                dup = 0x08 if attempt else 0
-                body = _enc_str(topic) + struct.pack(">H", pid) + payload
-                self._send(_packet(PUBLISH, dup | 0x02, body))
-                if ev.wait(timeout):
+                if not rec_seen:
+                    self._send(_packet(PUBLISH, dup | 0x04, body))
+                    if not rec.wait(timeout):
+                        continue  # no PUBREC: redeliver (same pid, DUP set)
+                    rec_seen = True
+                self._send(_packet(PUBREL, 0x02, struct.pack(">H", pid)))
+                if comp.wait(timeout):
                     return
             except OSError:
-                pass  # fall through to the retry (reader loop reconnects)
+                pass
             finally:
-                # always retire the pending entry: a stranded Event would leak
-                # per failed publish, and after the pid wrap a fresh PUBACK
-                # could route to a stale entry
-                self._acks.pop(pid, None)
-        raise TimeoutError(f"client {self.client_id}: no PUBACK for {topic}")
+                self._qos2_recs.pop(pid, None)
+                self._qos2_comps.pop(pid, None)
+        raise TimeoutError(
+            f"client {self.client_id}: qos{qos} handshake incomplete for {topic}"
+        )

@@ -121,6 +121,70 @@ def test_reconnect_resubscribes_and_traffic_resumes(broker):
         pub.disconnect()
 
 
+def test_qos2_exactly_once_roundtrip(broker):
+    """QoS2 publish completes the PUBREC/PUBREL/PUBCOMP handshake and the
+    subscriber sees the message exactly once."""
+    got = []
+    sub, pub = _client(broker, "q2sub"), _client(broker, "q2pub")
+    sub.connect()
+    pub.connect()
+    try:
+        sub.subscribe("fl/q2", lambda t, p: got.append(p))
+        time.sleep(0.2)
+        for i in range(5):
+            pub.publish("fl/q2", f"m{i}".encode(), qos=2)  # blocks to PUBCOMP
+        _wait(lambda: len(got) >= 5, msg="qos2 deliveries")
+        time.sleep(0.2)
+        assert got == [f"m{i}".encode() for i in range(5)], got
+        # handshake state fully drained on both ends
+        assert not pub._qos2_recs and not pub._qos2_comps
+        assert not sub._qos2_in
+    finally:
+        sub.disconnect()
+        pub.disconnect()
+
+
+def test_qos2_duplicate_publish_delivered_once(broker):
+    """A redelivered QoS2 PUBLISH (same pid, before PUBREL) must reach the
+    subscriber exactly once — the stash-until-PUBREL contract.  Speaks the
+    raw wire so the duplicate is byte-exact."""
+    import socket
+    import struct
+
+    from fedml_tpu.comm import mqtt_wire as w
+
+    got = []
+    sub = _client(broker, "dupsub")
+    sub.connect()
+    try:
+        sub.subscribe("fl/dup", lambda t, p: got.append(p))
+        time.sleep(0.2)
+
+        raw = socket.create_connection(("127.0.0.1", broker.port), timeout=5)
+        body = w._enc_str("MQTT") + bytes([4, 0x02]) + struct.pack(">H", 30) + w._enc_str("rawdup")
+        raw.sendall(w._packet(w.CONNECT, 0, body))
+        assert w._read_packet(raw)[0] == w.CONNACK
+
+        pub_body = w._enc_str("fl/dup") + struct.pack(">H", 7) + b"once"
+        raw.sendall(w._packet(w.PUBLISH, 0x04, pub_body))          # qos2 pid=7
+        assert w._read_packet(raw)[0] == w.PUBREC
+        raw.sendall(w._packet(w.PUBLISH, 0x0C, pub_body))          # DUP redelivery
+        assert w._read_packet(raw)[0] == w.PUBREC                  # idempotent
+        time.sleep(0.3)
+        assert got == [], "must not deliver before PUBREL"
+        raw.sendall(w._packet(w.PUBREL, 0x02, struct.pack(">H", 7)))
+        assert w._read_packet(raw)[0] == w.PUBCOMP
+        _wait(lambda: got == [b"once"], msg="exactly-once delivery")
+        # duplicate PUBREL after release: PUBCOMP again, still no re-delivery
+        raw.sendall(w._packet(w.PUBREL, 0x02, struct.pack(">H", 7)))
+        assert w._read_packet(raw)[0] == w.PUBCOMP
+        time.sleep(0.3)
+        assert got == [b"once"]
+        raw.close()
+    finally:
+        sub.disconnect()
+
+
 def test_session_takeover_closes_old_connection(broker):
     first = _client(broker, "same-id")
     first.connect()
