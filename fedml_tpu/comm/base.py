@@ -7,10 +7,14 @@ notifies registered observers on receive.
 
 from __future__ import annotations
 
+import logging
 import queue
+import time
 from abc import ABC, abstractmethod
 
 from .message import Message
+
+log = logging.getLogger(__name__)
 
 
 class Observer(ABC):
@@ -47,12 +51,47 @@ class ObserverLoopMixin:
         self._running = True
         while self._running:
             try:
-                data = self._inbox.get(timeout=0.05)
+                item = self._inbox.get(timeout=0.05)
             except queue.Empty:
                 continue
-            msg = self._decode_bytes(data)
+            # re-enqueued items carry their retry count (see below)
+            data, attempts = item if isinstance(item, tuple) else (item, 0)
+            try:
+                msg = self._decode_bytes(data)
+            except (KeyError, ValueError):
+                # a genuinely poisoned payload (store blob truly absent ->
+                # KeyError, corrupt framing -> ValueError) must not kill the
+                # receive loop: that silently drops every subsequent FL
+                # message for the life of the process.  Drop it loudly.
+                log.exception("dropping undecodable message (%d bytes)", len(data))
+                continue
+            except Exception:
+                # transient decode failure (object store briefly unreachable,
+                # HTTP 5xx/reset): the blob may well exist — MQTT already
+                # acked, so there is no transport redelivery.  Retry a few
+                # times before giving up.
+                if attempts < 3:
+                    log.warning(
+                        "transient decode failure (attempt %d) — requeueing",
+                        attempts + 1, exc_info=True,
+                    )
+                    time.sleep(0.2 * (attempts + 1))
+                    self._inbox.put((data, attempts + 1))
+                else:
+                    log.exception(
+                        "dropping message after %d decode attempts", attempts + 1
+                    )
+                continue
             for obs in list(self._observers):
-                obs.receive_message(msg.get_type(), msg)
+                try:
+                    obs.receive_message(msg.get_type(), msg)
+                except Exception:
+                    # a handler crash must not kill the loop either — same
+                    # invariant as the decode guard above
+                    log.exception(
+                        "observer %r failed on message type %s",
+                        obs, msg.get_type(),
+                    )
 
     def stop_receive_message(self) -> None:
         self._running = False

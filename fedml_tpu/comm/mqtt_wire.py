@@ -398,6 +398,12 @@ class SocketMqttClient:
         threading.Thread(target=self._ping_loop, args=(gen,), daemon=True).start()
 
     def _do_connect(self) -> None:
+        # clean-session connect: the broker forgets the QoS2 handshake, so a
+        # PUBLISH stashed between PUBREC and PUBREL will never see its PUBREL
+        # — drop the stash or it is stranded (never dispatched, never freed).
+        # Outbound _acks/_qos2_recs/_qos2_comps are owned by their publish()
+        # threads, which time out and retire their own entries.
+        self._qos2_in.clear()
         sock = socket.create_connection((self.host, self.port), timeout=10)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         flags = 0x02  # clean session

@@ -12,6 +12,7 @@ same interface for real deployments.
 from __future__ import annotations
 
 import threading
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -90,7 +91,14 @@ class HttpObjectStore:
         return key
 
     def get(self, key: str) -> bytes:
-        with urllib.request.urlopen(
-            f"{self.base_url}/{key}", timeout=self.timeout
-        ) as r:
-            return r.read()
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}/{key}", timeout=self.timeout
+            ) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                # keep the InMemoryObjectStore contract: callers handling a
+                # missing-payload race catch KeyError, not HTTPError
+                raise KeyError(key) from e
+            raise

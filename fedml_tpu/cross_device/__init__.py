@@ -112,13 +112,17 @@ class ServerMNN(FedMLServerManager):
         # round-0 upload — is not evidence of life and must not reset the
         # strike counter.
         with self._agg_lock:
-            up_round = msg.get(md.MSG_ARG_KEY_ROUND_INDEX)
+            try:  # a malformed/hostile ROUND_INDEX must not kill the handler
+                # coerce ONCE and use the coerced value for both checks: a
+                # transport delivering the index as a string would otherwise
+                # keep liveness working while silently denying attendance
+                # credit every round (strikes against healthy devices)
+                up_round = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
+            except (TypeError, ValueError):
+                up_round = None
             if up_round == self.round_idx:
                 self._uploaded_this_round.add(msg.get_sender_id())
-            try:  # a malformed/hostile ROUND_INDEX must not kill the handler
-                recent = up_round is not None and int(up_round) >= self.round_idx - 1
-            except (TypeError, ValueError):
-                recent = False
+            recent = up_round is not None and up_round >= self.round_idx - 1
         if recent:
             self.registry.note_participation(msg.get_sender_id())
         super().handle_message_receive_model(msg)

@@ -156,8 +156,10 @@ def make_local_train_fn(
             if hp.step_mode == "match":
                 active = s < own_steps
                 if stateless_opt:
+                    # where(), not u*active: inf/NaN updates on inactive steps
+                    # would turn 0*inf into NaN and corrupt the frozen params
                     updates = jax.tree_util.tree_map(
-                        lambda u: u * active.astype(u.dtype), updates
+                        lambda u: jnp.where(active, u, jnp.zeros_like(u)), updates
                     )
                     new_params = optax.apply_updates(params, updates)
                 else:

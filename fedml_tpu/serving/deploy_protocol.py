@@ -94,7 +94,19 @@ class DeployWorkerManager(FedMLCommManager):
         try:
             if name in self.sched.endpoints:
                 # a redelivered/duplicate DEPLOY must not overwrite the live
-                # Endpoint record (the old replica processes would leak)
+                # Endpoint record (the old replica processes would leak) —
+                # but a duplicate carrying a DIFFERENT card means the master
+                # wants a different model under this name; serving the old
+                # one silently would be wrong, so say so loudly
+                live = self.sched.endpoints[name].card
+                if card != live:
+                    log.warning(
+                        "worker %d: duplicate DEPLOY for live endpoint %s "
+                        "carries a different card (%s:%s vs live %s:%s) — "
+                        "keeping the live model; undeploy first to replace",
+                        self.rank, name, card.name, card.version,
+                        live.name, live.version,
+                    )
                 self.sched.scale(name, replicas)
             else:
                 self.sched.cards.register(card)

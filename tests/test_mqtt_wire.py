@@ -198,8 +198,6 @@ def test_session_takeover_closes_old_connection(broker):
 
 
 def test_http_object_store_roundtrip():
-    import urllib.error
-
     from fedml_tpu.comm.object_store_http import HttpObjectStore, MiniObjectStoreServer
 
     srv = MiniObjectStoreServer()
@@ -209,10 +207,80 @@ def test_http_object_store_roundtrip():
         blob = bytes(range(256)) * 200  # 51 KB binary
         assert store.put("run/abc", blob) == "run/abc"
         assert store.get("run/abc") == blob
-        with pytest.raises(urllib.error.HTTPError):
+        # missing key raises KeyError — the InMemoryObjectStore contract the
+        # HTTP store substitutes for (callers handle missing-payload races)
+        with pytest.raises(KeyError):
             store.get("run/missing")
     finally:
         srv.stop()
+
+
+def test_poisoned_message_does_not_kill_receive_loop():
+    """A store-ref to a never-PUT key (missing-payload race -> KeyError) or
+    garbage framing (ValueError) must be dropped, not kill the comm manager's
+    receive thread — a dead loop silently drops every later FL message."""
+    import json
+
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.mqtt_real import TcpMqttBroker
+    from fedml_tpu.comm.mqtt_s3 import MqttS3CommManager
+    from fedml_tpu.comm.mqtt_wire import MiniMqttBroker, SocketMqttClient
+    from fedml_tpu.comm.object_store_http import (
+        HttpObjectStore,
+        MiniObjectStoreServer,
+    )
+
+    broker = MiniMqttBroker()
+    broker.start()
+    store_srv = MiniObjectStoreServer()
+    store_srv.start()
+    mgr = peer = evil = None
+    try:
+        got = []
+
+        class Obs:
+            def receive_message(self, t, m):
+                got.append((t, m.get("k")))
+
+        mgr = MqttS3CommManager(
+            "poison", 0,
+            broker=TcpMqttBroker("127.0.0.1", broker.port, client_id="poison_0"),
+            store=HttpObjectStore(store_srv.url),
+        )
+        mgr.add_observer(Obs())
+        threading.Thread(target=mgr.handle_receive_message, daemon=True).start()
+        time.sleep(0.3)
+        evil = SocketMqttClient("127.0.0.1", broker.port, "evil")
+        evil.connect()
+        evil.publish(
+            "fedml_poison_to_0",
+            b"R" + json.dumps({"store_key": "poison/never-put"}).encode(),
+        )
+        evil.publish("fedml_poison_to_0", b"D\xde\xad\xbe\xef")
+        time.sleep(0.3)
+        peer = MqttS3CommManager(
+            "poison", 1,
+            broker=TcpMqttBroker("127.0.0.1", broker.port, client_id="poison_1"),
+            store=HttpObjectStore(store_srv.url),
+        )
+        m = Message(7, 1, 0)
+        m.add("k", "alive")
+        peer.send_message(m)
+        _wait(lambda: bool(got), msg="post-poison delivery")
+        assert got[0] == (7, "alive")
+    finally:
+        # shut the wire clients down BEFORE the broker dies, or their
+        # reconnect loops spin at 10 Hz against the closed port for the
+        # rest of the pytest session (and could attach to a reused port)
+        if mgr is not None:
+            mgr.stop_receive_message()
+            mgr.broker.disconnect()
+        if peer is not None:
+            peer.broker.disconnect()
+        if evil is not None:
+            evil.disconnect()
+        broker.stop()
+        store_srv.stop()
 
 
 # ---------------------------------------------------------------------------
