@@ -1,0 +1,241 @@
+"""Customized workflow jobs — DAG nodes that drive the real verticals.
+
+Parity with ``workflow/customized_jobs/`` in the reference
+(``train_job.py:1`` — ``TrainJob`` wraps a ``fedml launch`` yaml, polls run
+status, exposes outputs downstream; ``model_deploy_job.py`` — deploys a model
+and exposes the endpoint), re-built on this repo's own verticals:
+
+- :class:`LaunchJob` packages a job yaml into the agent spool
+  (:class:`~fedml_tpu.sched.launch.FedMLLaunchManager`), waits on the shared
+  ``JobDB`` until an agent has run it, and exposes the run's ``output.json``
+  to downstream jobs.
+- :class:`DeployJob` registers the upstream artifact as a
+  :class:`~fedml_tpu.serving.deploy.ModelCard`, drives a
+  :class:`~fedml_tpu.serving.deploy.ModelDeployScheduler` (or the
+  master/worker :class:`~fedml_tpu.serving.deploy_protocol.DeployMasterManager`)
+  to readiness, and exposes a live ``predict`` callable.
+
+Dependency feeding: a job's ``run(**inputs)`` receives its dependencies'
+outputs keyed by job name (``Workflow.run``).  LaunchJob serializes those
+inputs to ``__workflow_inputs__.json`` inside the packaged workspace so the
+launched process can read them (the reference threads outputs through
+dynamically-built yamls; a file in the package is the spool-transport
+equivalent).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from .workflow import Job, JobStatus
+
+log = logging.getLogger("fedml_tpu.workflow")
+
+
+def _jsonable(tree: Any) -> Any:
+    """Best-effort JSON projection of dependency outputs: non-serializable
+    values (live scheduler handles, callables) are replaced by their repr —
+    a launched subprocess can only consume data, not live objects."""
+    try:
+        json.dumps(tree)
+        return tree
+    except (TypeError, ValueError):
+        pass
+    if isinstance(tree, dict):
+        return {str(k): _jsonable(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_jsonable(v) for v in tree]
+    return repr(tree)
+
+
+class LaunchJob(Job):
+    """A workflow node wrapping ``fedml launch job.yaml``.
+
+    Reference ``TrainJob`` behavior (``customized_jobs/train_job.py``): build
+    the run package, submit, poll status until terminal, surface the run's
+    output.  The agent consuming the spool may live in another thread or
+    another process — status is read from the shared sqlite ``JobDB``, not
+    from an in-memory agent handle.
+
+    Output contract: the launched job may write an ``output.json`` in its run
+    directory (its cwd); its parsed content is merged into this job's output
+    dict alongside ``run_id`` / ``run_dir`` / ``returncode``.
+    """
+
+    def __init__(self, name: str, yaml_path: str, spool_dir: str,
+                 timeout: float = 600.0, poll_s: float = 0.3):
+        super().__init__(name)
+        self.yaml_path = str(yaml_path)
+        self.spool_dir = str(spool_dir)
+        self.timeout = timeout
+        self.poll_s = poll_s
+        self.run_id: Optional[str] = None
+
+    def run(self, **inputs) -> dict:
+        from ..sched.agent import JobDB
+        from ..sched.launch import FedMLLaunchManager, JobSpec
+
+        self.status = JobStatus.RUNNING
+        try:
+            spec = JobSpec.from_yaml(self.yaml_path)
+            ws = Path(self.yaml_path).parent / spec.workspace
+            if inputs:
+                # feed dependency outputs INTO the package: the launched
+                # process reads __workflow_inputs__.json from its cwd
+                (ws / "__workflow_inputs__.json").write_text(
+                    json.dumps(_jsonable(inputs))
+                )
+            mgr = FedMLLaunchManager(self.spool_dir)
+            pkg = mgr.build_package(spec, base_dir=str(Path(self.yaml_path).parent))
+            self.run_id = pkg.stem
+            log.info("workflow job %s: launched %s", self.name, self.run_id)
+
+            db = JobDB(str(Path(self.spool_dir) / "jobs.sqlite"))
+            deadline = time.time() + self.timeout
+            row = None
+            while time.time() < deadline:
+                row = db.get(self.run_id)
+                if row and row["status"] in ("FINISHED", "FAILED"):
+                    break
+                time.sleep(self.poll_s)
+            else:
+                raise TimeoutError(
+                    f"run {self.run_id} not terminal after {self.timeout}s "
+                    f"(last status: {(row or {}).get('status', 'never claimed')}"
+                    " — is an agent sweeping this spool?)"
+                )
+            run_dir = Path(self.spool_dir) / "runs" / self.run_id
+            if row["status"] == "FAILED":
+                tail = ""
+                lp = row.get("log_path")
+                if lp and Path(lp).exists():
+                    tail = Path(lp).read_text()[-2000:]
+                raise RuntimeError(
+                    f"run {self.run_id} FAILED (rc={row.get('returncode')}):\n{tail}"
+                )
+            out = {
+                "run_id": self.run_id,
+                "run_dir": str(run_dir),
+                "returncode": row.get("returncode"),
+            }
+            out_file = run_dir / "output.json"
+            if out_file.exists():
+                out.update(json.loads(out_file.read_text()))
+            self.output = out
+            self.status = JobStatus.FINISHED
+            return out
+        except BaseException as e:
+            self.status = JobStatus.FAILED
+            self.error = e
+            raise
+
+
+class DeployJob(Job):
+    """A workflow node that deploys an upstream model artifact and exposes a
+    live endpoint (reference ``model_deploy_job.py``).
+
+    The artifact is found in the dependencies' outputs: the first dep dict
+    carrying ``params_path`` wins (``model`` / ``classes`` / ``model_name`` /
+    ``model_version`` ride along when present); explicit constructor kwargs
+    override.  Deploys via an injected
+    :class:`~fedml_tpu.serving.deploy.ModelDeployScheduler` (in-proc,
+    process replicas) or an injected
+    :class:`~fedml_tpu.serving.deploy_protocol.DeployMasterManager`
+    (master/worker placement over the FL transport).
+
+    Output: ``{"endpoint", "ready_replicas", "predict"}`` where ``predict``
+    is a callable routing through the live gateway — downstream jobs (or the
+    caller) can serve requests immediately.
+    """
+
+    def __init__(self, name: str, endpoint: str, scheduler=None, master=None,
+                 model_name: str = "", model_version: str = "v1",
+                 model: str = "", classes: int = 0, params_path: str = "",
+                 replicas: int = 1, ready_timeout: float = 120.0):
+        super().__init__(name)
+        if (scheduler is None) == (master is None):
+            raise ValueError("pass exactly one of scheduler= or master=")
+        self.endpoint = endpoint
+        self.scheduler = scheduler
+        self.master = master
+        self.model_name = model_name
+        self.model_version = model_version
+        self.model = model
+        self.classes = classes
+        self.params_path = params_path
+        self.replicas = replicas
+        self.ready_timeout = ready_timeout
+
+    def _resolve_card(self, inputs: dict):
+        from ..serving.deploy import ModelCard
+
+        src: dict = {}
+        for dep_out in inputs.values():
+            if isinstance(dep_out, dict) and dep_out.get("params_path"):
+                src = dep_out
+                break
+        params_path = self.params_path or src.get("params_path", "")
+        if not params_path:
+            raise ValueError(
+                f"deploy job {self.name!r}: no params_path — neither passed "
+                "explicitly nor found in any dependency output"
+            )
+        return ModelCard(
+            name=self.model_name or src.get("model_name", self.endpoint),
+            version=self.model_version,
+            model=self.model or src.get("model", "lr"),
+            classes=int(self.classes or src.get("classes", 10)),
+            params_path=params_path,
+        )
+
+    def run(self, **inputs) -> dict:
+        self.status = JobStatus.RUNNING
+        try:
+            card = self._resolve_card(inputs)
+            if self.scheduler is not None:
+                out = self._run_scheduler(card)
+            else:
+                out = self._run_master(card)
+            self.output = out
+            self.status = JobStatus.FINISHED
+            return out
+        except BaseException as e:
+            self.status = JobStatus.FAILED
+            self.error = e
+            raise
+
+    def _run_scheduler(self, card) -> dict:
+        sched = self.scheduler
+        sched.cards.register(card)
+        sched.deploy(self.endpoint, card.name, card.version, replicas=self.replicas)
+        if not sched.wait_ready(self.endpoint, replicas=self.replicas,
+                                timeout=self.ready_timeout):
+            raise TimeoutError(
+                f"endpoint {self.endpoint!r} not ready after {self.ready_timeout}s"
+            )
+        ep = sched.endpoints[self.endpoint]
+        return {
+            "endpoint": self.endpoint,
+            "ready_replicas": len(ep.ready_ports()),
+            "predict": lambda request, _s=sched: _s.predict(self.endpoint, request),
+        }
+
+    def _run_master(self, card) -> dict:
+        master = self.master
+        master.deploy(self.endpoint, card, replicas=self.replicas)
+        if not master.wait_ready(self.endpoint, self.replicas,
+                                 timeout=self.ready_timeout):
+            raise TimeoutError(
+                f"endpoint {self.endpoint!r}: "
+                f"{len(master.ready_targets(self.endpoint))}/{self.replicas} "
+                f"replicas ready after {self.ready_timeout}s"
+            )
+        return {
+            "endpoint": self.endpoint,
+            "ready_replicas": len(master.ready_targets(self.endpoint)),
+            "predict": lambda request, _m=master: _m.predict(self.endpoint, request),
+        }

@@ -1,0 +1,171 @@
+"""Workflow customized jobs (VERDICT r4 item 3): the DAG engine driving the
+REAL sched + serving verticals — a LaunchJob that packages a config into the
+agent spool and waits on JobDB, feeding a DeployJob that brings an endpoint
+to readiness and serves a predict.
+
+Reference: ``workflow/customized_jobs/train_job.py``,
+``model_deploy_job.py``, ``workflow/jobs.py:43``.
+"""
+
+import json
+import os
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+
+TRAIN_MAIN = textwrap.dedent("""
+    import json, os
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.serving.deploy import save_params_card
+    from fedml_tpu.sim.engine import MeshSimulator
+
+    cfg = fedml_tpu.init(argv=["--cf", "fedml_config.yaml"])
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    sim = MeshSimulator(cfg, ds, model)
+    for _ in range(cfg.comm_round):
+        sim.run_round()
+    path = save_params_card(sim.global_vars, "model.wire")
+    seen = {}
+    if os.path.exists("__workflow_inputs__.json"):
+        with open("__workflow_inputs__.json") as f:
+            seen = json.load(f)
+    with open("output.json", "w") as f:
+        json.dump({
+            "params_path": os.path.abspath(path),
+            "model": cfg.model,
+            "classes": ds.class_num,
+            "model_name": "wf-trained",
+            "seen_inputs": seen,
+        }, f)
+""")
+
+TRAIN_CONFIG = textwrap.dedent("""
+    common_args:
+      training_type: "simulation"
+      random_seed: 0
+    data_args:
+      dataset: "synthetic"
+      partition_method: "homo"
+      synthetic_train_size: 320
+      synthetic_test_size: 80
+    model_args:
+      model: "lr"
+    train_args:
+      federated_optimizer: "FedAvg"
+      client_num_in_total: 4
+      client_num_per_round: 2
+      comm_round: 2
+      epochs: 1
+      batch_size: 16
+      learning_rate: 0.1
+""")
+
+
+def _make_train_workspace(root: Path) -> Path:
+    """A launchable workspace + job yaml, reference launch-example shape."""
+    ws = root / "train_ws"
+    ws.mkdir()
+    (ws / "main.py").write_text(TRAIN_MAIN)
+    (ws / "fedml_config.yaml").write_text(TRAIN_CONFIG)
+    (root / "job.yaml").write_text(
+        "workspace: train_ws\n"
+        "job: python main.py\n"
+        "job_name: wf-train\n"
+    )
+    return root / "job.yaml"
+
+
+def test_workflow_trains_then_deploys_then_serves(tmp_path, eight_devices):
+    """The reference's headline workflow: a 2-node DAG where node 1 launches
+    a (tiny) federated training run through the agent spool and node 2
+    deploys the produced artifact and answers a predict — plus a leading
+    config node proving dependency outputs reach the launched process."""
+    from fedml_tpu.sched.agent import FedMLAgent
+    from fedml_tpu.serving.deploy import ModelDeployScheduler
+    from fedml_tpu.workflow.customized_jobs import DeployJob, LaunchJob
+    from fedml_tpu.workflow.workflow import Job, JobStatus, Workflow
+
+    spool = tmp_path / "spool"
+    yaml_path = _make_train_workspace(tmp_path)
+
+    agent = FedMLAgent(str(spool), env={"JAX_PLATFORMS": "cpu"},
+                       capacity={"num_devices": 1})
+    agent.run_in_thread(poll_s=0.2)
+    sched = ModelDeployScheduler(str(tmp_path / "endpoints.db"),
+                                 reconcile_interval_s=0.3)
+    try:
+        wf = Workflow("train-deploy")
+        cfg_job = Job("config", fn=lambda: {"tag": "e2e", "lr": 0.1})
+        train = LaunchJob("train", str(yaml_path), str(spool), timeout=420)
+        deploy = DeployJob("deploy", endpoint="wf-ep", scheduler=sched,
+                           replicas=1, ready_timeout=180)
+        wf.add_job(cfg_job)
+        wf.add_job(train, dependencies=[cfg_job])
+        wf.add_job(deploy, dependencies=[train])
+        outputs = wf.run()
+
+        # the launch job surfaced the run's output.json
+        assert outputs["train"]["model"] == "lr"
+        assert Path(outputs["train"]["params_path"]).exists()
+        # dependency outputs reached the launched subprocess via the package
+        assert outputs["train"]["seen_inputs"] == {"config": {"tag": "e2e", "lr": 0.1}}
+        # the deploy job exposed a LIVE endpoint
+        assert outputs["deploy"]["ready_replicas"] == 1
+        out = outputs["deploy"]["predict"]({"inputs": np.zeros((2, 32)).tolist()})
+        assert len(out["outputs"]) == 2 and len(out["outputs"][0]) == 10
+        assert wf.get_workflow_status() == JobStatus.FINISHED
+    finally:
+        sched.stop()
+        agent.stop()
+
+
+def test_launch_job_failure_propagates(tmp_path):
+    """A FAILED run fails the LaunchJob (with the log tail in the error) and
+    the workflow reports FAILED — reference Workflow status semantics."""
+    from fedml_tpu.sched.agent import FedMLAgent
+    from fedml_tpu.workflow.customized_jobs import LaunchJob
+    from fedml_tpu.workflow.workflow import JobStatus, Workflow
+
+    spool = tmp_path / "spool"
+    ws = tmp_path / "bad_ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("import sys; print('boom-marker'); sys.exit(3)\n")
+    (tmp_path / "job.yaml").write_text("workspace: bad_ws\njob: python main.py\n")
+
+    agent = FedMLAgent(str(spool), capacity={"num_devices": 1})
+    agent.run_in_thread(poll_s=0.2)
+    try:
+        wf = Workflow("failing")
+        job = LaunchJob("bad", str(tmp_path / "job.yaml"), str(spool), timeout=60)
+        wf.add_job(job)
+        with pytest.raises(RuntimeError, match="boom-marker"):
+            wf.run()
+        assert job.status == JobStatus.FAILED
+        assert wf.get_workflow_status() == JobStatus.FAILED
+    finally:
+        agent.stop()
+
+
+def test_deploy_job_requires_artifact(tmp_path):
+    """No params_path anywhere -> a loud ValueError, not a half-deploy."""
+    from fedml_tpu.serving.deploy import ModelDeployScheduler
+    from fedml_tpu.workflow.customized_jobs import DeployJob
+
+    sched = ModelDeployScheduler(str(tmp_path / "e.db"))
+    job = DeployJob("d", endpoint="none", scheduler=sched)
+    with pytest.raises(ValueError, match="params_path"):
+        job.run(dep={"no": "artifact"})
+    assert job.status.value == "FAILED"
+
+
+def test_deploy_job_rejects_ambiguous_target():
+    from fedml_tpu.workflow.customized_jobs import DeployJob
+
+    with pytest.raises(ValueError, match="exactly one"):
+        DeployJob("d", endpoint="x")
