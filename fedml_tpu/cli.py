@@ -306,6 +306,42 @@ def cmd_storage(args) -> int:
     return 2
 
 
+# -- obs (round tracing / metrics trails; ISSUE 1 observability layer) -------
+
+def cmd_obs(args) -> int:
+    """Reconstruct round timelines from collector/metrics JSONL trails
+    (written by ObsCollector via extra.obs_jsonl_path, or MetricsLogger)."""
+    from fedml_tpu.obs import report as obs_report
+
+    if args.obs_cmd == "report":
+        records = []
+        for path in args.jsonl:
+            if not Path(path).exists():
+                print(f"error: no trail {path}", file=sys.stderr)
+                return 2
+            records.extend(obs_report.load_jsonl(path))
+        if not records:
+            print("error: trails contain no records", file=sys.stderr)
+            return 1
+        print(obs_report.render_report(records), end="")
+        return 0
+    if args.obs_cmd == "serve":
+        from fedml_tpu.obs.registry import REGISTRY, MetricsHTTPServer
+
+        server = MetricsHTTPServer(REGISTRY, port=args.port).start()
+        print(f"serving /metrics and /healthz on :{server.port}", file=sys.stderr)
+        try:
+            import time as _t
+
+            while True:
+                _t.sleep(1)
+        except KeyboardInterrupt:
+            server.close()
+        return 0
+    print(f"unknown obs subcommand {args.obs_cmd}", file=sys.stderr)
+    return 2
+
+
 def cmd_diagnosis(args) -> int:
     """Reference diagnosis.py checks SaaS/MQTT/S3 connectivity; here the
     self-hosted equivalents: jax backend usable, a jit executes, the spool is
@@ -438,6 +474,14 @@ def main(argv=None) -> int:
     sdel = ssub.add_parser("delete")
     sdel.add_argument("path")
     p.set_defaults(fn=cmd_storage)
+
+    p = sub.add_parser("obs", help="observability: round timelines, metrics endpoint")
+    osub = p.add_subparsers(dest="obs_cmd", required=True)
+    orep = osub.add_parser("report", help="round timeline + straggler report from JSONL trails")
+    orep.add_argument("jsonl", nargs="+", help="collector/metrics JSONL trail path(s)")
+    oserve = osub.add_parser("serve", help="serve /metrics + /healthz for this process")
+    oserve.add_argument("--port", type=int, default=9109)
+    p.set_defaults(fn=cmd_obs)
 
     p = sub.add_parser("diagnosis", help="environment/connectivity self-check")
     p.set_defaults(fn=cmd_diagnosis)

@@ -3,6 +3,11 @@
 Parity with ``core/distributed/communication/base_com_manager.py`` and
 ``observer.py``: a backend moves ``Message``s between numbered endpoints and
 notifies registered observers on receive.
+
+The receive loop is also the transport-agnostic metering point: every
+backend funnels raw payloads through it, so messages/bytes received, decode
+drops, and transient-decode retries are counted here in the process-global
+:mod:`~fedml_tpu.obs.registry` regardless of transport.
 """
 
 from __future__ import annotations
@@ -12,9 +17,50 @@ import queue
 import time
 from abc import ABC, abstractmethod
 
+from ..obs import registry as obsreg
 from .message import Message
 
 log = logging.getLogger(__name__)
+
+# transport-agnostic comm metrics (send-side counterparts live in
+# comm_manager.FedMLCommManager.send_message, the one choke point every
+# protocol send passes through)
+MSG_RECEIVED = obsreg.REGISTRY.counter(
+    "fedml_comm_messages_received_total",
+    "Messages decoded and dispatched to observers, by protocol message type.",
+    labels=("type",),
+)
+BYTES_RECEIVED = obsreg.REGISTRY.counter(
+    "fedml_comm_bytes_received_total",
+    "Wire bytes of successfully decoded messages.",
+)
+MSG_DROPPED = obsreg.REGISTRY.counter(
+    "fedml_comm_messages_dropped_total",
+    "Messages dropped in the receive loop, by reason.",
+    labels=("reason",),
+)
+DECODE_RETRIES = obsreg.REGISTRY.counter(
+    "fedml_comm_decode_retries_total",
+    "Transient decode failures deferred for retry (not yet dropped).",
+)
+HANDLER_ERRORS = obsreg.REGISTRY.counter(
+    "fedml_comm_handler_errors_total",
+    "Observer/handler exceptions contained by the receive loop.",
+)
+MSG_SENT = obsreg.REGISTRY.counter(
+    "fedml_comm_messages_sent_total",
+    "Messages handed to a transport send, by protocol message type.",
+    labels=("type",),
+)
+SEND_LATENCY = obsreg.REGISTRY.histogram(
+    "fedml_comm_send_latency_seconds",
+    "Transport send() wall time, by protocol message type.",
+    labels=("type",),
+)
+
+#: transient decode failures are retried this many times with linear backoff
+DECODE_RETRY_LIMIT = 3
+DECODE_RETRY_BACKOFF_S = 0.2
 
 
 class Observer(ABC):
@@ -49,13 +95,29 @@ class ObserverLoopMixin:
 
     def handle_receive_message(self) -> None:
         self._running = True
+        # transiently-undecodable payloads wait here with a not-before
+        # timestamp instead of sleeping the loop or cycling through the
+        # inbox: healthy messages keep draining in arrival order while a
+        # flaky object-store blob backs off
+        retry_pending: list[tuple[float, bytes, int]] = []
         while self._running:
-            try:
-                item = self._inbox.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            # re-enqueued items carry their retry count (see below)
-            data, attempts = item if isinstance(item, tuple) else (item, 0)
+            item = None
+            if retry_pending:
+                now = time.monotonic()
+                for i, (not_before, data, attempts) in enumerate(retry_pending):
+                    if not_before <= now:
+                        item = (data, attempts)
+                        del retry_pending[i]
+                        break
+            if item is None:
+                try:
+                    raw = self._inbox.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                # pre-redesign requeues carried (data, attempts) tuples;
+                # accept both shapes so a mid-upgrade inbox still drains
+                item = raw if isinstance(raw, tuple) else (raw, 0)
+            data, attempts = item
             try:
                 msg = self._decode_bytes(data)
             except (KeyError, ValueError):
@@ -63,31 +125,40 @@ class ObserverLoopMixin:
                 # KeyError, corrupt framing -> ValueError) must not kill the
                 # receive loop: that silently drops every subsequent FL
                 # message for the life of the process.  Drop it loudly.
+                MSG_DROPPED.inc(reason="undecodable")
                 log.exception("dropping undecodable message (%d bytes)", len(data))
                 continue
             except Exception:
                 # transient decode failure (object store briefly unreachable,
                 # HTTP 5xx/reset): the blob may well exist — MQTT already
-                # acked, so there is no transport redelivery.  Retry a few
-                # times before giving up.
-                if attempts < 3:
+                # acked, so there is no transport redelivery.  Defer and
+                # retry a few times before giving up.
+                if attempts < DECODE_RETRY_LIMIT:
+                    DECODE_RETRIES.inc()
                     log.warning(
-                        "transient decode failure (attempt %d) — requeueing",
+                        "transient decode failure (attempt %d) — deferring",
                         attempts + 1, exc_info=True,
                     )
-                    time.sleep(0.2 * (attempts + 1))
-                    self._inbox.put((data, attempts + 1))
+                    retry_pending.append((
+                        time.monotonic() + DECODE_RETRY_BACKOFF_S * (attempts + 1),
+                        data, attempts + 1,
+                    ))
                 else:
+                    MSG_DROPPED.inc(reason="retries_exhausted")
                     log.exception(
                         "dropping message after %d decode attempts", attempts + 1
                     )
                 continue
+            MSG_RECEIVED.inc(type=str(msg.get_type()))
+            if isinstance(data, (bytes, bytearray, memoryview)):
+                BYTES_RECEIVED.inc(len(data))
             for obs in list(self._observers):
                 try:
                     obs.receive_message(msg.get_type(), msg)
                 except Exception:
                     # a handler crash must not kill the loop either — same
                     # invariant as the decode guard above
+                    HANDLER_ERRORS.inc()
                     log.exception(
                         "observer %r failed on message type %s",
                         obs, msg.get_type(),

@@ -9,10 +9,12 @@ receive loop; ``_init_manager`` (:133) is the backend factory keyed by
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
 from .. import constants as C
-from .base import BaseCommunicationManager, Observer
+from ..obs import trace as obstrace
+from .base import BaseCommunicationManager, MSG_SENT, Observer, SEND_LATENCY
 from .message import Message
 
 
@@ -31,7 +33,15 @@ class FedMLCommManager(Observer):
         self.message_handler_dict[msg_type] = handler
 
     def send_message(self, message: Message) -> None:
+        # send-side trace propagation: an explicitly stamped header (the
+        # server's round stamp) wins; otherwise the ambient span — e.g. a
+        # client replying from inside an activated handler — rides along
+        obstrace.inject(message)
+        t0 = time.perf_counter()
         self.com_manager.send_message(message)
+        msg_type = str(message.get_type())
+        MSG_SENT.inc(type=msg_type)
+        SEND_LATENCY.observe(time.perf_counter() - t0, type=msg_type)
 
     def receive_message(self, msg_type: int, msg: Message) -> None:
         handler = self.message_handler_dict.get(msg_type)
@@ -40,7 +50,11 @@ class FedMLCommManager(Observer):
                 f"no handler registered for msg_type {msg_type} (rank {self.rank}); "
                 f"registered: {sorted(self.message_handler_dict)}"
             )
-        handler(msg)
+        # receive-side trace propagation: the message's trace header becomes
+        # the ambient context for the handler, so spans opened inside (client
+        # train, server aggregate) join the sender's round-scoped trace
+        with obstrace.activate(obstrace.extract(msg)):
+            handler(msg)
 
     def run(self) -> None:
         """Blocking receive loop (reference ``FedMLCommManager.run``)."""

@@ -17,6 +17,10 @@ MSG_ARG_KEY_TYPE = "msg_type"
 MSG_ARG_KEY_SENDER = "sender"
 MSG_ARG_KEY_RECEIVER = "receiver"
 
+#: optional distributed-tracing header ({"trace_id", "span_id"}) — rides the
+#: JSON control section so every transport propagates it unchanged
+MSG_ARG_KEY_TRACE = "trace"
+
 # payload keys matching the reference vocabulary
 MSG_ARG_KEY_MODEL_PARAMS = "model_params"
 MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
@@ -50,6 +54,14 @@ class Message:
 
     def get_receiver_id(self) -> int:
         return self.msg_params[MSG_ARG_KEY_RECEIVER]
+
+    # -- tracing header ------------------------------------------------------
+    def set_trace(self, header: dict) -> None:
+        """Attach a trace-propagation header (see ``obs.trace.inject``)."""
+        self.msg_params[MSG_ARG_KEY_TRACE] = dict(header)
+
+    def get_trace(self):
+        return self.msg_params.get(MSG_ARG_KEY_TRACE)
 
     # -- wire ---------------------------------------------------------------
     def encode(self) -> bytes:

@@ -13,6 +13,7 @@ broadcast_object_list needed, GSPMD inserts the gradient all-reduce.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import math
 import threading
@@ -30,6 +31,16 @@ from ..fl.local_sgd import make_local_train_fn
 from . import message_define as md
 
 log = logging.getLogger("fedml_tpu.cross_silo.client")
+
+# XLA executes a k-device collective program with k participant threads that
+# must ALL reach a rendezvous; dispatching two such programs concurrently
+# from different client threads on one host (the in-process cross-silo
+# harness runs N silo masters as threads) can starve the shared device
+# threadpool and deadlock — observed as >=120s AllReduce rendezvous stalls
+# on XLA:CPU.  One host owns one device set anyway, so multi-device local
+# training is serialized within the process; single-device trainers
+# (dp_active=False) are unaffected.
+_DP_TRAIN_LOCK = threading.Lock()
 
 
 def data_parallel_constraint(mesh):
@@ -106,8 +117,10 @@ class FedMLTrainer:
         # cross-silo and simulation runs share sampling/dropout streams
         key = rng.client_key(rng.round_key(seed_key, round_idx), client_idx)
         variables = jax.tree_util.tree_map(jnp.asarray, global_vars)
-        new_vars, metrics = self._train(variables, self.x, self.y, self.count, key, None)
-        return jax.device_get(new_vars), float(self.count)
+        with _DP_TRAIN_LOCK if self.dp_active else contextlib.nullcontext():
+            new_vars, metrics = self._train(variables, self.x, self.y, self.count, key, None)
+            new_vars = jax.device_get(new_vars)
+        return new_vars, float(self.count)
 
 
 class ClientMasterManager(FedMLCommManager):
@@ -125,6 +138,7 @@ class ClientMasterManager(FedMLCommManager):
         # the same telemetry.
         self.obs = None
         if (getattr(cfg, "extra", {}) or {}).get("enable_remote_obs"):
+            from ..obs import trace as obstrace
             from ..obs.remote import RemoteObsShipper
 
             self.obs = RemoteObsShipper(self.send_message, rank)
@@ -133,7 +147,15 @@ class ClientMasterManager(FedMLCommManager):
             def train_with_obs(global_vars, round_idx, seed_key, client_idx=0):
                 self.obs.event("train", "started", round_idx=int(round_idx),
                                client_idx=int(client_idx))
-                out = inner_train(global_vars, round_idx, seed_key, client_idx)
+                # the span parents to the ambient context the comm layer
+                # activated from the server's message trace header, so this
+                # train span and the server's aggregate span share one
+                # round-scoped trace_id
+                with obstrace.traced("train", round_idx=int(round_idx),
+                                     client_idx=int(client_idx),
+                                     rank=rank) as span:
+                    out = inner_train(global_vars, round_idx, seed_key, client_idx)
+                self.obs.span(span, num_samples=float(out[1]))
                 self.obs.event("train", "ended", round_idx=int(round_idx),
                                client_idx=int(client_idx),
                                num_samples=float(out[1]))

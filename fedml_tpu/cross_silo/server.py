@@ -35,10 +35,27 @@ from ..comm.message import Message
 from ..core import pytree as pt, rng
 from ..data.dataset import pad_eval_set
 from ..fl.local_sgd import make_eval_fn
+from ..obs import registry as obsreg, trace as obstrace
 from ..obs.metrics import MetricsLogger
 from . import message_define as md
 
 log = logging.getLogger("fedml_tpu.cross_silo.server")
+
+# straggler attribution: how long between the server's model broadcast and
+# each client's trained-model reply, per client
+CLIENT_ROUND_TRIP = obsreg.REGISTRY.histogram(
+    "fedml_crosssilo_client_round_trip_seconds",
+    "Broadcast-to-model-reply round trip, by client rank.",
+    labels=("client",),
+)
+ROUND_TIME = obsreg.REGISTRY.histogram(
+    "fedml_crosssilo_round_seconds",
+    "Cross-silo round wall time (broadcast to aggregated).",
+)
+AGGREGATE_TIME = obsreg.REGISTRY.histogram(
+    "fedml_crosssilo_aggregate_seconds",
+    "Server-side aggregation wall time per round.",
+)
 
 
 def provisional_steps_per_epoch(cfg) -> int:
@@ -200,6 +217,13 @@ class FedMLServerManager(FedMLCommManager):
             self.obs_collector = ObsCollector(
                 extra.get("obs_jsonl_path") or None
             ).attach(self)
+        # distributed round tracing: one trace per round, stamped on every
+        # broadcast so client train spans join it (obs.trace module doc)
+        self._round_span: Optional[obstrace.Span] = None
+        self._sent_at: dict[int, float] = {}
+        self._round_rtts: dict[int, float] = {}
+        # Prometheus exposition, gated on extra['metrics_port']
+        self.metrics_server = obsreg.maybe_start_metrics_server(cfg)
 
     # -- protocol ------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -243,8 +267,14 @@ class FedMLServerManager(FedMLCommManager):
         with self._agg_lock:
             if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) != self.round_idx:
                 return  # stale round (post-timeout arrival)
+            sender = int(msg.get_sender_id())
+            sent_at = self._sent_at.pop(sender, None)
+            if sent_at is not None:
+                rtt = time.perf_counter() - sent_at
+                CLIENT_ROUND_TRIP.observe(rtt, client=str(sender))
+                self._round_rtts[sender] = rtt
             self.aggregator.add_local_trained_result(
-                msg.get_sender_id(),
+                sender,
                 msg.get(md.MSG_ARG_KEY_MODEL_PARAMS),
                 float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES)),
             )
@@ -277,13 +307,22 @@ class FedMLServerManager(FedMLCommManager):
         Caller holds _agg_lock."""
         if self._round_timer is not None:
             self._round_timer.cancel()
-        self.aggregator.aggregate(self.round_idx)
+        received = self.aggregator.received_count()
+        with obstrace.traced("aggregate", parent=self._round_span,
+                             round_idx=self.round_idx,
+                             clients_received=received) as agg_span:
+            self.aggregator.aggregate(self.round_idx)
+        AGGREGATE_TIME.observe(agg_span.duration_s)
         metrics = {"round": self.round_idx}
+        eval_span = None
         if self.cfg.frequency_of_the_test and (
             (self.round_idx + 1) % self.cfg.frequency_of_the_test == 0
             or self.round_idx == self.comm_round - 1
         ):
-            metrics.update(self.aggregator.test_on_server())
+            with obstrace.traced("eval", parent=self._round_span,
+                                 round_idx=self.round_idx) as eval_span:
+                metrics.update(self.aggregator.test_on_server())
+        self._close_round_trace(agg_span, eval_span)
         self.logger.log(metrics)
         self.history.append(metrics)
         self.round_idx += 1
@@ -292,17 +331,47 @@ class FedMLServerManager(FedMLCommManager):
             return
         self._broadcast_model(md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
 
+    def _close_round_trace(self, *child_spans) -> None:
+        """End the round span, record its duration, and persist the server's
+        half of the round trace (spans + per-client round trips) into the
+        same collector trail the clients ship to."""
+        round_span = self._round_span
+        if round_span is None:
+            return
+        round_span.end()
+        ROUND_TIME.observe(round_span.duration_s)
+        if self.obs_collector is not None:
+            records = [s.to_record() for s in child_spans if s is not None]
+            records.append(round_span.to_record())
+            records += [
+                {"kind": "metric", "metric": "client_round_trip_s",
+                 "client": cid, "value": rtt, "round_idx": self.round_idx,
+                 "trace_id": round_span.trace_id, "ts": time.time()}
+                for cid, rtt in sorted(self._round_rtts.items())
+            ]
+            self.obs_collector.ingest(0, records)
+        self._round_rtts.clear()
+        self._round_span = None
+
     def _broadcast_model(self, msg_type: int) -> None:
         """Select clients, send them the global model for this round, arm the
         straggler timer — shared by round 0 (INIT) and later rounds (SYNC)."""
         self.selected = self.aggregator.client_selection(self.round_idx, self._candidate_ids(), self.per_round)
+        # one fresh trace per round: every broadcast carries its header, so
+        # each client's train span lands in this round's span tree
+        self._round_span = obstrace.Span(
+            "round", round_idx=self.round_idx, clients=len(self.selected)
+        )
+        self._round_rtts.clear()
         params = jax.device_get(self.aggregator.global_vars)
         for cid in self.selected:
             msg = Message(msg_type, 0, cid)
             msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
             msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
             msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            obstrace.inject(msg, self._round_span)
             try:
+                self._sent_at[cid] = time.perf_counter()
                 self.send_message(msg)
             except Exception:
                 # best-effort per client: one unreachable peer must not kill
@@ -324,6 +393,9 @@ class FedMLServerManager(FedMLCommManager):
         super().finish()
         if self.obs_collector is not None:
             self.obs_collector.close()  # release the JSONL append handle
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
 
     # -- runner API ----------------------------------------------------------
     def run_until_done(self, timeout: float = 600.0) -> list[dict]:

@@ -69,6 +69,13 @@ class RemoteObsShipper:
         self._push({"kind": "event", "event": name, "phase": phase,
                     "value": value, **extra})
 
+    def span(self, span, **extra) -> None:
+        """Ship a finished :class:`~fedml_tpu.obs.trace.Span` (or a raw span
+        record dict) — the trace identity travels with it, so the server-side
+        trail can stitch client spans into the round's span tree."""
+        record = span.to_record() if hasattr(span, "to_record") else dict(span)
+        self._push({**record, **extra})
+
     def log_lines(self, lines: list[str]) -> None:
         """RuntimeLogDaemon sink signature: one record per batch of lines."""
         self._push({"kind": "log", "lines": list(lines)})
@@ -131,6 +138,13 @@ class ObsCollector:
             batch = json.loads(msg.get(MSG_ARG_KEY_OBS_BATCH))
         except (TypeError, ValueError):
             return  # malformed telemetry must never disturb the FL server
+        self.ingest(sender, batch)
+
+    def ingest(self, sender: int, batch: list[dict]) -> None:
+        """Aggregate + persist a batch of records for ``sender``.  Also the
+        server's own entry point: rank 0 records its round/aggregate spans
+        into the same trail its clients ship to, so one JSONL holds the whole
+        distributed round."""
         with self._lock:
             self.by_sender.setdefault(sender, []).extend(batch)
             if self._fh:

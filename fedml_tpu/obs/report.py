@@ -1,0 +1,251 @@
+"""Offline round-timeline reconstruction from collector JSONL trails.
+
+The server-side :class:`~fedml_tpu.obs.remote.ObsCollector` persists every
+telemetry record — client train spans, server round/aggregate/eval spans,
+per-client round-trip metrics — as one JSON object per line.  This module
+reads those trails back, reassembles the per-round span tree by
+(trace_id, span_id, parent_id), and renders the operational answers the
+communication-perspective FL surveys call the cross-silo blind spot: where
+did each round's time go (p50/p95 per phase) and which client is the
+straggler.
+
+Pure stdlib; consumed by ``fedml-tpu obs report`` and the tests.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional, Sequence
+
+__all__ = [
+    "load_jsonl", "SpanNode", "build_span_trees", "round_rows",
+    "phase_percentiles", "slowest_clients", "render_report",
+]
+
+
+def load_jsonl(path) -> list[dict]:
+    """Parse a JSONL trail, skipping malformed lines (a crash mid-write must
+    not make the whole trail unreadable)."""
+    records = []
+    text = Path(path).read_text()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records
+
+
+@dataclass
+class SpanNode:
+    record: dict
+    children: list = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return str(self.record.get("name", ""))
+
+    @property
+    def span_id(self) -> Optional[str]:
+        return self.record.get("span_id")
+
+    @property
+    def dur_s(self) -> float:
+        return float(self.record.get("dur_s", 0.0) or 0.0)
+
+
+def _spans(records: Iterable[dict]) -> list[dict]:
+    return [r for r in records if r.get("kind") == "span" and r.get("trace_id")]
+
+
+def build_span_trees(records: Iterable[dict]) -> dict[str, list[SpanNode]]:
+    """trace_id -> root SpanNodes (children attached by parent_id, ordered by
+    start timestamp).  Spans whose parent never arrived (a client's collector
+    batch lost in transit) surface as extra roots instead of disappearing."""
+    nodes: dict[str, SpanNode] = {}
+    spans = _spans(records)
+    for rec in spans:
+        sid = rec.get("span_id")
+        if sid:
+            nodes[sid] = SpanNode(rec)
+    trees: dict[str, list[SpanNode]] = {}
+    for rec in spans:
+        node = nodes.get(rec.get("span_id")) or SpanNode(rec)
+        parent = nodes.get(rec.get("parent_id") or "")
+        if parent is not None and parent is not node:
+            parent.children.append(node)
+        else:
+            trees.setdefault(str(rec["trace_id"]), []).append(node)
+    for node in nodes.values():
+        node.children.sort(key=lambda n: n.record.get("ts", 0.0))
+    for roots in trees.values():
+        roots.sort(key=lambda n: n.record.get("ts", 0.0))
+    return trees
+
+
+def round_rows(records: Iterable[dict]) -> list[dict]:
+    """One row per federated round, keyed by the round span's trace.
+
+    Each row: round_idx, trace_id, round span duration, aggregate/eval
+    durations, the client train spans ({sender, client_idx, dur_s}), and the
+    server-measured per-client round trips."""
+    records = list(records)
+    spans = _spans(records)
+    by_trace: dict[str, dict] = {}
+    for rec in spans:
+        row = by_trace.setdefault(str(rec["trace_id"]), {
+            "trace_id": str(rec["trace_id"]), "round_idx": None,
+            "round_dur_s": None, "aggregate_dur_s": None, "eval_dur_s": None,
+            "train": [], "round_trips": {},
+        })
+        name = rec.get("name")
+        if name == "round":
+            row["round_idx"] = rec.get("round_idx")
+            row["round_dur_s"] = float(rec.get("dur_s", 0.0) or 0.0)
+            row["ts"] = rec.get("ts", 0.0)
+        elif name == "aggregate":
+            row["aggregate_dur_s"] = float(rec.get("dur_s", 0.0) or 0.0)
+            if row["round_idx"] is None:
+                row["round_idx"] = rec.get("round_idx")
+        elif name == "eval":
+            row["eval_dur_s"] = float(rec.get("dur_s", 0.0) or 0.0)
+        elif name == "train":
+            row["train"].append({
+                "sender": rec.get("sender"),
+                "client_idx": rec.get("client_idx"),
+                "dur_s": float(rec.get("dur_s", 0.0) or 0.0),
+            })
+            if row["round_idx"] is None:
+                row["round_idx"] = rec.get("round_idx")
+    for rec in records:
+        if rec.get("kind") == "metric" and rec.get("metric") == "client_round_trip_s":
+            trace_id = str(rec.get("trace_id", ""))
+            if trace_id in by_trace:
+                by_trace[trace_id]["round_trips"][str(rec.get("client"))] = float(rec.get("value", 0.0))
+    rows = [row for row in by_trace.values() if row["round_idx"] is not None]
+    rows.sort(key=lambda r: (r["round_idx"], r.get("ts", 0.0)))
+    return rows
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Linear-interpolated percentile on a pre-sorted sequence (stdlib-only
+    twin of numpy.percentile's default)."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return float(sorted_vals[0])
+    pos = (len(sorted_vals) - 1) * (q / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return float(sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac)
+
+
+def phase_percentiles(records: Iterable[dict]) -> dict[str, dict]:
+    """phase name -> {n, p50_s, p95_s, max_s} over every span of that name."""
+    durs: dict[str, list[float]] = {}
+    for rec in _spans(records):
+        durs.setdefault(str(rec.get("name")), []).append(float(rec.get("dur_s", 0.0) or 0.0))
+    out = {}
+    for name, values in sorted(durs.items()):
+        values.sort()
+        out[name] = {
+            "n": len(values),
+            "p50_s": _percentile(values, 50),
+            "p95_s": _percentile(values, 95),
+            "max_s": values[-1],
+        }
+    return out
+
+
+def slowest_clients(records: Iterable[dict]) -> list[dict]:
+    """Clients ranked slowest-first by mean train-span duration (the
+    straggler attribution table); round trips ride along when the server
+    recorded them."""
+    records = list(records)
+    per_client: dict[str, list[float]] = {}
+    rtts: dict[str, list[float]] = {}
+    for rec in _spans(records):
+        if rec.get("name") == "train":
+            key = str(rec.get("sender", rec.get("client_idx")))
+            per_client.setdefault(key, []).append(float(rec.get("dur_s", 0.0) or 0.0))
+    for rec in records:
+        if rec.get("kind") == "metric" and rec.get("metric") == "client_round_trip_s":
+            rtts.setdefault(str(rec.get("client")), []).append(float(rec.get("value", 0.0)))
+    out = []
+    for client, durations in per_client.items():
+        row = {
+            "client": client,
+            "rounds": len(durations),
+            "mean_train_s": sum(durations) / len(durations),
+            "max_train_s": max(durations),
+        }
+        if rtts.get(client):
+            row["mean_round_trip_s"] = sum(rtts[client]) / len(rtts[client])
+        out.append(row)
+    out.sort(key=lambda r: -r["mean_train_s"])
+    return out
+
+
+def _table(headers: list[str], rows: list[list[str]]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    def fmt(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    lines = [fmt(headers), fmt(["-" * w for w in widths])]
+    lines += [fmt(r) for r in rows]
+    return "\n".join(lines)
+
+
+def _s(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v:.4f}"
+
+
+def render_report(records: Iterable[dict]) -> str:
+    """The ``fedml-tpu obs report`` output: per-round timeline, per-phase
+    p50/p95, and the slowest-client ranking."""
+    records = list(records)
+    rows = round_rows(records)
+    sections = []
+
+    timeline = []
+    for row in rows:
+        train = sorted(row["train"], key=lambda t: -t["dur_s"])
+        if train:
+            who = train[0]["sender"] if train[0]["sender"] is not None else train[0]["client_idx"]
+            slowest = f"{who} ({train[0]['dur_s']:.4f}s)"
+        else:
+            slowest = "-"
+        timeline.append([
+            str(row["round_idx"]), str(row["trace_id"]), _s(row["round_dur_s"]),
+            _s(row["aggregate_dur_s"]), _s(row["eval_dur_s"]),
+            str(len(train)), slowest,
+        ])
+    sections.append("== round timeline ==\n" + _table(
+        ["round", "trace_id", "round_s", "aggregate_s", "eval_s", "clients", "slowest client (train_s)"],
+        timeline,
+    ))
+
+    phases = phase_percentiles(records)
+    sections.append("== phase durations ==\n" + _table(
+        ["phase", "n", "p50_s", "p95_s", "max_s"],
+        [[name, str(st["n"]), f"{st['p50_s']:.4f}", f"{st['p95_s']:.4f}", f"{st['max_s']:.4f}"]
+         for name, st in phases.items()],
+    ))
+
+    stragglers = slowest_clients(records)
+    sections.append("== slowest clients ==\n" + _table(
+        ["client", "rounds", "mean_train_s", "max_train_s", "mean_round_trip_s"],
+        [[r["client"], str(r["rounds"]), f"{r['mean_train_s']:.4f}",
+          f"{r['max_train_s']:.4f}",
+          f"{r['mean_round_trip_s']:.4f}" if "mean_round_trip_s" in r else "-"]
+         for r in stragglers],
+    ))
+    return "\n\n".join(sections) + "\n"

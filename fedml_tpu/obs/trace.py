@@ -1,0 +1,201 @@
+"""Distributed round tracing — trace/span identity that crosses the wire.
+
+The reference correlates a round's client train phases and server aggregation
+only through its SaaS backend's run ids; locally there is no way to line up
+"client 3 trained for 1.2s" with "the server aggregated round 7".  This
+module gives every phase a span (trace_id / span_id / parent_id + monotonic
+and wall clocks) and propagates the (trace_id, span_id) pair over the comm
+layer's ``Message`` trace header, so one round-scoped trace links the
+server's round/aggregate spans with every client's train span — across
+processes and transports.
+
+Design constraints: stdlib + jax only.  ``traced`` doubles as decorator and
+context manager and mirrors every span into ``jax.profiler.TraceAnnotation``
+so the same names show up in XLA device profiles; the current span rides a
+``contextvars.ContextVar`` so nested spans parent automatically, including
+under the comm receive loop's per-message ``activate`` window.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import functools
+import secrets
+import time
+from typing import Any, Callable, Optional, Union
+
+import jax
+
+__all__ = [
+    "Span", "traced", "activate", "current", "start_span",
+    "inject", "extract", "new_id",
+]
+
+
+def new_id() -> str:
+    """128-bit-ish random hex id (16 chars is plenty for run-local traces)."""
+    return secrets.token_hex(8)
+
+
+class Span:
+    """One timed phase. ``trace_id`` groups spans of one logical operation
+    (a federated round); ``parent_id`` is the enclosing span's ``span_id``."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id",
+                 "start_wall", "start_mono", "end_wall", "end_mono", "attrs")
+
+    def __init__(self, name: str, trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None, **attrs):
+        self.name = name
+        self.trace_id = trace_id or new_id()
+        self.span_id = new_id()
+        self.parent_id = parent_id
+        self.start_wall = time.time()
+        self.start_mono = time.monotonic()
+        self.end_wall: Optional[float] = None
+        self.end_mono: Optional[float] = None
+        self.attrs = attrs
+
+    def end(self) -> "Span":
+        if self.end_mono is None:
+            self.end_mono = time.monotonic()
+            self.end_wall = time.time()
+        return self
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end_mono if self.end_mono is not None else time.monotonic()) - self.start_mono
+
+    def header(self) -> dict:
+        """The wire propagation context: what a child on the far side needs."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def to_record(self) -> dict:
+        """JSONL shape the collector trail stores and ``obs.report`` reads."""
+        return {
+            "kind": "span",
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "ts": self.start_wall,
+            "dur_s": round(self.duration_s, 9),
+            **self.attrs,
+        }
+
+    def __repr__(self) -> str:
+        return (f"Span({self.name!r}, trace={self.trace_id}, span={self.span_id}, "
+                f"parent={self.parent_id}, dur={self.duration_s:.6f}s)")
+
+
+#: current span (a Span) or remote parent context (a header dict) — set by
+#: ``traced`` locally and ``activate`` at the comm receive boundary
+_current: contextvars.ContextVar[Optional[Union[Span, dict]]] = contextvars.ContextVar(
+    "fedml_tpu_current_span", default=None
+)
+
+
+def current() -> Optional[Union[Span, dict]]:
+    """The ambient span (or remote header dict) new spans will parent to."""
+    return _current.get()
+
+
+def start_span(name: str, parent: Any = None, **attrs) -> Span:
+    """Open a span under ``parent`` (a Span, a wire header dict, or None =
+    ambient context; no ambient context starts a fresh trace)."""
+    if parent is None:
+        parent = _current.get()
+    if isinstance(parent, Span):
+        return Span(name, trace_id=parent.trace_id, parent_id=parent.span_id, **attrs)
+    if isinstance(parent, dict) and parent.get("trace_id"):
+        return Span(name, trace_id=parent["trace_id"],
+                    parent_id=parent.get("span_id"), **attrs)
+    return Span(name, **attrs)
+
+
+class traced:
+    """Span context manager AND decorator.
+
+    ``with traced("train", round_idx=3) as span: ...`` opens a span under the
+    ambient context, makes it the ambient context for the body, mirrors it
+    into ``jax.profiler.TraceAnnotation`` (TPU profile visibility), ends it
+    on exit, and hands the record to ``sink`` when one is given.  ``sink``
+    failures are swallowed — telemetry must never take down the traced path.
+    """
+
+    def __init__(self, name: str, parent: Any = None,
+                 sink: Optional[Callable[[dict], None]] = None, **attrs):
+        self.name = name
+        self.parent = parent
+        self.sink = sink
+        self.attrs = attrs
+        self.span: Optional[Span] = None
+
+    def __enter__(self) -> Span:
+        self.span = start_span(self.name, parent=self.parent, **self.attrs)
+        self._token = _current.set(self.span)
+        self._annotation = jax.profiler.TraceAnnotation(self.name)
+        self._annotation.__enter__()
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._annotation.__exit__(exc_type, exc, tb)
+        _current.reset(self._token)
+        self.span.end()
+        if self.sink is not None:
+            try:
+                self.sink(self.span.to_record())
+            except Exception:
+                pass
+        return False
+
+    def __call__(self, fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with traced(self.name, parent=self.parent, sink=self.sink, **self.attrs):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class activate:
+    """Install a remote parent context (a wire header) as the ambient span
+    for the duration of a message handler — the receive-side half of
+    propagation.  A missing/invalid header is a no-op, so the receive loop
+    can wrap every dispatch unconditionally."""
+
+    def __init__(self, header: Optional[dict]):
+        self.header = header if (isinstance(header, dict) and header.get("trace_id")) else None
+        self._token = None
+
+    def __enter__(self) -> Optional[dict]:
+        if self.header is not None:
+            self._token = _current.set(self.header)
+        return self.header
+
+    def __exit__(self, *exc) -> bool:
+        if self._token is not None:
+            _current.reset(self._token)
+        return False
+
+
+def inject(msg, context: Any = None) -> None:
+    """Stamp a trace header onto an outgoing protocol message (send-side half
+    of propagation).  ``context`` defaults to the ambient span; an existing
+    header on the message is never overwritten (an explicit round stamp wins
+    over the ambient context of whatever thread sends the message)."""
+    if msg.get_trace() is not None:
+        return
+    src = context if context is not None else _current.get()
+    if isinstance(src, Span):
+        msg.set_trace(src.header())
+    elif isinstance(src, dict) and src.get("trace_id"):
+        msg.set_trace({"trace_id": src["trace_id"], "span_id": src.get("span_id")})
+
+
+def extract(msg) -> Optional[dict]:
+    """Read the trace header off an incoming message (None when absent)."""
+    header = msg.get_trace()
+    if isinstance(header, dict) and header.get("trace_id"):
+        return header
+    return None

@@ -23,8 +23,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import SHARD_MAP_UNCHECKED, shard_map
 
 NEG_INF = -1e30
 
@@ -135,5 +136,6 @@ def ring_attention(
         return out.astype(q.dtype)
 
     return shard_map(
-        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        **SHARD_MAP_UNCHECKED,
     )(q, k, v)

@@ -28,6 +28,19 @@ AXIS_SILO = "silo"
 AXIS_MODEL = "model"  # tensor-parallel axis (beyond reference parity)
 AXIS_SEQ = "seq"  # context/sequence-parallel axis (ring attention)
 
+# shard_map moved to the jax top level (with check_vma) in newer jax; 0.4.x
+# has it under experimental (with check_rep).  One shim so every shard_map
+# call site works on both — pass **SHARD_MAP_UNCHECKED to skip the
+# replication check.
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+
+    SHARD_MAP_UNCHECKED = {"check_vma": False}
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+    SHARD_MAP_UNCHECKED = {"check_rep": False}
+
 
 def make_mesh(
     axis_names: Sequence[str] = (AXIS_CLIENTS,),

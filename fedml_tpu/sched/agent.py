@@ -155,6 +155,13 @@ class FedMLAgent:
         self.db.upsert(run_id, status="PROVISIONING", log_path=log_path)
         logf = open(log_path, "ab")
         env = dict(os.environ)
+        # the job runs with cwd=run_dir, so a package doing `import
+        # fedml_tpu` must find THIS checkout even when the framework isn't
+        # pip-installed: put the directory containing the fedml_tpu package
+        # on the child's PYTHONPATH (an explicit self.env override wins)
+        pkg_parent = str(Path(__file__).resolve().parents[2])
+        existing = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = pkg_parent + (os.pathsep + existing if existing else "")
         if self.env:
             env.update(self.env)
         env["FEDML_RUN_ID"] = run_id

@@ -113,6 +113,17 @@ class AgentControlPlane(FedMLCommManager):
         self.agent = agent
         self.ota_dir = agent.spool / "ota"
         self.secret: Optional[str] = getattr(cfg, "control_plane_secret", None)
+        # Prometheus exposition for the agent host (scrape comm/job metrics
+        # without the SaaS the reference requires): extra['metrics_port']
+        from ..obs import registry as obsreg
+
+        self.metrics_server = obsreg.maybe_start_metrics_server(cfg)
+
+    def finish(self) -> None:
+        super().finish()
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
 
     def _verify(self, msg: Message, verb: int, name: str, package: bytes = b"") -> None:
         """Reject any verb whose HMAC or freshness fails; see module doc."""

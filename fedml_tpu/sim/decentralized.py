@@ -63,6 +63,15 @@ class DecentralizedSimulator:
             # uniform {prev, self, next} ring — mixed via ppermute halo
             # exchange (see _make_ring_mix), W kept only as the reference
             # matrix for parity checks
+            if n < 3:
+                # with n <= 2 prev == next, so the halo mix weights the single
+                # neighbor twice ((x + 2*other)/3) while the dense
+                # ring_topology reference collapses the duplicate edge —
+                # the two would silently diverge
+                raise ValueError(
+                    f"mode='ring' needs n >= 3 clients (got {n}); use "
+                    "mode='dsgd' for 1-2 clients"
+                )
             W = topo.ring_topology(n)
         else:
             W = topo.symmetric_topology(n, neighbor_num, seed=cfg.random_seed)
@@ -108,8 +117,9 @@ class DecentralizedSimulator:
         full stacked model, which is what makes large-N sparse rings viable
         (reference P10 does this with per-edge MPI messages;
         ``decentralized_framework/algorithm_api.py``)."""
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import SHARD_MAP_UNCHECKED, shard_map
 
         axis = self._gossip_axis()
         d = self.mesh.shape[axis]
@@ -140,7 +150,7 @@ class DecentralizedSimulator:
         spec = P(axis)
         return shard_map(
             local_mix, mesh=self.mesh, in_specs=(spec,), out_specs=spec,
-            check_vma=False,
+            **SHARD_MAP_UNCHECKED,
         )
 
     def _make_round_fn(self):

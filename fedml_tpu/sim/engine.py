@@ -44,7 +44,34 @@ from ..core import pytree as pt, rng
 from ..data.dataset import FederatedDataset, StackedClientData, pad_eval_set, stack_clients
 from ..fl.local_sgd import make_eval_fn
 from ..parallel import mesh as meshlib
+from ..obs import registry as obsreg
 from ..obs.metrics import MetricsLogger
+from ..obs.trace import traced
+
+# measurement substrate for perf work (ISSUE 1): compile vs execute split,
+# program-cache hit rate, round/eval wall time — all scrapable via /metrics
+ROUND_TIME = obsreg.REGISTRY.histogram(
+    "fedml_sim_round_seconds",
+    "Per-round wall time (chunk-averaged inside scanned chunks).",
+)
+CHUNK_COMPILE_TIME = obsreg.REGISTRY.histogram(
+    "fedml_sim_chunk_compile_seconds",
+    "jit(scan(round)) chunk program compile time.",
+)
+CHUNK_EXECUTE_TIME = obsreg.REGISTRY.histogram(
+    "fedml_sim_chunk_execute_seconds",
+    "Scanned-chunk execute wall time (dispatch to host sync, post-compile).",
+)
+EVAL_TIME = obsreg.REGISTRY.histogram(
+    "fedml_sim_eval_seconds",
+    "Server-side evaluation wall time.",
+)
+CHUNK_CACHE = obsreg.REGISTRY.counter(
+    "fedml_sim_chunk_cache_total",
+    "Scanned-chunk program cache lookups; jit cache hits are the "
+    "hit/miss delta over time.",
+    labels=("result",),
+)
 
 
 from ..core.checkpoint import RoundCheckpointMixin
@@ -286,15 +313,26 @@ class MeshSimulator(RoundCheckpointMixin):
         return out.contribution, out.client_state, out.metrics
 
     # ------------------------------------------------------------------
-    def _get_multi_round_fn(self, n: int):
+    def _get_multi_round_fn(self, n: int, example_args: Optional[tuple] = None):
         """jit(scan(round)) over ``n`` rounds — ONE dispatch and ONE host
         sync per chunk.  On TPU every host<->device round trip is latency
         (and over a tunneled single-chip setup it dominates: per-round metric
         pulls were 3-8x the compute itself); the round loop belongs on the
-        device, which is exactly SURVEY.md §7's ``jit(scan(round))`` form."""
+        device, which is exactly SURVEY.md §7's ``jit(scan(round))`` form.
+
+        With ``example_args`` the chunk is AOT-compiled (lower + compile)
+        so compile time is measured separately from execute time.  The
+        carried state is donated only off-CPU: executing the donated scanned
+        chunk on XLA:CPU (jax 0.4.37) corrupts the heap — the tier-1 suite
+        died with wandering segfaults/aborts (device_get, tracing, GC, and
+        most reliably when the serialized donated executable was reloaded
+        from the persistent compilation cache) until CPU donation was
+        dropped."""
         fn = self._multi_round_fns.get(n)
         if fn is not None:
+            CHUNK_CACHE.inc(result="hit")
             return fn
+        CHUNK_CACHE.inc(result="miss")
         round_fn = self._make_round_fn()
 
         def multi(global_vars, server_state, client_states, counts, data_x, data_y,
@@ -310,8 +348,21 @@ class MeshSimulator(RoundCheckpointMixin):
             return gv, ss, cs, pd, stacked_metrics
 
         # donate the big carried state: the round rewrites params/opt/client
-        # stacks in place instead of holding two copies in HBM
-        fn = jax.jit(multi, donate_argnums=(0, 1, 2, 8))
+        # stacks in place instead of holding two copies in HBM.  NOT on CPU:
+        # donated scan carries corrupt the heap there (see docstring) and
+        # host RAM doesn't need the in-place rewrite anyway.
+        donate = () if jax.default_backend() == "cpu" else (0, 1, 2, 8)
+        jitted = jax.jit(multi, donate_argnums=donate)
+        fn = jitted
+        if example_args is not None:
+            t0 = time.perf_counter()
+            try:
+                with traced("sim.chunk_compile", rounds=n):
+                    fn = jitted.lower(*example_args).compile()
+            except Exception:
+                # AOT unsupported for these inputs — the lazy jit still works
+                fn = jitted
+            CHUNK_COMPILE_TIME.observe(time.perf_counter() - t0)
         self._multi_round_fns[n] = fn
         return fn
 
@@ -327,25 +378,37 @@ class MeshSimulator(RoundCheckpointMixin):
         if n <= 0:
             return []
         if self.backend == C.SIMULATION_BACKEND_SP:
-            return [self.run_round() for _ in range(n)]
-        fn = self._get_multi_round_fn(n)
+            out = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                out.append(self.run_round())
+                ROUND_TIME.observe(time.perf_counter() - t0)
+            return out
+        args = (
+            self.global_vars, self.server_state, self.client_states,
+            self.counts, self._data[0], self._data[1],
+            jnp.int32(self.round_idx), self.root_key, self.defense_history,
+        )
+        fn = self._get_multi_round_fn(n, example_args=args)
+        t0 = time.perf_counter()
         try:
-            gv, ss, cs, nd, stacked = fn(
-                self.global_vars, self.server_state, self.client_states,
-                self.counts, self._data[0], self._data[1],
-                jnp.int32(self.round_idx), self.root_key, self.defense_history,
-            )
+            with traced("sim.chunk", rounds=n, start_round=self.round_idx):
+                gv, ss, cs, nd, stacked = fn(*args)
+                host = jax.device_get(stacked)  # the single host sync for the chunk
         except Exception as e:
             raise RuntimeError(
                 f"scanned chunk of {n} rounds failed at round {self.round_idx}; "
                 "carried state was donated and is no longer valid — resume from "
                 "the last checkpoint"
             ) from e
+        execute_s = time.perf_counter() - t0
+        CHUNK_EXECUTE_TIME.observe(execute_s)
+        for _ in range(n):
+            ROUND_TIME.observe(execute_s / n)
         self.global_vars, self.server_state, self.client_states = gv, ss, cs
         if nd is not None:
             self.defense_history = nd
         self.round_idx += n
-        host = jax.device_get(stacked)  # the single host sync for the chunk
         return [{k: float(v[i]) for k, v in host.items()} for i in range(n)]
 
     # ------------------------------------------------------------------
@@ -406,8 +469,12 @@ class MeshSimulator(RoundCheckpointMixin):
 
     # ------------------------------------------------------------------
     def evaluate(self) -> dict:
-        res = self._eval_fn(self.global_vars, *self._test)
-        return {k: float(v) for k, v in res.items()}
+        t0 = time.perf_counter()
+        with traced("sim.eval", round_idx=self.round_idx):
+            res = self._eval_fn(self.global_vars, *self._test)
+            out = {k: float(v) for k, v in res.items()}  # float() syncs
+        EVAL_TIME.observe(time.perf_counter() - t0)
+        return out
 
     # -- checkpoint / resume (first-class, SURVEY.md §5; save/resume plumbing
     # from core.checkpoint.RoundCheckpointMixin) ------------------------------

@@ -83,14 +83,24 @@ class LaunchJob(Job):
         try:
             spec = JobSpec.from_yaml(self.yaml_path)
             ws = Path(self.yaml_path).parent / spec.workspace
+            inputs_file = None
             if inputs:
                 # feed dependency outputs INTO the package: the launched
                 # process reads __workflow_inputs__.json from its cwd
-                (ws / "__workflow_inputs__.json").write_text(
-                    json.dumps(_jsonable(inputs))
-                )
+                inputs_file = ws / "__workflow_inputs__.json"
+                inputs_file.write_text(json.dumps(_jsonable(inputs)))
             mgr = FedMLLaunchManager(self.spool_dir)
-            pkg = mgr.build_package(spec, base_dir=str(Path(self.yaml_path).parent))
+            try:
+                pkg = mgr.build_package(spec, base_dir=str(Path(self.yaml_path).parent))
+            finally:
+                # the inputs belong to ONE launch; leaking the file into the
+                # source workspace would feed stale inputs to the next
+                # package built from it (and dirty the user's tree)
+                if inputs_file is not None:
+                    try:
+                        inputs_file.unlink()
+                    except OSError:
+                        pass
             self.run_id = pkg.stem
             log.info("workflow job %s: launched %s", self.name, self.run_id)
 

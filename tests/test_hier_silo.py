@@ -61,6 +61,12 @@ def _flat_reference():
     return float(flat.sum()), float(np.sqrt((flat ** 2).sum())), history[-1].get("test_acc")
 
 
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 4,
+    reason="spawns multiple jax processes whose collective programs starve "
+           "the XLA:CPU rendezvous on hosts with too few cores (observed "
+           "240s hangs then timeout failures on 1-core CI)",
+)
 def test_hierarchical_silo_over_tcp_matches_flat(eight_devices):
     base_port, coord_port = _free_port(), _free_port()
     worker = os.path.join(_REPO, "tests", "_hier_silo_worker.py")

@@ -47,6 +47,12 @@ def _single_process_reference():
     return float(flat.sum()), float(np.sqrt((flat ** 2).sum())), history[-1].get("test_acc")
 
 
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 4,
+    reason="spawns multiple jax processes whose collective programs starve "
+           "the XLA:CPU rendezvous on hosts with too few cores (observed "
+           "240s hangs then timeout failures on 1-core CI)",
+)
 def test_two_process_mesh_equals_single_process(eight_devices):
     port = _free_port()
     worker = os.path.join(_REPO, "tests", "_multihost_worker.py")

@@ -135,7 +135,9 @@ def test_cross_silo_round_events_arrive_server_side(tmp_path, eight_devices):
     assert logs and logs[0]["lines"] == ["epoch 0 ok", "epoch 1 ok"]
     metrics = col.records(sender=1, kind="metric")
     assert metrics and metrics[0]["cpu_utilization"] == 12.5
-    # persisted server-side
+    # persisted server-side: both clients' telemetry plus the server's own
+    # round/aggregate spans (rank 0) share ONE trail
     lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
-    assert {l["sender"] for l in lines} == {1, 2}
+    assert {l["sender"] for l in lines} == {0, 1, 2}
     assert any(l.get("kind") == "log" for l in lines)
+    assert any(l.get("kind") == "span" and l["sender"] == 0 for l in lines)

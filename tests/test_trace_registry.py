@@ -1,0 +1,435 @@
+"""Distributed round tracing + metrics registry (ISSUE 1 tentpole).
+
+- trace-context propagation across an INPROC cross-silo round: every client
+  train span carries the SAME trace_id as the server's aggregate span for
+  that round, and ``obs.report`` reconstructs the per-round span tree from
+  the collector JSONL trail alone;
+- Prometheus text-format invariants of ``MetricsRegistry.render()`` and the
+  stdlib ``/metrics`` + ``/healthz`` endpoint round-trip;
+- the comm receive loop's non-blocking transient-decode retry (healthy
+  messages keep draining while a flaky payload backs off) with its registry
+  counters;
+- ``obs report`` timeline reconstruction from a recorded JSONL trail.
+"""
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from .conftest import tiny_config
+
+
+# ---------------------------------------------------------------------------
+# trace primitives
+
+
+def test_span_parenting_and_wire_header():
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.obs import trace
+
+    with trace.traced("round", round_idx=7) as round_span:
+        msg = Message(3, 0, 1)
+        trace.inject(msg, round_span)
+        # wire round trip: the header survives encode/decode as JSON control
+        decoded = Message.decode(msg.encode())
+    header = trace.extract(decoded)
+    assert header == {"trace_id": round_span.trace_id, "span_id": round_span.span_id}
+
+    # receive side: activate the header, open a child span
+    with trace.activate(header):
+        with trace.traced("train", client_idx=2) as train_span:
+            time.sleep(0.002)
+    assert train_span.trace_id == round_span.trace_id
+    assert train_span.parent_id == round_span.span_id
+    rec = train_span.to_record()
+    assert rec["kind"] == "span" and rec["client_idx"] == 2
+    assert rec["dur_s"] >= 0.002
+
+    # no ambient context -> fresh trace; inject never overwrites a header
+    with trace.traced("orphan") as orphan:
+        pass
+    assert orphan.parent_id is None and orphan.trace_id != round_span.trace_id
+    trace.inject(decoded, orphan)
+    assert trace.extract(decoded)["trace_id"] == round_span.trace_id
+
+
+def test_traced_decorator_nests_and_sinks():
+    from fedml_tpu.obs import trace
+
+    records = []
+
+    @trace.traced("outer", sink=records.append)
+    def outer():
+        with trace.traced("inner", sink=records.append):
+            pass
+
+    outer()
+    inner_rec, outer_rec = records
+    assert inner_rec["name"] == "inner" and outer_rec["name"] == "outer"
+    assert inner_rec["trace_id"] == outer_rec["trace_id"]
+    assert inner_rec["parent_id"] == outer_rec["span_id"]
+
+
+# ---------------------------------------------------------------------------
+# metrics registry + Prometheus exposition
+
+
+def test_registry_render_prometheus_invariants():
+    from fedml_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("demo_requests_total", "requests", labels=("code",))
+    c.inc(code="200")
+    c.inc(2, code='5"00\n')  # label value needing escaping
+    g = reg.gauge("demo_temp", "temperature")
+    g.set(-3.5)
+    h = reg.histogram("demo_latency_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+
+    out = reg.render()
+    lines = out.splitlines()
+    assert out.endswith("\n")
+
+    # one HELP + one TYPE per family, TYPE correct
+    for name, kind in (("demo_requests_total", "counter"), ("demo_temp", "gauge"),
+                       ("demo_latency_seconds", "histogram")):
+        assert lines.count(f"# TYPE {name} {kind}") == 1
+        assert sum(1 for l in lines if l.startswith(f"# HELP {name} ")) == 1
+
+    assert 'demo_requests_total{code="200"} 1' in lines
+    assert 'demo_requests_total{code="5\\"00\\n"} 2' in lines
+    assert "demo_temp -3.5" in lines
+
+    # histogram invariants: cumulative monotone buckets, +Inf == _count, _sum
+    buckets = [l for l in lines if l.startswith("demo_latency_seconds_bucket")]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+    assert counts == sorted(counts), buckets
+    assert buckets[-1].startswith('demo_latency_seconds_bucket{le="+Inf"}')
+    assert counts == [1, 3, 4, 5]
+    assert "demo_latency_seconds_count 5" in lines
+    sum_line = next(l for l in lines if l.startswith("demo_latency_seconds_sum"))
+    assert abs(float(sum_line.split(" ")[1]) - 5.605) < 1e-9
+    assert h.count() == 5
+
+    # re-registration: same spec returns the same family, mismatch is loud
+    assert reg.counter("demo_requests_total", "requests", labels=("code",)) is c
+    with pytest.raises(ValueError):
+        reg.gauge("demo_requests_total")
+    with pytest.raises(ValueError):
+        reg.counter("bad name!")
+    with pytest.raises(ValueError):
+        c.inc(-1, code="200")
+
+
+def test_metrics_endpoint_roundtrip():
+    from fedml_tpu.obs.registry import MetricsHTTPServer, MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("endpoint_hits_total", "hits").inc(3)
+    server = MetricsHTTPServer(reg, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain; version=0.0.4")
+            body = resp.read().decode()
+        assert "endpoint_hits_total 3" in body
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# comm receive loop: non-blocking transient-decode retry
+
+
+class _FlakyBackend:
+    """Observer-loop harness whose decode fails transiently for payloads
+    starting with b'bad' (recovering after 2 attempts) — the object-store-
+    briefly-unreachable shape the retry path exists for."""
+
+    def __init__(self):
+        from fedml_tpu.comm.base import ObserverLoopMixin
+
+        self._mixin = ObserverLoopMixin()
+        self._mixin._init_observer_loop()
+        self.failures: dict[bytes, int] = {}
+
+        def decode(data):
+            from fedml_tpu.comm.message import Message
+
+            if data.startswith(b"bad"):
+                seen = self.failures.get(data, 0)
+                self.failures[data] = seen + 1
+                if seen < 2:
+                    raise OSError("object store unreachable")
+            msg = Message(int(data.split(b":")[1]), 1, 0)
+            return msg
+
+        self._mixin._decode_bytes = decode
+
+
+def test_transient_decode_retry_does_not_block_queue():
+    from fedml_tpu.comm.base import DECODE_RETRIES, MSG_DROPPED
+
+    backend = _FlakyBackend()
+    mixin = backend._mixin
+    arrivals = []
+
+    class Recorder:
+        def receive_message(self, msg_type, msg):
+            arrivals.append((msg_type, time.monotonic()))
+
+    mixin.add_observer(Recorder())
+    retries_before = DECODE_RETRIES.value()
+    dropped_before = MSG_DROPPED.value(reason="retries_exhausted")
+
+    t = threading.Thread(target=mixin.handle_receive_message, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    mixin._inbox.put(b"bad:7")   # needs 2 backoff windows before decoding
+    mixin._inbox.put(b"ok:1")
+    mixin._inbox.put(b"ok:2")
+    deadline = time.monotonic() + 5
+    while len(arrivals) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    mixin.stop_receive_message()
+    t.join(timeout=2)
+
+    assert [mt for mt, _ in sorted(arrivals, key=lambda a: a[1])][-1] == 7, arrivals
+    ok_times = [ts for mt, ts in arrivals if mt in (1, 2)]
+    bad_times = [ts for mt, ts in arrivals if mt == 7]
+    assert len(ok_times) == 2 and len(bad_times) == 1
+    # healthy messages drained while the flaky payload sat in backoff:
+    # first retry is not-before t0+0.2s, so both OK messages beat it
+    assert max(ok_times) - t0 < 0.2, (t0, arrivals)
+    assert bad_times[0] - t0 >= 0.2
+    assert DECODE_RETRIES.value() - retries_before == 2
+    assert MSG_DROPPED.value(reason="retries_exhausted") == dropped_before
+
+
+def test_poisoned_payload_dropped_after_retry_budget():
+    from fedml_tpu.comm.base import MSG_DROPPED
+
+    backend = _FlakyBackend()
+    backend.failures[b"bad:9"] = -10**6  # never recovers within the budget
+    mixin = backend._mixin
+    arrivals = []
+
+    class Recorder:
+        def receive_message(self, msg_type, msg):
+            arrivals.append(msg_type)
+
+    mixin.add_observer(Recorder())
+    dropped_before = MSG_DROPPED.value(reason="retries_exhausted")
+    t = threading.Thread(target=mixin.handle_receive_message, daemon=True)
+    t.start()
+    mixin._inbox.put(b"bad:9")
+    mixin._inbox.put(b"ok:1")
+    deadline = time.monotonic() + 5
+    while MSG_DROPPED.value(reason="retries_exhausted") == dropped_before \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    mixin.stop_receive_message()
+    t.join(timeout=2)
+    assert MSG_DROPPED.value(reason="retries_exhausted") == dropped_before + 1
+    assert arrivals == [1]  # the healthy message was dispatched, the bad one never
+
+
+# ---------------------------------------------------------------------------
+# e2e: trace propagation across an INPROC cross-silo run + report
+
+
+def test_cross_silo_round_trace_propagates_and_report_reconstructs(tmp_path, eight_devices):
+    """The acceptance criterion: an INPROC cross-silo run with
+    enable_remote_obs yields a collector JSONL from which obs.report
+    reconstructs a per-round span tree where every client train span carries
+    the same trace_id as the server's aggregate span for that round; the
+    registry render is served over /metrics while the run is live."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.obs import report
+
+    jsonl = tmp_path / "trail.jsonl"
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=2, client_num_per_round=2,
+        comm_round=3, learning_rate=0.3, frequency_of_the_test=1, run_id="trace-e2e",
+    )
+    cfg.extra = {"enable_remote_obs": True, "obs_jsonl_path": str(jsonl),
+                 "metrics_port": 0}
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("trace-e2e")
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC") for r in (1, 2)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    assert server.metrics_server is not None
+    port = server.metrics_server.port
+    try:
+        # the endpoint is live for the duration of the run (finish() closes
+        # it): scrape now, before the protocol completes
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            assert resp.status == 200
+            pre_body = resp.read().decode()
+        assert "fedml_comm_messages_sent_total" in pre_body
+        assert "fedml_crosssilo_client_round_trip_seconds" in pre_body
+        history = server.run_until_done(timeout=120.0)
+    finally:
+        for c in clients:
+            c.finish()
+    assert len(history) == 3
+
+    # after the run the process-global registry holds the per-client RTT
+    # histogram samples the straggler attribution is built from
+    from fedml_tpu.obs.registry import REGISTRY
+
+    post_body = REGISTRY.render()
+    assert 'fedml_crosssilo_client_round_trip_seconds_bucket{client="1",le="+Inf"}' in post_body
+    assert 'fedml_crosssilo_client_round_trip_seconds_bucket{client="2",le="+Inf"}' in post_body
+
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    spans = [r for r in records if r.get("kind") == "span"]
+
+    # server spans: one round + one aggregate per round, rank-0 sourced
+    agg_trace_by_round = {}
+    for rec in spans:
+        if rec["name"] == "aggregate":
+            assert rec["sender"] == 0
+            agg_trace_by_round[rec["round_idx"]] = rec["trace_id"]
+    assert sorted(agg_trace_by_round) == [0, 1, 2]
+
+    # EVERY client train span shares the round's trace and parents to the
+    # round span (the server stamp each broadcast carried)
+    round_span_by_trace = {r["trace_id"]: r for r in spans if r["name"] == "round"}
+    trains = [r for r in spans if r["name"] == "train"]
+    assert len(trains) == 6  # 2 clients x 3 rounds
+    for rec in trains:
+        assert rec["trace_id"] == agg_trace_by_round[rec["round_idx"]], rec
+        assert rec["parent_id"] == round_span_by_trace[rec["trace_id"]]["span_id"]
+        assert rec["sender"] in (1, 2) and rec["dur_s"] > 0
+
+    # span-tree reconstruction: each round's tree has the round span as root
+    # with the aggregate span and both train spans among its children
+    trees = report.build_span_trees(records)
+    assert len(trees) == 3
+    for roots in trees.values():
+        root_names = {n.name for n in roots}
+        assert "round" in root_names
+        round_node = next(n for n in roots if n.name == "round")
+        child_names = [c.name for c in round_node.children]
+        assert child_names.count("train") == 2
+        assert "aggregate" in child_names
+
+    # timeline rows + straggler ranking come straight from the trail
+    rows = report.round_rows(records)
+    assert [r["round_idx"] for r in rows] == [0, 1, 2]
+    for row in rows:
+        assert row["round_dur_s"] > 0 and row["aggregate_dur_s"] > 0
+        assert len(row["train"]) == 2
+        assert set(row["round_trips"]) == {"1", "2"}
+    ranking = report.slowest_clients(records)
+    assert {r["client"] for r in ranking} == {"1", "2"}
+    assert all(r["rounds"] == 3 and "mean_round_trip_s" in r for r in ranking)
+
+    rendered = report.render_report(records)
+    assert "== round timeline ==" in rendered
+    assert "== slowest clients ==" in rendered
+    assert "p50_s" in rendered and "p95_s" in rendered
+
+
+def test_obs_report_from_recorded_trail(tmp_path):
+    """`fedml-tpu obs report` reconstructs a deterministic timeline from a
+    synthetic recorded trail (no live run needed)."""
+    from fedml_tpu.cli import main as cli_main
+
+    trail = tmp_path / "obs.jsonl"
+    records = []
+    for r, trace_id in enumerate(["t0", "t1"]):
+        records.append({"sender": 0, "kind": "span", "name": "round", "trace_id": trace_id,
+                        "span_id": f"r{r}", "parent_id": None, "ts": 100.0 + r,
+                        "dur_s": 2.0, "round_idx": r})
+        records.append({"sender": 0, "kind": "span", "name": "aggregate", "trace_id": trace_id,
+                        "span_id": f"a{r}", "parent_id": f"r{r}", "ts": 101.0 + r,
+                        "dur_s": 0.25, "round_idx": r})
+        for rank, dur in ((1, 0.5), (2, 1.5)):
+            records.append({"sender": rank, "kind": "span", "name": "train",
+                            "trace_id": trace_id, "span_id": f"c{rank}{r}",
+                            "parent_id": f"r{r}", "ts": 100.1 + r, "dur_s": dur,
+                            "round_idx": r, "client_idx": rank - 1})
+            records.append({"sender": 0, "kind": "metric", "metric": "client_round_trip_s",
+                            "client": rank, "value": dur + 0.1, "round_idx": r,
+                            "trace_id": trace_id, "ts": 102.0 + r})
+    trail.write_text("\n".join(json.dumps(r) for r in records)
+                     + "\nnot json\n")  # malformed tail line must be skipped
+
+    from fedml_tpu.obs import report
+    recs = report.load_jsonl(trail)
+    assert len(recs) == len(records)
+
+    phases = report.phase_percentiles(recs)
+    assert phases["train"]["n"] == 4
+    assert abs(phases["train"]["p50_s"] - 1.0) < 1e-9   # median of .5,.5,1.5,1.5
+    assert abs(phases["round"]["p95_s"] - 2.0) < 1e-9
+
+    ranking = report.slowest_clients(recs)
+    assert ranking[0]["client"] == "2"  # slowest first
+    assert abs(ranking[0]["mean_train_s"] - 1.5) < 1e-9
+    assert abs(ranking[0]["mean_round_trip_s"] - 1.6) < 1e-9
+
+    rc = cli_main(["obs", "report", str(trail)])
+    assert rc == 0
+
+
+def test_ring_mode_requires_three_clients(eight_devices):
+    """Satellite: ring gossip with n <= 2 silently diverged from the dense
+    ring_topology reference — now refused loudly."""
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.sim.decentralized import DecentralizedSimulator
+
+    cfg = tiny_config(client_num_in_total=2, client_num_per_round=2,
+                      synthetic_train_size=160, synthetic_test_size=32)
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    with pytest.raises(ValueError, match="n >= 3"):
+        DecentralizedSimulator(cfg, ds, model, mode="ring")
+
+
+def test_launch_job_cleans_up_inputs_file_on_failure(tmp_path, monkeypatch):
+    """Satellite: __workflow_inputs__.json must not leak into the source
+    workspace even when packaging explodes (try/finally path)."""
+    from fedml_tpu.workflow.customized_jobs import LaunchJob
+
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("print('hi')\n")
+    yaml_path = tmp_path / "job.yaml"
+    yaml_path.write_text("workspace: ws\njob: python main.py\n")
+
+    from fedml_tpu.sched import launch as launch_mod
+
+    def boom(self, spec, base_dir=None):
+        assert (ws / "__workflow_inputs__.json").exists()  # visible to packaging
+        raise RuntimeError("disk full")
+
+    monkeypatch.setattr(launch_mod.FedMLLaunchManager, "build_package", boom)
+    job = LaunchJob("leaky", str(yaml_path), str(tmp_path / "spool"), timeout=5)
+    with pytest.raises(RuntimeError, match="disk full"):
+        job.run(dep={"tag": "x"})
+    assert not (ws / "__workflow_inputs__.json").exists()

@@ -115,9 +115,12 @@ def test_workflow_trains_then_deploys_then_serves(tmp_path, eight_devices):
         assert Path(outputs["train"]["params_path"]).exists()
         # dependency outputs reached the launched subprocess via the package
         assert outputs["train"]["seen_inputs"] == {"config": {"tag": "e2e", "lr": 0.1}}
+        # ...without leaking the inputs file into the SOURCE workspace
+        assert not (tmp_path / "train_ws" / "__workflow_inputs__.json").exists()
         # the deploy job exposed a LIVE endpoint
         assert outputs["deploy"]["ready_replicas"] == 1
-        out = outputs["deploy"]["predict"]({"inputs": np.zeros((2, 32)).tolist()})
+        # synthetic dataset features are 60-dim (loader _KNOWN table)
+        out = outputs["deploy"]["predict"]({"inputs": np.zeros((2, 60)).tolist()})
         assert len(out["outputs"]) == 2 and len(out["outputs"][0]) == 10
         assert wf.get_workflow_status() == JobStatus.FINISHED
     finally:
