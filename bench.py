@@ -10,7 +10,10 @@ Two benches, one JSON line:
    token x tokens/s / chip peak); vs_baseline = MFU / 0.35 target.
 2. **FedAvg CIFAR-10 ResNet-20 simulation** (the north-star FL recipe,
    BASELINE.md): samples/s/chip with 64 vmapped clients/round x batch 128
-   on the clients mesh axis, plus its own (low, conv-bound) MFU.
+   on the clients mesh axis, plus its own (low, conv-bound) MFU — measured
+   twice, unfused and with the fused Pallas conv epilogues
+   (``extra.fused_blocks``, ops/pallas/fused_block.py), the round-6 A/B.
+   The regression floors are asserted on the UNFUSED number only.
 
 The reference publishes no numeric baselines (BASELINE.md) and has no MFU
 accounting at all; the 0.35 target comes from BASELINE.json's north star.
@@ -22,7 +25,7 @@ import sys
 import time
 
 
-def bench_fedavg(peak):
+def bench_fedavg(peak, fused=False):
     import jax
 
     import fedml_tpu
@@ -52,6 +55,9 @@ def bench_fedavg(peak):
         compute_dtype="bfloat16",
         step_mode="match",
         metrics_jsonl_path="",
+        # fused=True: identical recipe, conv epilogues via the fused Pallas
+        # kernel (ops/pallas/fused_block.py) — the round-6 A/B
+        extra={"fused_blocks": True} if fused else {},
     )
     fedml_tpu.init(cfg)
     sim = FedMLRunner(cfg).runner
@@ -78,7 +84,7 @@ def bench_fedavg(peak):
     #   mandatory BN/relu/residual second passes account for the rest.
     #   See PERF.md "Per-op attribution".
     lane_ceiling, attainable = 0.214, 0.150
-    return {
+    result = {
         "samples_per_sec_chip": round(sps_chip, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "mfu_ceiling": lane_ceiling,
@@ -89,7 +95,44 @@ def bench_fedavg(peak):
         "clients_total": n_clients,
         "clients_per_round": per_round,
         "batch": batch,
+        "fused_blocks": fused,
     }
+    if fused:
+        result["pallas_kernels"] = _kernel_microbench(batch)
+    return result
+
+
+def _kernel_microbench(batch):
+    """Standalone eager timings of each Pallas kernel on the flagship's
+    per-stage activation shapes: populates the process-global
+    ``pallas_kernel_seconds`` histogram (ROADMAP "Pallas-level timing hooks")
+    and returns its summary for the BENCH json.  Eager wall time includes
+    dispatch — an upper bound on the in-program cost, useful for
+    kernel-vs-kernel comparison, not for round accounting."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.ops.pallas import (
+        fused_bn_relu, fused_bn_residual_relu, kernel_time_summary, qsgd_int8,
+    )
+
+    key = jax.random.PRNGKey(0)
+    iters = int(os.environ.get("BENCH_KERNEL_ITERS", "10"))
+    for shape in [(batch, 32, 32, 16), (batch, 16, 16, 32), (batch, 8, 8, 64)]:
+        y = jax.random.normal(key, shape, jnp.bfloat16)
+        r = jax.random.normal(key, shape, jnp.bfloat16)
+        s = jnp.full((shape[-1],), 1.1, jnp.float32)
+        b = jnp.full((shape[-1],), -0.1, jnp.float32)
+        g = jnp.ones(shape, jnp.bfloat16)
+        for _ in range(iters):
+            fused_bn_residual_relu(y, s, b, r)  # eager fwd, observed
+            _, pull = jax.vjp(lambda yy, rr: fused_bn_residual_relu(yy, s, b, rr), y, r)
+            pull(g)  # eager pullback -> the fused bwd kernel, also observed
+            fused_bn_relu(y, s, b)
+    vec = jax.random.normal(key, (1 << 20,), jnp.float32)
+    for i in range(iters):
+        qsgd_int8(vec, jax.random.PRNGKey(i), interpret=jax.default_backend() != "tpu")
+    return kernel_time_summary()
 
 
 def bench_llm(peak):
@@ -143,13 +186,25 @@ def bench_llm(peak):
 
 def _run_one(mode):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # shared persistent compilation cache (core/cache.py — same dir as the
+    # test suite and the multichip dryrun): warm re-runs skip the multi-minute
+    # XLA compiles of the scanned round and LLM step programs
+    from fedml_tpu.core.cache import setup_persistent_cache
+
+    setup_persistent_cache()
+
     import jax
 
     from fedml_tpu.ops import flops as flopslib
 
     dev = jax.devices()[0]
     peak = flopslib.device_peak_flops(dev)
-    result = bench_llm(peak) if mode == "llm" else bench_fedavg(peak)
+    if mode == "llm":
+        result = bench_llm(peak)
+    elif mode == "fedavg_fused":
+        result = bench_fedavg(peak, fused=True)
+    else:
+        result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
     result["chip_peak_tflops"] = round(peak / 1e12, 1) if peak else None
     print("BENCH_RESULT " + json.dumps(result))
@@ -194,6 +249,13 @@ def main():
     # Device identity/peak come back in the children's results.
     llm = _subprocess_bench("llm")
     fedavg = _subprocess_bench("fedavg")
+    # round-6 A/B: the identical FedAvg recipe with conv epilogues through
+    # the fused Pallas kernels.  Soft-fail — a fused-path failure is recorded
+    # in the JSON but must not take down the two floor-guarded benches.
+    try:
+        fedavg_fused = _subprocess_bench("fedavg_fused")
+    except Exception as e:  # noqa: BLE001 — the error string IS the record
+        fedavg_fused = {"error": str(e)[-2000:]}
 
     on_tpu = "TPU" in str(llm.get("device", ""))
     # one retry per bench before declaring a floor violation: a tunneled chip
@@ -210,6 +272,11 @@ def main():
 
     mfu = llm["mfu"]
     target = 0.35  # BASELINE.md MFU floor
+    fused_speedup = None
+    if fedavg.get("samples_per_sec_chip") and fedavg_fused.get("samples_per_sec_chip"):
+        fused_speedup = round(
+            fedavg_fused["samples_per_sec_chip"] / fedavg["samples_per_sec_chip"], 4
+        )
     print(json.dumps({
         "metric": "llm_542m_train_step_mfu",
         "value": mfu if mfu is not None else llm["tokens_per_sec_chip"],
@@ -221,6 +288,8 @@ def main():
             "chip_peak_tflops": llm.get("chip_peak_tflops"),
             "llm": llm,
             "fedavg_cifar10_resnet20": fedavg,
+            "fedavg_cifar10_resnet20_fused": fedavg_fused,
+            "fedavg_fused_speedup": fused_speedup,
         },
     }))
     if violations:

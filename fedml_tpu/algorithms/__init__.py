@@ -68,4 +68,5 @@ def hparams_from_config(cfg, steps_per_epoch: int = 0) -> HParams:
         steps_per_epoch=steps_per_epoch,
         step_mode=getattr(cfg, "step_mode", "match"),
         compute_dtype=cfg.compute_dtype,
+        fused_blocks=bool(getattr(cfg, "fused_blocks", False)),
     )

@@ -137,11 +137,22 @@ class ClientMasterManager(FedMLCommManager):
         # client managers — which override the train-and-send path — ship
         # the same telemetry.
         self.obs = None
+        self._pallas_sink = None
         if (getattr(cfg, "extra", {}) or {}).get("enable_remote_obs"):
             from ..obs import trace as obstrace
             from ..obs.remote import RemoteObsShipper
+            from ..ops.pallas import timing as pallas_timing
 
             self.obs = RemoteObsShipper(self.send_message, rank)
+
+            # eager Pallas kernel timings (quantize round trips etc.) ride
+            # the same trail, so `fedml-tpu obs report` can summarize them
+            # next to the round phases
+            def _pallas_sink(kernel, seconds, _obs=self.obs, _rank=rank):
+                _obs.metric({"metric": "pallas_kernel_seconds",
+                             "kernel": kernel, "value": seconds, "rank": _rank})
+
+            self._pallas_sink = pallas_timing.add_sink(_pallas_sink)
             inner_train = self.trainer.train
 
             def train_with_obs(global_vars, round_idx, seed_key, client_idx=0):
@@ -202,6 +213,11 @@ class ClientMasterManager(FedMLCommManager):
         trainer_finish = getattr(self.trainer, "finish", None)
         if callable(trainer_finish):
             trainer_finish()
+        if self._pallas_sink is not None:
+            from ..ops.pallas import timing as pallas_timing
+
+            pallas_timing.remove_sink(self._pallas_sink)
+            self._pallas_sink = None
         if self.obs is not None:
             self.obs.close()  # final flush while the transport is still up
         try:

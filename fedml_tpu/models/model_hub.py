@@ -31,14 +31,19 @@ def create(cfg: Config, output_dim: int) -> Any:
         return simple.CifarCNN(num_classes=output_dim)
     if name == "mlp":
         return simple.MLP(num_classes=output_dim)
+    # extra.fused_blocks routes the CIFAR-ResNet conv epilogues through the
+    # fused Pallas kernel (ops/pallas/fused_block.py); Config.__getattr__
+    # falls through to the extra dict, so a recipe-level `fused_blocks: true`
+    # lands here without a dedicated field
+    fused = bool(getattr(cfg, "fused_blocks", False))
     if name == "resnet20":
-        return resnet.resnet20(output_dim, norm, dtype)
+        return resnet.resnet20(output_dim, norm, dtype, fused=fused)
     if name == "resnet32":
-        return resnet.resnet32(output_dim, norm, dtype)
+        return resnet.resnet32(output_dim, norm, dtype, fused=fused)
     if name == "resnet44":
-        return resnet.resnet44(output_dim, norm, dtype)
+        return resnet.resnet44(output_dim, norm, dtype, fused=fused)
     if name == "resnet56":
-        return resnet.resnet56(output_dim, norm, dtype)
+        return resnet.resnet56(output_dim, norm, dtype, fused=fused)
     if name in ("resnet18_gn", "resnet_gn"):
         # BN-free escape hatch (reference model/cv/resnet_gn.py)
         return resnet.resnet20(output_dim, "group", dtype)

@@ -21,7 +21,8 @@ from typing import Iterable, Optional, Sequence
 
 __all__ = [
     "load_jsonl", "SpanNode", "build_span_trees", "round_rows",
-    "phase_percentiles", "slowest_clients", "render_report",
+    "phase_percentiles", "slowest_clients", "pallas_kernel_stats",
+    "render_report",
 ]
 
 
@@ -194,6 +195,28 @@ def slowest_clients(records: Iterable[dict]) -> list[dict]:
     return out
 
 
+def pallas_kernel_stats(records: Iterable[dict]) -> list[dict]:
+    """Per-kernel summary of ``pallas_kernel_seconds`` metric records (shipped
+    by clients via the Pallas timing sink, ``ops/pallas/timing.py``): kernel,
+    n, total/mean/max seconds — slowest-total first."""
+    per_kernel: dict[str, list[float]] = {}
+    for rec in records:
+        if rec.get("kind") == "metric" and rec.get("metric") == "pallas_kernel_seconds":
+            per_kernel.setdefault(str(rec.get("kernel")), []).append(
+                float(rec.get("value", 0.0) or 0.0))
+    out = []
+    for kernel, values in per_kernel.items():
+        out.append({
+            "kernel": kernel,
+            "n": len(values),
+            "total_s": sum(values),
+            "mean_s": sum(values) / len(values),
+            "max_s": max(values),
+        })
+    out.sort(key=lambda r: -r["total_s"])
+    return out
+
+
 def _table(headers: list[str], rows: list[list[str]]) -> str:
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(headers)]
@@ -248,4 +271,12 @@ def render_report(records: Iterable[dict]) -> str:
           f"{r['mean_round_trip_s']:.4f}" if "mean_round_trip_s" in r else "-"]
          for r in stragglers],
     ))
+
+    kernels = pallas_kernel_stats(records)
+    if kernels:
+        sections.append("== pallas kernels ==\n" + _table(
+            ["kernel", "n", "total_s", "mean_s", "max_s"],
+            [[r["kernel"], str(r["n"]), f"{r['total_s']:.4f}",
+              f"{r['mean_s']:.6f}", f"{r['max_s']:.6f}"] for r in kernels],
+        ))
     return "\n\n".join(sections) + "\n"

@@ -21,10 +21,14 @@ E[dequantize(quantize(x))] = x  (floor(x/s + u) with u ~ U[0,1) is unbiased).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .timing import observe_eager
 
 _SUB, _LANE = 8, 128  # f32 min tile
 _BLOCK = _SUB * _LANE
@@ -58,6 +62,13 @@ def quantize_int8_stochastic(vec: jax.Array, key: jax.Array, interpret: bool = F
     """flat f32 vector -> (int8 values (blocks, 8, 128), f32 scales (blocks,),
     original length).  ``interpret=True`` runs the same kernel through the
     pallas interpreter (CPU CI)."""
+    return observe_eager(
+        "quantize_int8_stochastic", partial(_quantize_impl, interpret=interpret),
+        vec, key,
+    )
+
+
+def _quantize_impl(vec: jax.Array, key: jax.Array, *, interpret: bool):
     x, n = _pad_blocks(vec.astype(jnp.float32))
     noise = jax.random.uniform(key, x.shape, jnp.float32)
     blocks = x.shape[0]
@@ -83,6 +94,15 @@ def quantize_int8_stochastic(vec: jax.Array, key: jax.Array, interpret: bool = F
 
 def dequantize_int8(values: jax.Array, scales: jax.Array, length: int,
                     interpret: bool = False) -> jax.Array:
+    return observe_eager(
+        "dequantize_int8",
+        partial(_dequantize_impl, length=length, interpret=interpret),
+        values, scales,
+    )
+
+
+def _dequantize_impl(values: jax.Array, scales: jax.Array, *, length: int,
+                     interpret: bool) -> jax.Array:
     blocks = values.shape[0]
     out = pl.pallas_call(
         _dequantize_kernel,

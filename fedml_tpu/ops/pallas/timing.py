@@ -1,0 +1,82 @@
+"""Per-kernel wall-time observation for Pallas entry points.
+
+Every hand-written kernel records its eager invocations into the
+process-global ``pallas_kernel_seconds`` histogram (ROADMAP "Pallas-level
+timing hooks"), labeled by kernel name — scrapable via ``/metrics`` and
+summarized by ``fedml-tpu obs report`` / ``bench.py``.
+
+Only *eager* calls are observed: inside ``jit``/``vmap``/``scan`` the
+arguments are tracers and host wall-clock around the call would measure
+tracing, not execution (per-invocation device time for traced kernels comes
+from ``scripts/profile_trace.py`` on the chip).  Eager observation blocks on
+the kernel's outputs — the callers that hit this path (compression round
+trips, bench microbenches) consume the result immediately anyway.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+from ...obs import registry as obsreg
+
+PALLAS_KERNEL_TIME = obsreg.REGISTRY.histogram(
+    "pallas_kernel_seconds",
+    "Wall time of eagerly-invoked Pallas kernels (dispatch to ready), "
+    "labeled by kernel.",
+    labels=("kernel",),
+)
+
+
+#: extra per-observation sinks ``fn(kernel_name, seconds)`` — e.g. the
+#: cross-silo client forwards observations over the FL transport so they land
+#: in the server's collector trail (and thus in ``fedml-tpu obs report``)
+_sinks: list = []
+
+
+def add_sink(fn):
+    _sinks.append(fn)
+    return fn
+
+
+def remove_sink(fn) -> None:
+    try:
+        _sinks.remove(fn)
+    except ValueError:
+        pass
+
+
+def observe_eager(name: str, fn, *args):
+    """Run ``fn(*args)``; when the call is eager (no tracers among the
+    argument leaves), time it to completion and record under ``name``."""
+    if any(isinstance(l, jax.core.Tracer) for l in jax.tree_util.tree_leaves(args)):
+        return fn(*args)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    PALLAS_KERNEL_TIME.observe(dt, kernel=name)
+    for sink in list(_sinks):
+        try:
+            sink(name, dt)
+        except Exception:
+            pass  # telemetry must never take down the kernel path
+    return out
+
+
+def kernel_time_summary() -> dict:
+    """{kernel: {count, total_s, mean_s}} from the process-global histogram —
+    the JSON-friendly view ``bench.py`` attaches to its results."""
+    out = {}
+    with PALLAS_KERNEL_TIME._lock:
+        children = {k: dict(v) for k, v in PALLAS_KERNEL_TIME._children.items()}
+    for key, child in sorted(children.items()):
+        n = int(child["count"])
+        total = float(child["sum"])
+        out[key[0]] = {
+            "count": n,
+            "total_s": round(total, 6),
+            "mean_s": round(total / n, 6) if n else 0.0,
+        }
+    return out

@@ -72,6 +72,11 @@ CHUNK_CACHE = obsreg.REGISTRY.counter(
     "hit/miss delta over time.",
     labels=("result",),
 )
+FUSED_BLOCKS = obsreg.REGISTRY.gauge(
+    "fedml_sim_fused_blocks",
+    "1 when the simulator's model routes conv epilogues through the fused "
+    "Pallas BasicBlock kernel (extra.fused_blocks), else 0.",
+)
 
 
 from ..core.checkpoint import RoundCheckpointMixin
@@ -108,6 +113,11 @@ class MeshSimulator(RoundCheckpointMixin):
         steps_per_epoch = max(1, math.ceil(self.capacity / cfg.batch_size))
         self.hp = hparams_from_config(cfg, steps_per_epoch=steps_per_epoch)
         self.algorithm = (algorithm or create_algorithm(cfg, self.hp)).build(model)
+
+        # which kernel path this run's model uses (fused Pallas epilogues vs
+        # plain XLA loop fusions) — scrapable next to the round timings so an
+        # A/B pair of runs is attributable from /metrics alone
+        FUSED_BLOCKS.set(1.0 if getattr(model, "fused", False) else 0.0)
 
         self.mesh = mesh if mesh is not None else meshlib.mesh_from_config(cfg)
         # Client-axis padding (SURVEY §7 hard-part 2): stacks whose leading

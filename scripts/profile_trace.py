@@ -7,6 +7,8 @@ and GB/s per bucket — the evidence base for PERF.md's roofline ("what is the
 round actually spending its time and bandwidth on").
 
 Usage: python scripts/profile_trace.py   (on the TPU; writes /tmp/prof)
+       PROFILE_FUSED=1 python scripts/profile_trace.py   (trace the
+       extra.fused_blocks program — the PERF.md round-6 attribution path)
 """
 import collections
 import glob
@@ -34,6 +36,7 @@ def build_sim():
         synthetic_train_size=n_clients * spc, synthetic_test_size=1024,
         frequency_of_the_test=0, compute_dtype="bfloat16", step_mode="match",
         metrics_jsonl_path="",
+        extra={"fused_blocks": True} if os.environ.get("PROFILE_FUSED") else {},
     )
     fedml_tpu.init(cfg)
     return FedMLRunner(cfg).runner

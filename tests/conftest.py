@@ -19,33 +19,17 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite is dominated by XLA compiles (the
 # CNN zoo alone re-compiles ~20 models); caching them across runs cuts the
-# 1-core wall clock severalfold.  Keyed per repo checkout AND per host CPU
-# fingerprint: XLA:CPU AOT entries compiled on a host with different machine
-# features load with "could lead to SIGILL" warnings and occasionally abort
-# the process mid-suite (observed: Fatal Python error: Aborted inside a
-# jitted round) — a cache written on another machine must never be read.
-import hashlib as _hashlib
-import platform as _platform
+# 1-core wall clock severalfold.  The setup (host-CPU-fingerprinted dir at
+# the repo root — see the module for the SIGILL rationale) is shared with
+# the __graft_entry__ multichip dryrun and bench.py via core/cache.py, so
+# all three warm the same cache.
+import sys
 
-_cpu_flags = _platform.machine() + _platform.processor()
-try:
-    _seen = set()
-    with open("/proc/cpuinfo") as _f:
-        for _line in _f:
-            # x86 says "flags", aarch64 says "Features"; model lines cover
-            # hosts with neither.  First occurrence of each key (cpuinfo
-            # repeats per core) — the feature list is the actual contract.
-            _key = _line.split(":", 1)[0].strip()
-            if _key in ("flags", "Features", "model name", "CPU part") and _key not in _seen:
-                _seen.add(_key)
-                _cpu_flags += _line.strip()
-except OSError:
-    pass
-_host_tag = _hashlib.sha1(_cpu_flags.encode()).hexdigest()[:12]
-_cache_dir = os.path.join(os.path.dirname(__file__), "..", f".jax_cache-{_host_tag}")
-jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedml_tpu.core.cache import setup_persistent_cache
+
+setup_persistent_cache()
 
 import numpy as np
 import pytest
