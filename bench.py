@@ -207,6 +207,17 @@ def _run_one(mode):
         result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
     result["chip_peak_tflops"] = round(peak / 1e12, 1) if peak else None
+    # telemetry overhead ledger: the OTLP exporter's shipped/dropped/retried
+    # counters and whatever per-client health the run produced, so the perf
+    # trajectory records what observability cost (0s when no otlp_endpoint /
+    # no cross-silo clients — the honest default)
+    from fedml_tpu.obs.health import health_summary_from_registry
+    from fedml_tpu.obs.otlp import otlp_counters
+
+    result["telemetry"] = {
+        "otlp": otlp_counters(),
+        "client_health": health_summary_from_registry(),
+    }
     print("BENCH_RESULT " + json.dumps(result))
 
 

@@ -325,6 +325,25 @@ def cmd_obs(args) -> int:
             return 1
         print(obs_report.render_report(records), end="")
         return 0
+    if args.obs_cmd == "export":
+        from fedml_tpu.obs import otlp as obs_otlp
+
+        records = []
+        for path in args.jsonl:
+            if not Path(path).exists():
+                print(f"error: no trail {path}", file=sys.stderr)
+                return 2
+            records.extend(obs_report.load_jsonl(path))
+        if not records:
+            print("error: trails contain no records", file=sys.stderr)
+            return 1
+        summary = obs_otlp.export_jsonl_trail(
+            args.endpoint, records,
+            batch_size=args.batch_size, timeout_s=args.timeout,
+        )
+        print(json.dumps(summary))
+        failed = summary["spans_failed"] + summary["metric_points_failed"]
+        return 0 if failed == 0 else 1
     if args.obs_cmd == "serve":
         from fedml_tpu.obs.registry import REGISTRY, MetricsHTTPServer
 
@@ -479,6 +498,13 @@ def main(argv=None) -> int:
     osub = p.add_subparsers(dest="obs_cmd", required=True)
     orep = osub.add_parser("report", help="round timeline + straggler report from JSONL trails")
     orep.add_argument("jsonl", nargs="+", help="collector/metrics JSONL trail path(s)")
+    oexp = osub.add_parser(
+        "export", help="backfill a JSONL trail into an OTLP/HTTP collector")
+    oexp.add_argument("jsonl", nargs="+", help="collector JSONL trail path(s)")
+    oexp.add_argument("--endpoint", required=True,
+                      help="collector base URL (POSTs /v1/traces and /v1/metrics)")
+    oexp.add_argument("--batch-size", type=int, default=512)
+    oexp.add_argument("--timeout", type=float, default=10.0)
     oserve = osub.add_parser("serve", help="serve /metrics + /healthz for this process")
     oserve.add_argument("--port", type=int, default=9109)
     p.set_defaults(fn=cmd_obs)

@@ -62,6 +62,33 @@ SEND_LATENCY = obsreg.REGISTRY.histogram(
 DECODE_RETRY_LIMIT = 3
 DECODE_RETRY_BACKOFF_S = 0.2
 
+#: process-wide comm event sinks ``fn(event, **info)`` for the drop/retry
+#: signals the counters above aggregate — the client health ledger
+#: (obs/health.py) subscribes so transport pressure folds into health
+#: scores.  Sink failures are swallowed: telemetry must never take down
+#: the receive loop.
+_event_sinks: list = []
+
+
+def add_comm_event_sink(fn):
+    _event_sinks.append(fn)
+    return fn
+
+
+def remove_comm_event_sink(fn) -> None:
+    try:
+        _event_sinks.remove(fn)
+    except ValueError:
+        pass
+
+
+def _emit_comm_event(event: str, **info) -> None:
+    for fn in list(_event_sinks):
+        try:
+            fn(event, **info)
+        except Exception:
+            pass
+
 
 class Observer(ABC):
     @abstractmethod
@@ -126,6 +153,7 @@ class ObserverLoopMixin:
                 # receive loop: that silently drops every subsequent FL
                 # message for the life of the process.  Drop it loudly.
                 MSG_DROPPED.inc(reason="undecodable")
+                _emit_comm_event("dropped", reason="undecodable")
                 log.exception("dropping undecodable message (%d bytes)", len(data))
                 continue
             except Exception:
@@ -135,6 +163,7 @@ class ObserverLoopMixin:
                 # retry a few times before giving up.
                 if attempts < DECODE_RETRY_LIMIT:
                     DECODE_RETRIES.inc()
+                    _emit_comm_event("retried")
                     log.warning(
                         "transient decode failure (attempt %d) — deferring",
                         attempts + 1, exc_info=True,
@@ -145,6 +174,7 @@ class ObserverLoopMixin:
                     ))
                 else:
                     MSG_DROPPED.inc(reason="retries_exhausted")
+                    _emit_comm_event("dropped", reason="retries_exhausted")
                     log.exception(
                         "dropping message after %d decode attempts", attempts + 1
                     )

@@ -159,12 +159,30 @@ class FedMLAggregator:
     def test_on_server(self) -> dict:
         return {k: float(v) for k, v in self._eval_fn(self.global_vars, *self._test).items()}
 
-    def client_selection(self, round_idx: int, client_ids: list[int], per_round: int) -> list[int]:
-        """Reference ``client_selection`` (:139) semantics on real ranks."""
+    def client_selection(self, round_idx: int, client_ids: list[int], per_round: int,
+                         health=None) -> list[int]:
+        """Reference ``client_selection`` (:139) semantics on real ranks.
+
+        With a :class:`~fedml_tpu.obs.health.ClientHealthLedger` (gated on
+        ``extra.health_aware_selection`` by the server manager), degraded
+        ranks are deprioritized: the round samples from the healthy pool
+        first and only fills remaining slots with the least-degraded ranks.
+        When everyone fits, everyone still participates (reference
+        semantics); without a ledger the sampling is bit-identical to the
+        reference's round-seeded ``np.random.choice``."""
         if per_round >= len(client_ids):
             return list(client_ids)
-        idx = rng.sample_clients_np(round_idx, len(client_ids), per_round)
-        return [client_ids[i] for i in idx]
+        pool = list(client_ids)
+        if health is not None:
+            healthy, degraded = health.partition(pool)
+            if len(healthy) >= per_round:
+                pool = healthy
+            else:
+                pool = healthy + degraded[: per_round - len(healthy)]
+        if per_round >= len(pool):
+            return list(pool)
+        idx = rng.sample_clients_np(round_idx, len(pool), per_round)
+        return [pool[i] for i in idx]
 
     def data_silo_selection(self, round_idx: int, data_silo_num_in_total: int,
                             client_num_in_total: int) -> list[int]:
@@ -211,12 +229,28 @@ class FedMLServerManager(FedMLCommManager):
         # rides THIS comm manager — client shippers target rank 0
         self.obs_collector = None
         extra = getattr(cfg, "extra", {}) or {}
-        if extra.get("enable_remote_obs"):
+        # OTLP egress (obs/otlp.py): gated on extra.otlp_endpoint — unset
+        # means no exporter object, no worker thread, default path unchanged
+        from ..obs import otlp as obsotlp
+
+        self.otlp = obsotlp.exporter_from_config(cfg)
+        if extra.get("enable_remote_obs") or self.otlp is not None:
             from ..obs.remote import ObsCollector
 
+            # the exporter tees on collector ingest, so rank 0 exports the
+            # whole distributed round tree (its own spans + every
+            # client-shipped span under one trace_id per round)
             self.obs_collector = ObsCollector(
-                extra.get("obs_jsonl_path") or None
+                extra.get("obs_jsonl_path") or None, otlp=self.otlp
             ).attach(self)
+        # per-client health ledger (obs/health.py): EWMA RTT, deadline
+        # breaches, comm failures -> fedml_client_health_* gauges.  Always
+        # maintained (same always-on stance as the RTT histogram); consulted
+        # by client_selection only behind extra.health_aware_selection.
+        from ..obs.health import ClientHealthLedger
+
+        self.health = ClientHealthLedger().attach_comm()
+        self.health_aware = bool(extra.get("health_aware_selection"))
         # distributed round tracing: one trace per round, stamped on every
         # broadcast so client train spans join it (obs.trace module doc)
         self._round_span: Optional[obstrace.Span] = None
@@ -272,6 +306,7 @@ class FedMLServerManager(FedMLCommManager):
             if sent_at is not None:
                 rtt = time.perf_counter() - sent_at
                 CLIENT_ROUND_TRIP.observe(rtt, client=str(sender))
+                self.health.observe_rtt(sender, rtt)
                 self._round_rtts[sender] = rtt
             self.aggregator.add_local_trained_result(
                 sender,
@@ -298,6 +333,13 @@ class FedMLServerManager(FedMLCommManager):
                     "round %d: straggler timeout, aggregating %d/%d clients",
                     self.round_idx, self.aggregator.received_count(), len(self.selected),
                 )
+                # the round proceeds without them: every selected-but-missing
+                # rank breached the deadline — the health ledger remembers,
+                # and (behind extra.health_aware_selection) later rounds
+                # deprioritize repeat offenders
+                for cid in self.selected:
+                    if cid not in self.aggregator.model_dict:
+                        self.health.record_deadline_breach(cid)
                 self._finish_round()
             else:
                 self._arm_straggler_timer()  # keep waiting for quorum
@@ -349,6 +391,9 @@ class FedMLServerManager(FedMLCommManager):
                  "trace_id": round_span.trace_id, "ts": time.time()}
                 for cid, rtt in sorted(self._round_rtts.items())
             ]
+            # health trajectory rides the same trail: one client_health
+            # record per known client, per round (obs report renders it)
+            records += self.health.records(trace_id=round_span.trace_id)
             self.obs_collector.ingest(0, records)
         self._round_rtts.clear()
         self._round_span = None
@@ -356,7 +401,10 @@ class FedMLServerManager(FedMLCommManager):
     def _broadcast_model(self, msg_type: int) -> None:
         """Select clients, send them the global model for this round, arm the
         straggler timer — shared by round 0 (INIT) and later rounds (SYNC)."""
-        self.selected = self.aggregator.client_selection(self.round_idx, self._candidate_ids(), self.per_round)
+        self.selected = self.aggregator.client_selection(
+            self.round_idx, self._candidate_ids(), self.per_round,
+            health=self.health if self.health_aware else None,
+        )
         # one fresh trace per round: every broadcast carries its header, so
         # each client's train span lands in this round's span tree
         self._round_span = obstrace.Span(
@@ -377,6 +425,7 @@ class FedMLServerManager(FedMLCommManager):
                 # best-effort per client: one unreachable peer must not kill
                 # the receive/timer thread mid-broadcast and hang the run —
                 # quorum + straggler handling own progress for missing clients
+                self.health.record_comm_failure(cid)
                 log.warning("broadcast to client %d failed; continuing", cid, exc_info=True)
         self._arm_straggler_timer()
 
@@ -393,6 +442,11 @@ class FedMLServerManager(FedMLCommManager):
         super().finish()
         if self.obs_collector is not None:
             self.obs_collector.close()  # release the JSONL append handle
+        if self.otlp is not None:
+            # drain queued spans + ship the final registry snapshot (close
+            # is idempotent — finish can run twice on the timeout path)
+            self.otlp.close()
+        self.health.detach_comm()
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None

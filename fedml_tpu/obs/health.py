@@ -1,0 +1,228 @@
+"""Per-client health ledger — what telemetry learns feeds back into selection.
+
+The communication-perspective FL surveys (PAPERS.md, arxiv 2405.20431) name
+client heterogeneity and straggler variance as the dominant cross-silo
+bottleneck; PR 1 measured it (per-client RTT histogram, straggler-timeout
+quorum) but the server kept sampling degraded ranks anyway.  This ledger
+folds the three signals the server already observes into one health score
+per client:
+
+- **EWMA round trip** — the same broadcast-to-reply RTT the
+  ``fedml_crosssilo_client_round_trip_seconds`` histogram observes, smoothed
+  per client (``ewma_alpha``);
+- **deadline breaches** — selected-but-missing when a straggler timeout
+  fires and the round proceeds on quorum (``_on_straggler_timeout``);
+- **comm failures** — per-client broadcast send errors, plus process-wide
+  transport drop/retry pressure via the comm layer's event sinks.
+
+Scores live in ``[0, 1]`` (1 = healthy), decay back toward healthy on every
+successful round trip (``recovery``), and are exported as
+``fedml_client_health_*`` gauges.  ``FedMLAggregator.client_selection``
+consults ``partition()`` behind ``extra.health_aware_selection`` to
+deprioritize degraded ranks: healthy clients are sampled first, degraded
+ones fill remaining slots best-score-first — a rank is deprioritized, never
+permanently evicted, so a recovered client re-enters the pool.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable, Optional
+
+from . import registry as obsreg
+
+__all__ = ["ClientHealthLedger", "health_summary_from_registry"]
+
+HEALTH_SCORE = obsreg.REGISTRY.gauge(
+    "fedml_client_health_score",
+    "Per-client health in [0,1] (1 = healthy): EWMA RTT vs the fleet, "
+    "deadline breaches, comm failures.  Feeds health-aware selection.",
+    labels=("client",),
+)
+HEALTH_EWMA_RTT = obsreg.REGISTRY.gauge(
+    "fedml_client_health_ewma_rtt_seconds",
+    "EWMA of the broadcast-to-reply round trip, by client rank.",
+    labels=("client",),
+)
+HEALTH_BREACHES = obsreg.REGISTRY.gauge(
+    "fedml_client_health_deadline_breaches",
+    "Decayed count of straggler-deadline breaches, by client rank.",
+    labels=("client",),
+)
+HEALTH_COMM_FAILURES = obsreg.REGISTRY.gauge(
+    "fedml_client_health_comm_failures",
+    "Decayed count of per-client transport failures, by client rank.",
+    labels=("client",),
+)
+
+
+class ClientHealthLedger:
+    """Thread-safe per-client health state + the selection-facing queries.
+
+    The score is multiplicative so each signal degrades independently:
+    ``1/(1 + breach_weight*breaches)`` x ``1/(1 + comm_weight*failures)``
+    x an RTT factor that only kicks in when a client's EWMA round trip
+    exceeds ``rtt_degraded_factor`` x the fleet median (absolute RTTs vary
+    by deployment; the *ratio* flags the straggler).
+    """
+
+    def __init__(self, ewma_alpha: float = 0.3, breach_weight: float = 0.5,
+                 comm_weight: float = 0.25, rtt_degraded_factor: float = 3.0,
+                 recovery: float = 0.25, degraded_threshold: float = 0.5):
+        self.ewma_alpha = float(ewma_alpha)
+        self.breach_weight = float(breach_weight)
+        self.comm_weight = float(comm_weight)
+        self.rtt_degraded_factor = float(rtt_degraded_factor)
+        self.recovery = float(recovery)
+        self.degraded_threshold = float(degraded_threshold)
+        self._lock = threading.Lock()
+        self._clients: dict[int, dict] = {}
+        # process-wide transport pressure (unattributable to one client:
+        # drops happen before the sender is decodable)
+        self.comm_drops = 0
+        self.comm_retries = 0
+        self._comm_sink = None
+
+    def _entry(self, client) -> dict:
+        return self._clients.setdefault(int(client), {
+            "ewma_rtt_s": None, "rtts": 0, "breaches": 0.0, "comm_failures": 0.0,
+        })
+
+    # -- signal intake --------------------------------------------------------
+    def observe_rtt(self, client, rtt_s: float) -> None:
+        """A completed round trip: update the EWMA and decay the failure
+        counts — successful replies are the evidence of recovery."""
+        with self._lock:
+            e = self._entry(client)
+            prev = e["ewma_rtt_s"]
+            e["ewma_rtt_s"] = (float(rtt_s) if prev is None
+                               else self.ewma_alpha * float(rtt_s)
+                               + (1.0 - self.ewma_alpha) * prev)
+            e["rtts"] += 1
+            e["breaches"] *= (1.0 - self.recovery)
+            e["comm_failures"] *= (1.0 - self.recovery)
+        self._export(int(client))
+
+    def record_deadline_breach(self, client) -> None:
+        with self._lock:
+            self._entry(client)["breaches"] += 1.0
+        self._export(int(client))
+
+    def record_comm_failure(self, client, n: float = 1.0) -> None:
+        with self._lock:
+            self._entry(client)["comm_failures"] += float(n)
+        self._export(int(client))
+
+    def attach_comm(self) -> "ClientHealthLedger":
+        """Subscribe to the comm layer's process-wide drop/retry events
+        (``comm.base.add_comm_event_sink``); idempotent."""
+        if self._comm_sink is None:
+            from ..comm import base as comm_base
+
+            def sink(event: str, **_info):
+                with self._lock:
+                    if event == "dropped":
+                        self.comm_drops += 1
+                    elif event == "retried":
+                        self.comm_retries += 1
+
+            self._comm_sink = comm_base.add_comm_event_sink(sink)
+        return self
+
+    def detach_comm(self) -> None:
+        if self._comm_sink is not None:
+            from ..comm import base as comm_base
+
+            comm_base.remove_comm_event_sink(self._comm_sink)
+            self._comm_sink = None
+
+    # -- scoring --------------------------------------------------------------
+    def _fleet_median_rtt_locked(self) -> Optional[float]:
+        vals = sorted(e["ewma_rtt_s"] for e in self._clients.values()
+                      if e["ewma_rtt_s"])
+        return vals[len(vals) // 2] if vals else None
+
+    def _score_locked(self, client: int) -> float:
+        e = self._clients.get(client)
+        if e is None:
+            return 1.0  # never observed = assumed healthy
+        s = 1.0 / (1.0 + self.breach_weight * e["breaches"])
+        s *= 1.0 / (1.0 + self.comm_weight * e["comm_failures"])
+        med = self._fleet_median_rtt_locked()
+        ewma = e["ewma_rtt_s"]
+        if med and ewma and ewma > self.rtt_degraded_factor * med:
+            s *= (self.rtt_degraded_factor * med) / ewma
+        return s
+
+    def score(self, client) -> float:
+        with self._lock:
+            return self._score_locked(int(client))
+
+    def partition(self, client_ids: Iterable) -> tuple[list, list]:
+        """(healthy, degraded) split of ``client_ids`` at
+        ``degraded_threshold``; degraded comes back best-score-first so the
+        caller can fill remaining slots with the least-bad ranks."""
+        with self._lock:
+            scored = [(self._score_locked(int(c)), c) for c in client_ids]
+        healthy = [c for s, c in scored if s >= self.degraded_threshold]
+        degraded = [c for s, c in sorted(
+            (sc for sc in scored if sc[0] < self.degraded_threshold),
+            key=lambda t: t[0], reverse=True)]
+        return healthy, degraded
+
+    # -- export ---------------------------------------------------------------
+    def _export(self, client: int) -> None:
+        with self._lock:
+            e = self._clients.get(client)
+            if e is None:
+                return
+            score = self._score_locked(client)
+            ewma = e["ewma_rtt_s"] or 0.0
+            breaches, failures = e["breaches"], e["comm_failures"]
+        label = str(client)
+        HEALTH_SCORE.set(score, client=label)
+        HEALTH_EWMA_RTT.set(ewma, client=label)
+        HEALTH_BREACHES.set(breaches, client=label)
+        HEALTH_COMM_FAILURES.set(failures, client=label)
+
+    def summary(self) -> dict:
+        """{client: {score, ewma_rtt_s, rtts, breaches, comm_failures}} plus
+        the process-wide comm pressure under the ``_comm`` key."""
+        with self._lock:
+            out = {
+                cid: {
+                    "score": round(self._score_locked(cid), 4),
+                    "ewma_rtt_s": round(e["ewma_rtt_s"], 6) if e["ewma_rtt_s"] else None,
+                    "rtts": e["rtts"],
+                    "breaches": round(e["breaches"], 4),
+                    "comm_failures": round(e["comm_failures"], 4),
+                }
+                for cid, e in sorted(self._clients.items())
+            }
+            out["_comm"] = {"drops": self.comm_drops, "retries": self.comm_retries}
+        return out
+
+    def records(self, trace_id: Optional[str] = None) -> list[dict]:
+        """Collector-trail metric records (one per client) so the health
+        trajectory persists in the same JSONL the spans land in and
+        ``fedml-tpu obs report`` can render it."""
+        now = time.time()
+        summary = self.summary()
+        out = []
+        for cid, e in summary.items():
+            if cid == "_comm":
+                continue
+            rec = {"kind": "metric", "metric": "client_health", "client": cid,
+                   "ts": now, **e}
+            if trace_id:
+                rec["trace_id"] = trace_id
+            out.append(rec)
+        return out
+
+
+def health_summary_from_registry() -> dict:
+    """{client: score} read back from the global gauges — lets ``bench.py``
+    record a health summary without holding a ledger reference."""
+    fam = HEALTH_SCORE._snapshot()
+    return {s["labels"]["client"]: round(s["value"], 4) for s in fam["samples"]}

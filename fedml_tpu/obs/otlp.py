@@ -1,0 +1,462 @@
+"""OTLP/HTTP JSON export sink — stdlib only, no opentelemetry-sdk.
+
+PR 1 made rounds traceable but the data dead-ended on the local machine
+(JSONL trails + a /metrics endpoint).  This module is the egress: it maps
+the obs layer's native shapes onto the OpenTelemetry protocol's proto3-JSON
+encoding (OTLP/HTTP, ``Content-Type: application/json``), which every
+standard collector (otel-collector, Jaeger all-in-one, Grafana Alloy,
+vendor OTLP endpoints) accepts on ``/v1/traces`` and ``/v1/metrics``:
+
+- ``Span.to_record()`` dicts -> ``resourceSpans``: trace/span ids
+  zero-padded to the protocol's 32/16 hex chars, wall clocks to unix-nano
+  strings, leftover record keys to typed attributes;
+- ``MetricsRegistry.snapshot()`` -> ``resourceMetrics``: Counter ->
+  monotonic cumulative sum, Gauge -> gauge, Histogram -> histogram data
+  points with explicit bounds (the +Inf bucket becomes the overflow count).
+
+:class:`OTLPExporter` is a batched background worker over a bounded queue
+with exponential-backoff retry on 429/5xx and connection errors.  Its
+shipped/dropped/retried counts land back in the SAME registry it exports,
+so telemetry loss is itself observable.  ``exporter_from_config`` gates the
+whole thing on ``extra.otlp_endpoint`` (or ``FEDML_TPU_OTLP_ENDPOINT``):
+unset means no exporter object and no thread — the default path is
+untouched.  ``export_jsonl_trail`` backfills a recorded collector trail
+(``fedml-tpu obs export``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from typing import Iterable, Optional
+
+from . import registry as obsreg
+
+__all__ = [
+    "OTLPExporter", "exporter_from_config", "export_jsonl_trail",
+    "span_record_to_otlp", "spans_to_otlp", "metrics_snapshot_to_otlp",
+    "trail_metrics_to_otlp", "post_otlp", "otlp_counters",
+]
+
+_INF = float("inf")
+
+#: exporter self-telemetry, in the registry the exporter itself ships
+OTLP_SHIPPED = obsreg.REGISTRY.counter(
+    "fedml_otlp_shipped_total",
+    "Spans / metric data points delivered to the OTLP collector.",
+    labels=("signal",),
+)
+OTLP_DROPPED = obsreg.REGISTRY.counter(
+    "fedml_otlp_dropped_total",
+    "Spans / metric data points lost (bounded queue full, non-retryable "
+    "status, or retry budget exhausted).",
+    labels=("signal", "reason"),
+)
+OTLP_RETRIED = obsreg.REGISTRY.counter(
+    "fedml_otlp_retried_total",
+    "OTLP export requests retried after 429/5xx or a connection failure.",
+)
+
+
+# ---------------------------------------------------------------------------
+# shape mapping: obs records -> OTLP proto3-JSON
+
+
+def _hex_id(value, width: int) -> str:
+    """Normalize an id to the OTLP hex width (32 for traces, 16 for spans).
+    Native ids are 16-hex ``secrets.token_hex(8)`` — zero-padded on the
+    left; foreign/non-hex ids (hand-written trails) hash deterministically
+    so parent/child links still line up after conversion."""
+    s = str(value if value is not None else "").strip().lower()
+    if not s:
+        return ""
+    if all(c in "0123456789abcdef" for c in s):
+        return s[-width:].zfill(width)
+    return hashlib.sha256(s.encode()).hexdigest()[:width]
+
+
+def _any_value(v) -> dict:
+    """proto3-JSON AnyValue (int64 is a string in the JSON encoding)."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    if isinstance(v, str):
+        return {"stringValue": v}
+    return {"stringValue": json.dumps(v, default=str)}
+
+
+def _attrs(d: dict) -> list:
+    return [{"key": str(k), "value": _any_value(v)} for k, v in d.items()]
+
+
+def _num(v, default: float = 0.0) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+_SPAN_CORE_KEYS = frozenset({"kind", "name", "trace_id", "span_id", "parent_id",
+                             "ts", "dur_s"})
+
+
+def span_record_to_otlp(rec: dict) -> dict:
+    """One ``Span.to_record()``-shaped dict -> one OTLP JSON Span."""
+    ts = _num(rec.get("ts"))
+    dur = _num(rec.get("dur_s"))
+    start_ns = int(ts * 1e9)
+    span = {
+        "traceId": _hex_id(rec.get("trace_id"), 32),
+        "spanId": _hex_id(rec.get("span_id"), 16),
+        "name": str(rec.get("name", "")),
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(start_ns),
+        "endTimeUnixNano": str(start_ns + int(dur * 1e9)),
+        "attributes": _attrs({k: v for k, v in rec.items()
+                              if k not in _SPAN_CORE_KEYS and v is not None}),
+    }
+    parent = _hex_id(rec.get("parent_id"), 16)
+    if parent:
+        span["parentSpanId"] = parent
+    return span
+
+
+def _resource(service_name: str, resource_attributes: Optional[dict]) -> dict:
+    return {"attributes": _attrs({"service.name": service_name,
+                                  **(resource_attributes or {})})}
+
+
+def spans_to_otlp(records: Iterable[dict], service_name: str = "fedml-tpu",
+                  resource_attributes: Optional[dict] = None,
+                  scope: str = "fedml_tpu.obs") -> tuple[dict, int]:
+    """Span records -> an ``ExportTraceServiceRequest`` JSON body.  Returns
+    (payload, span count); non-span / id-less records are skipped."""
+    spans = [span_record_to_otlp(r) for r in records
+             if r.get("kind") == "span" and r.get("trace_id") and r.get("span_id")]
+    payload = {"resourceSpans": [{
+        "resource": _resource(service_name, resource_attributes),
+        "scopeSpans": [{"scope": {"name": scope}, "spans": spans}],
+    }]}
+    return payload, len(spans)
+
+
+def metrics_snapshot_to_otlp(snapshot: list[dict], service_name: str = "fedml-tpu",
+                             resource_attributes: Optional[dict] = None,
+                             scope: str = "fedml_tpu.obs.registry",
+                             time_unix_nano: Optional[int] = None) -> tuple[dict, int]:
+    """``MetricsRegistry.snapshot()`` -> an ``ExportMetricsServiceRequest``
+    JSON body.  Counter -> cumulative monotonic sum, Gauge -> gauge,
+    Histogram -> histogram with explicit bounds.  Returns (payload, number
+    of data points)."""
+    now = str(time_unix_nano if time_unix_nano is not None else int(time.time() * 1e9))
+    metrics, n_points = [], 0
+    for fam in snapshot:
+        kind = fam.get("kind")
+        if kind == "histogram":
+            bounds = [b for b in fam.get("buckets", ()) if b != _INF]
+            dps = [{
+                "attributes": _attrs(s["labels"]),
+                "timeUnixNano": now,
+                "count": str(int(s["count"])),
+                "sum": float(s["sum"]),
+                "bucketCounts": [str(int(c)) for c in s["counts"]],
+                "explicitBounds": bounds,
+            } for s in fam["samples"]]
+            body = {"histogram": {"dataPoints": dps, "aggregationTemporality": 2}}
+        else:
+            dps = [{"attributes": _attrs(s["labels"]), "timeUnixNano": now,
+                    "asDouble": float(s["value"])} for s in fam["samples"]]
+            if kind == "counter":
+                body = {"sum": {"dataPoints": dps, "aggregationTemporality": 2,
+                                "isMonotonic": True}}
+            else:  # gauge / untyped
+                body = {"gauge": {"dataPoints": dps}}
+        metrics.append({"name": fam["name"], "description": fam.get("help", ""),
+                        **body})
+        n_points += len(dps)
+    payload = {"resourceMetrics": [{
+        "resource": _resource(service_name, resource_attributes),
+        "scopeMetrics": [{"scope": {"name": scope}, "metrics": metrics}],
+    }]}
+    return payload, n_points
+
+
+def trail_metrics_to_otlp(records: Iterable[dict], service_name: str = "fedml-tpu",
+                          resource_attributes: Optional[dict] = None,
+                          scope: str = "fedml_tpu.obs.trail") -> tuple[dict, int]:
+    """Collector-trail ``kind: metric`` records (``{"metric": name,
+    "value": x, ...}``) -> gauge data points, grouped per metric name —
+    the backfill half of ``fedml-tpu obs export``.  Records without a name
+    or a numeric value are skipped."""
+    by_name: dict[str, list] = {}
+    n_points = 0
+    for rec in records:
+        if rec.get("kind") != "metric":
+            continue
+        name = rec.get("metric")
+        value = rec.get("value")
+        if not name or not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        extra = {k: v for k, v in rec.items()
+                 if k not in ("kind", "metric", "value", "ts") and v is not None}
+        by_name.setdefault(str(name), []).append({
+            "attributes": _attrs(extra),
+            "timeUnixNano": str(int(_num(rec.get("ts"), time.time()) * 1e9)),
+            "asDouble": float(value),
+        })
+        n_points += 1
+    metrics = [{"name": name, "description": "backfilled from a collector JSONL trail",
+                "gauge": {"dataPoints": dps}} for name, dps in sorted(by_name.items())]
+    payload = {"resourceMetrics": [{
+        "resource": _resource(service_name, resource_attributes),
+        "scopeMetrics": [{"scope": {"name": scope}, "metrics": metrics}],
+    }]}
+    return payload, n_points
+
+
+# ---------------------------------------------------------------------------
+# transport
+
+
+def post_otlp(url: str, payload: dict, timeout_s: float = 10.0,
+              max_retries: int = 4, backoff_base_s: float = 0.25,
+              backoff_max_s: float = 10.0, headers: Optional[dict] = None,
+              on_retry=None) -> Optional[int]:
+    """POST one OTLP JSON body; exponential-backoff retry on 429/5xx and
+    connection errors.  Returns the final HTTP status, or None when every
+    attempt failed at the connection level."""
+    body = json.dumps(payload).encode("utf-8")
+    delay = backoff_base_s
+    status: Optional[int] = None
+    for attempt in range(max_retries + 1):
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        except (OSError, urllib.error.URLError):
+            status = None  # connection-level failure: retryable
+        if status is not None and 200 <= status < 300:
+            return status
+        retryable = status is None or status == 429 or status >= 500
+        if not retryable or attempt == max_retries:
+            return status
+        if on_retry is not None:
+            try:
+                on_retry()
+            except Exception:
+                pass
+        time.sleep(delay)
+        delay = min(delay * 2.0, backoff_max_s)
+    return status
+
+
+class OTLPExporter:
+    """Batched background OTLP/HTTP exporter over a bounded queue.
+
+    ``enqueue_span(record)`` never blocks the caller: a full queue drops
+    the record (counted, reason ``queue_full``).  The daemon worker drains
+    up to ``batch_size`` records per request to ``/v1/traces``; a request
+    that still fails after the retry budget drops its batch (counted).
+    ``export_metrics_now()`` ships the registry snapshot to ``/v1/metrics``
+    on the caller's thread; ``close()`` drains the span queue, ships a
+    final snapshot, and joins the worker.
+    """
+
+    def __init__(self, endpoint: str, registry: Optional[obsreg.MetricsRegistry] = None,
+                 service_name: str = "fedml-tpu",
+                 resource_attributes: Optional[dict] = None,
+                 queue_size: int = 4096, batch_size: int = 256,
+                 flush_interval_s: float = 1.0, max_retries: int = 4,
+                 backoff_base_s: float = 0.25, backoff_max_s: float = 10.0,
+                 timeout_s: float = 5.0, headers: Optional[dict] = None):
+        self.endpoint = endpoint.rstrip("/")
+        self.registry = registry or obsreg.REGISTRY
+        self.service_name = service_name
+        self.resource_attributes = dict(resource_attributes or {})
+        self.queue_size = int(queue_size)
+        self.batch_size = int(batch_size)
+        self.flush_interval_s = float(flush_interval_s)
+        self._post_kw = dict(timeout_s=timeout_s, max_retries=max_retries,
+                             backoff_base_s=backoff_base_s,
+                             backoff_max_s=backoff_max_s, headers=headers)
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._stop = threading.Event()
+        self._closed = False
+        self._thread = threading.Thread(target=self._worker,
+                                        name="fedml-otlp-export", daemon=True)
+        self._thread.start()
+
+    # -- producers ------------------------------------------------------------
+    def enqueue_span(self, record: dict) -> bool:
+        with self._cv:
+            if len(self._q) >= self.queue_size:
+                OTLP_DROPPED.inc(signal="traces", reason="queue_full")
+                return False
+            self._q.append(dict(record))
+            self._cv.notify()
+        return True
+
+    def tee(self, sender, batch: Iterable[dict]) -> None:
+        """``ObsCollector.ingest`` tap: queue every span record of a
+        collector batch, stamped with its sender rank."""
+        for rec in batch:
+            if isinstance(rec, dict) and rec.get("kind") == "span" and rec.get("trace_id"):
+                self.enqueue_span({"sender": sender, **rec})
+
+    # -- shipping -------------------------------------------------------------
+    def _send_spans(self, batch: list[dict]) -> None:
+        payload, n = spans_to_otlp(batch, service_name=self.service_name,
+                                   resource_attributes=self.resource_attributes)
+        if not n:
+            return
+        status = post_otlp(self.endpoint + "/v1/traces", payload,
+                           on_retry=OTLP_RETRIED.inc, **self._post_kw)
+        if status is not None and 200 <= status < 300:
+            OTLP_SHIPPED.inc(n, signal="traces")
+        else:
+            reason = "retries_exhausted" if (status is None or status == 429
+                                             or status >= 500) else "rejected"
+            OTLP_DROPPED.inc(n, signal="traces", reason=reason)
+
+    def export_metrics_now(self, snapshot: Optional[list[dict]] = None) -> bool:
+        """Ship a registry snapshot to ``/v1/metrics`` (caller's thread)."""
+        payload, n = metrics_snapshot_to_otlp(
+            snapshot if snapshot is not None else self.registry.snapshot(),
+            service_name=self.service_name,
+            resource_attributes=self.resource_attributes,
+        )
+        status = post_otlp(self.endpoint + "/v1/metrics", payload,
+                           on_retry=OTLP_RETRIED.inc, **self._post_kw)
+        ok = status is not None and 200 <= status < 300
+        if ok:
+            OTLP_SHIPPED.inc(max(n, 1), signal="metrics")
+        else:
+            reason = "retries_exhausted" if (status is None or status == 429
+                                             or status >= 500) else "rejected"
+            OTLP_DROPPED.inc(max(n, 1), signal="metrics", reason=reason)
+        return ok
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                if not self._q and not self._stop.is_set():
+                    self._cv.wait(self.flush_interval_s)
+                batch = [self._q.popleft()
+                         for _ in range(min(len(self._q), self.batch_size))]
+                if batch:
+                    self._inflight += 1
+                stopping = self._stop.is_set()
+            if batch:
+                try:
+                    self._send_spans(batch)
+                finally:
+                    with self._cv:
+                        self._inflight -= 1
+                        self._cv.notify_all()
+            elif stopping:
+                return
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until the span queue is drained (or ``timeout``)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            self._cv.notify_all()
+            while (self._q or self._inflight) and time.monotonic() < deadline:
+                self._cv.wait(0.05)
+            return not self._q and not self._inflight
+
+    def close(self, timeout: float = 15.0) -> None:
+        """Drain remaining spans, ship a final metrics snapshot, stop the
+        worker.  Idempotent; telemetry shutdown must never raise."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout)
+        try:
+            self.export_metrics_now()
+        except Exception:
+            pass
+
+
+def exporter_from_config(cfg, **kwargs) -> Optional[OTLPExporter]:
+    """The gate: an exporter (and its worker thread) exists ONLY when
+    ``cfg.extra['otlp_endpoint']`` or ``$FEDML_TPU_OTLP_ENDPOINT`` is set;
+    otherwise None and the default path is byte-for-byte unchanged."""
+    extra = (getattr(cfg, "extra", {}) or {}) if cfg is not None else {}
+    endpoint = extra.get("otlp_endpoint") or os.environ.get("FEDML_TPU_OTLP_ENDPOINT")
+    if not endpoint:
+        return None
+    return OTLPExporter(str(endpoint), **kwargs)
+
+
+def export_jsonl_trail(endpoint: str, records: list[dict], *,
+                       batch_size: int = 512, timeout_s: float = 10.0,
+                       max_retries: int = 4, service_name: str = "fedml-tpu",
+                       resource_attributes: Optional[dict] = None) -> dict:
+    """Backfill a recorded collector JSONL trail into an OTLP collector:
+    span records to ``/v1/traces`` in batches, numeric metric records to
+    ``/v1/metrics`` as gauges.  Returns a shipped/failed summary
+    (``fedml-tpu obs export`` prints it)."""
+    endpoint = endpoint.rstrip("/")
+    kw = dict(timeout_s=timeout_s, max_retries=max_retries,
+              on_retry=OTLP_RETRIED.inc)
+    spans = [r for r in records
+             if r.get("kind") == "span" and r.get("trace_id") and r.get("span_id")]
+    shipped = failed = requests = 0
+    for i in range(0, len(spans), batch_size):
+        payload, n = spans_to_otlp(spans[i:i + batch_size],
+                                   service_name=service_name,
+                                   resource_attributes=resource_attributes)
+        status = post_otlp(endpoint + "/v1/traces", payload, **kw)
+        requests += 1
+        if status is not None and 200 <= status < 300:
+            shipped += n
+            OTLP_SHIPPED.inc(n, signal="traces")
+        else:
+            failed += n
+            OTLP_DROPPED.inc(n, signal="traces", reason="retries_exhausted")
+    m_payload, m_points = trail_metrics_to_otlp(
+        records, service_name=service_name, resource_attributes=resource_attributes)
+    m_shipped = m_failed = 0
+    if m_points:
+        status = post_otlp(endpoint + "/v1/metrics", m_payload, **kw)
+        requests += 1
+        if status is not None and 200 <= status < 300:
+            m_shipped = m_points
+            OTLP_SHIPPED.inc(m_points, signal="metrics")
+        else:
+            m_failed = m_points
+            OTLP_DROPPED.inc(m_points, signal="metrics", reason="retries_exhausted")
+    return {"endpoint": endpoint, "requests": requests,
+            "spans_shipped": shipped, "spans_failed": failed,
+            "metric_points_shipped": m_shipped, "metric_points_failed": m_failed}
+
+
+def otlp_counters() -> dict:
+    """Exporter self-telemetry totals — ``bench.py`` attaches this so the
+    perf trajectory records telemetry overhead."""
+    out = {}
+    for key, metric in (("shipped", OTLP_SHIPPED), ("dropped", OTLP_DROPPED),
+                        ("retried", OTLP_RETRIED)):
+        fam = metric._snapshot()
+        out[key] = round(sum(s["value"] for s in fam["samples"]), 6)
+    return out

@@ -97,6 +97,17 @@ class _Metric:
         with self._lock:
             self._children.clear()
 
+    def _snapshot(self) -> dict:
+        """Structured point-in-time view of this family (scalar children;
+        Histogram overrides).  Input shape of the OTLP exporter."""
+        with self._lock:
+            samples = [
+                {"labels": dict(zip(self.labelnames, key)), "value": float(v)}
+                for key, v in sorted(self._children.items())
+            ]
+        return {"name": self.name, "kind": self.kind, "help": self.help,
+                "labels": list(self.labelnames), "samples": samples}
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -184,6 +195,18 @@ class Histogram(_Metric):
             child = self._children.get(self._key(labels))
             return float(child["sum"]) if child else 0.0
 
+    def _snapshot(self) -> dict:
+        with self._lock:
+            samples = [
+                {"labels": dict(zip(self.labelnames, key)),
+                 "counts": list(child["counts"]),
+                 "sum": float(child["sum"]), "count": int(child["count"])}
+                for key, child in sorted(self._children.items())
+            ]
+        return {"name": self.name, "kind": self.kind, "help": self.help,
+                "labels": list(self.labelnames), "buckets": list(self.buckets),
+                "samples": samples}
+
     def _render_into(self, out: list) -> None:
         with self._lock:
             for key in sorted(self._children):
@@ -235,6 +258,15 @@ class MetricsRegistry:
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
             return self._metrics.get(name)
+
+    def snapshot(self) -> list[dict]:
+        """Structured point-in-time view of every family: name/kind/help/
+        labels plus samples (and buckets for histograms).  This is what the
+        OTLP exporter (``obs/otlp.py``) maps to ``ResourceMetrics``, and a
+        JSON-friendly debugging surface for tests and ``bench.py``."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return [m._snapshot() for m in metrics]
 
     def render(self) -> str:
         """Prometheus text format 0.0.4: HELP + TYPE per family, then the

@@ -25,6 +25,7 @@ import time
 from typing import Callable, Optional
 
 from ..comm.message import Message
+from . import registry as obsreg
 
 #: C2S observability batch (cross-silo protocol ids 0-8 are taken;
 #: collectors register this on the same comm manager as the FL protocol)
@@ -32,34 +33,55 @@ MSG_TYPE_C2S_OBS = 9
 
 MSG_ARG_KEY_OBS_BATCH = "obs_batch"
 
+OBS_SHIPPED = obsreg.REGISTRY.counter(
+    "fedml_obs_records_shipped_total",
+    "Telemetry records delivered to the server-side collector.",
+)
+OBS_DROPPED = obsreg.REGISTRY.counter(
+    "fedml_obs_records_dropped_total",
+    "Telemetry records lost after the bounded re-buffer retry.",
+)
+OBS_REBUFFERED = obsreg.REGISTRY.counter(
+    "fedml_obs_records_rebuffered_total",
+    "Telemetry records re-buffered once after a failed send.",
+)
+
 
 class RemoteObsShipper:
     """Buffer + batch telemetry records and ship them through ``send``.
 
     ``send`` is any callable taking a :class:`Message` (typically a comm
     manager's ``send_message``).  Records are flushed when ``flush_every``
-    accumulate, every ``flush_interval_s`` (daemon thread), and at
-    ``close()``.  Shipping never raises into the training path: transport
-    errors drop the batch and keep the run alive (telemetry is best-effort,
-    the reference's MQTT publisher behaves the same way).
+    accumulate, every ``flush_interval_s`` (daemon thread, joined in
+    ``close()``), and at ``close()``.  Shipping never raises into the
+    training path; a failed send re-buffers the batch ONCE (bounded by
+    ``max_rebuffer``) so a transient transport blip loses nothing, while a
+    batch that fails twice is dropped — both outcomes land in the
+    ``fedml_obs_records_*`` registry counters.
     """
 
     def __init__(self, send: Callable[[Message], None], rank: int,
                  flush_every: int = 16, flush_interval_s: float = 2.0,
-                 receiver_id: int = 0):
+                 receiver_id: int = 0, max_rebuffer: int = 256):
         self._send = send
         self.rank = rank
         self.receiver_id = receiver_id
         self.flush_every = flush_every
+        self.flush_interval_s = flush_interval_s
+        self.max_rebuffer = max_rebuffer
         self._buf: list[dict] = []
+        self._rebuffer: list[dict] = []  # one failed batch awaiting its retry
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.shipped = 0
         self.dropped = 0
+        self._thread: Optional[threading.Thread] = None
         if flush_interval_s > 0:
-            t = threading.Thread(target=self._flush_loop, args=(flush_interval_s,),
-                                 daemon=True)
-            t.start()
+            self._thread = threading.Thread(
+                target=self._flush_loop, args=(flush_interval_s,),
+                name=f"fedml-obs-ship-{rank}", daemon=True,
+            )
+            self._thread.start()
 
     # -- record kinds ---------------------------------------------------------
     def metric(self, record: dict) -> None:
@@ -91,18 +113,32 @@ class RemoteObsShipper:
     # -- shipping -------------------------------------------------------------
     def flush(self) -> int:
         with self._lock:
+            retrying, self._rebuffer = self._rebuffer, []
             batch, self._buf = self._buf, []
-        if not batch:
+        payload = retrying + batch
+        if not payload:
             return 0
         msg = Message(MSG_TYPE_C2S_OBS, self.rank, self.receiver_id)
-        msg.add_params(MSG_ARG_KEY_OBS_BATCH, json.dumps(batch))
+        msg.add_params(MSG_ARG_KEY_OBS_BATCH, json.dumps(payload))
         try:
             self._send(msg)
-            self.shipped += len(batch)
-            return len(batch)
+            self.shipped += len(payload)
+            OBS_SHIPPED.inc(len(payload))
+            return len(payload)
         except Exception:
-            # best-effort: telemetry loss must never take down training
-            self.dropped += len(batch)
+            # best-effort: telemetry loss must never take down training.
+            # Records that already failed once are dropped; fresh records get
+            # ONE bounded second chance on the next flush.
+            lost = len(retrying)
+            keep = batch[-self.max_rebuffer:] if batch else []
+            lost += len(batch) - len(keep)
+            if lost:
+                self.dropped += lost
+                OBS_DROPPED.inc(lost)
+            if keep:
+                OBS_REBUFFERED.inc(len(keep))
+                with self._lock:
+                    self._rebuffer = keep + self._rebuffer
             return 0
 
     def _flush_loop(self, interval: float) -> None:
@@ -112,6 +148,11 @@ class RemoteObsShipper:
     def close(self) -> None:
         self._stop.set()
         self.flush()
+        if self._rebuffer:
+            self.flush()  # the bounded retry of a batch that failed at close
+        if self._thread is not None:
+            self._thread.join(timeout=max(2.0, 2 * self.flush_interval_s))
+            self._thread = None
 
 
 class ObsCollector:
@@ -120,10 +161,15 @@ class ObsCollector:
     ``attach(comm_manager)`` registers the OBS handler on an existing
     manager (FL protocol and telemetry share one transport); records land in
     ``by_sender`` and, when ``jsonl_path`` is set, one JSON object per line
-    tagged with the sender rank."""
+    tagged with the sender rank.  ``otlp`` (an
+    :class:`~fedml_tpu.obs.otlp.OTLPExporter`) tees every span record of
+    every ingested batch — the server's own rank-0 records AND the
+    client-shipped ones — so rank 0 exports the WHOLE distributed round
+    tree to a standard OpenTelemetry collector."""
 
-    def __init__(self, jsonl_path: Optional[str] = None):
+    def __init__(self, jsonl_path: Optional[str] = None, otlp=None):
         self.jsonl_path = jsonl_path
+        self.otlp = otlp
         self.by_sender: dict[int, list[dict]] = {}
         self._lock = threading.Lock()
         self._fh = open(jsonl_path, "a") if jsonl_path else None
@@ -151,6 +197,11 @@ class ObsCollector:
                 for rec in batch:
                     self._fh.write(json.dumps({"sender": sender, **rec}) + "\n")
                 self._fh.flush()
+        if self.otlp is not None:
+            try:
+                self.otlp.tee(sender, batch)
+            except Exception:
+                pass  # export loss must never disturb the FL server
 
     # -- queries --------------------------------------------------------------
     def records(self, sender: Optional[int] = None, kind: Optional[str] = None) -> list[dict]:

@@ -22,8 +22,28 @@ from typing import Iterable, Optional, Sequence
 __all__ = [
     "load_jsonl", "SpanNode", "build_span_trees", "round_rows",
     "phase_percentiles", "slowest_clients", "pallas_kernel_stats",
-    "render_report",
+    "client_health_rows", "render_report",
 ]
+
+
+def _dur(rec: dict) -> float:
+    """Span duration, tolerant of records that never carried one (a crash
+    before ``end()``, a foreign trail): missing/None/non-numeric -> 0.0."""
+    try:
+        return float(rec.get("dur_s") or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _ts(rec: dict):
+    """Wall timestamp or None when absent/non-numeric — callers fall back
+    to collector ingest order (cross-host clocks skew; ingest order never
+    lies about what the collector saw first)."""
+    try:
+        ts = rec.get("ts")
+        return None if ts is None else float(ts)
+    except (TypeError, ValueError):
+        return None
 
 
 def load_jsonl(path) -> list[dict]:
@@ -48,6 +68,7 @@ def load_jsonl(path) -> list[dict]:
 class SpanNode:
     record: dict
     children: list = field(default_factory=list)
+    ingest: int = 0  # position in the collector trail (skew-proof ordering)
 
     @property
     def name(self) -> str:
@@ -59,35 +80,46 @@ class SpanNode:
 
     @property
     def dur_s(self) -> float:
-        return float(self.record.get("dur_s", 0.0) or 0.0)
+        return _dur(self.record)
 
 
 def _spans(records: Iterable[dict]) -> list[dict]:
     return [r for r in records if r.get("kind") == "span" and r.get("trace_id")]
 
 
+def _node_order(node: SpanNode) -> tuple:
+    """Start-timestamp order with ingest-order fallback: a record without a
+    usable ``ts`` (or from a skew-drifted host) sorts by when the collector
+    saw it instead of raising or landing arbitrarily."""
+    ts = _ts(node.record)
+    return (0, ts, node.ingest) if ts is not None else (1, node.ingest, 0)
+
+
 def build_span_trees(records: Iterable[dict]) -> dict[str, list[SpanNode]]:
     """trace_id -> root SpanNodes (children attached by parent_id, ordered by
-    start timestamp).  Spans whose parent never arrived (a client's collector
-    batch lost in transit) surface as extra roots instead of disappearing."""
+    start timestamp with collector-ingest-order fallback).  Spans whose
+    parent never arrived (a client's collector batch lost in transit)
+    surface as extra roots instead of disappearing."""
+    records = list(records)
     nodes: dict[str, SpanNode] = {}
-    spans = _spans(records)
-    for rec in spans:
+    spans = [(i, r) for i, r in enumerate(records)
+             if r.get("kind") == "span" and r.get("trace_id")]
+    for i, rec in spans:
         sid = rec.get("span_id")
         if sid:
-            nodes[sid] = SpanNode(rec)
+            nodes[sid] = SpanNode(rec, ingest=i)
     trees: dict[str, list[SpanNode]] = {}
-    for rec in spans:
-        node = nodes.get(rec.get("span_id")) or SpanNode(rec)
+    for i, rec in spans:
+        node = nodes.get(rec.get("span_id")) or SpanNode(rec, ingest=i)
         parent = nodes.get(rec.get("parent_id") or "")
         if parent is not None and parent is not node:
             parent.children.append(node)
         else:
             trees.setdefault(str(rec["trace_id"]), []).append(node)
     for node in nodes.values():
-        node.children.sort(key=lambda n: n.record.get("ts", 0.0))
+        node.children.sort(key=_node_order)
     for roots in trees.values():
-        roots.sort(key=lambda n: n.record.get("ts", 0.0))
+        roots.sort(key=_node_order)
     return trees
 
 
@@ -98,30 +130,31 @@ def round_rows(records: Iterable[dict]) -> list[dict]:
     durations, the client train spans ({sender, client_idx, dur_s}), and the
     server-measured per-client round trips."""
     records = list(records)
-    spans = _spans(records)
     by_trace: dict[str, dict] = {}
-    for rec in spans:
+    for ingest, rec in enumerate(records):
+        if rec.get("kind") != "span" or not rec.get("trace_id"):
+            continue
         row = by_trace.setdefault(str(rec["trace_id"]), {
             "trace_id": str(rec["trace_id"]), "round_idx": None,
             "round_dur_s": None, "aggregate_dur_s": None, "eval_dur_s": None,
-            "train": [], "round_trips": {},
+            "train": [], "round_trips": {}, "_ingest": ingest,
         })
         name = rec.get("name")
         if name == "round":
             row["round_idx"] = rec.get("round_idx")
-            row["round_dur_s"] = float(rec.get("dur_s", 0.0) or 0.0)
+            row["round_dur_s"] = _dur(rec)
             row["ts"] = rec.get("ts", 0.0)
         elif name == "aggregate":
-            row["aggregate_dur_s"] = float(rec.get("dur_s", 0.0) or 0.0)
+            row["aggregate_dur_s"] = _dur(rec)
             if row["round_idx"] is None:
                 row["round_idx"] = rec.get("round_idx")
         elif name == "eval":
-            row["eval_dur_s"] = float(rec.get("dur_s", 0.0) or 0.0)
+            row["eval_dur_s"] = _dur(rec)
         elif name == "train":
             row["train"].append({
                 "sender": rec.get("sender"),
                 "client_idx": rec.get("client_idx"),
-                "dur_s": float(rec.get("dur_s", 0.0) or 0.0),
+                "dur_s": _dur(rec),
             })
             if row["round_idx"] is None:
                 row["round_idx"] = rec.get("round_idx")
@@ -129,9 +162,24 @@ def round_rows(records: Iterable[dict]) -> list[dict]:
         if rec.get("kind") == "metric" and rec.get("metric") == "client_round_trip_s":
             trace_id = str(rec.get("trace_id", ""))
             if trace_id in by_trace:
-                by_trace[trace_id]["round_trips"][str(rec.get("client"))] = float(rec.get("value", 0.0))
+                try:
+                    by_trace[trace_id]["round_trips"][str(rec.get("client"))] = \
+                        float(rec.get("value", 0.0))
+                except (TypeError, ValueError):
+                    pass
     rows = [row for row in by_trace.values() if row["round_idx"] is not None]
-    rows.sort(key=lambda r: (r["round_idx"], r.get("ts", 0.0)))
+
+    def row_key(row):
+        # numeric round index first; non-numeric indexes (foreign trails)
+        # fall back to collector ingest order.  The tiebreak within a round
+        # index is ALSO ingest order, not wall clocks: cross-host clock skew
+        # must not reshuffle the timeline.
+        try:
+            return (0, float(row["round_idx"]), row["_ingest"])
+        except (TypeError, ValueError):
+            return (1, float(row["_ingest"]), 0)
+
+    rows.sort(key=row_key)
     return rows
 
 
@@ -153,7 +201,7 @@ def phase_percentiles(records: Iterable[dict]) -> dict[str, dict]:
     """phase name -> {n, p50_s, p95_s, max_s} over every span of that name."""
     durs: dict[str, list[float]] = {}
     for rec in _spans(records):
-        durs.setdefault(str(rec.get("name")), []).append(float(rec.get("dur_s", 0.0) or 0.0))
+        durs.setdefault(str(rec.get("name")), []).append(_dur(rec))
     out = {}
     for name, values in sorted(durs.items()):
         values.sort()
@@ -176,10 +224,13 @@ def slowest_clients(records: Iterable[dict]) -> list[dict]:
     for rec in _spans(records):
         if rec.get("name") == "train":
             key = str(rec.get("sender", rec.get("client_idx")))
-            per_client.setdefault(key, []).append(float(rec.get("dur_s", 0.0) or 0.0))
+            per_client.setdefault(key, []).append(_dur(rec))
     for rec in records:
         if rec.get("kind") == "metric" and rec.get("metric") == "client_round_trip_s":
-            rtts.setdefault(str(rec.get("client")), []).append(float(rec.get("value", 0.0)))
+            try:
+                rtts.setdefault(str(rec.get("client")), []).append(float(rec.get("value", 0.0)))
+            except (TypeError, ValueError):
+                pass
     out = []
     for client, durations in per_client.items():
         row = {
@@ -214,6 +265,31 @@ def pallas_kernel_stats(records: Iterable[dict]) -> list[dict]:
             "max_s": max(values),
         })
     out.sort(key=lambda r: -r["total_s"])
+    return out
+
+
+def client_health_rows(records: Iterable[dict]) -> list[dict]:
+    """Latest ``client_health`` ledger record per client (the cross-silo
+    server persists one per client per round), worst score first — the
+    health counterpart of the straggler table."""
+    latest: dict[str, dict] = {}
+    for rec in records:
+        if rec.get("kind") == "metric" and rec.get("metric") == "client_health":
+            latest[str(rec.get("client"))] = rec
+    out = []
+    for client, rec in latest.items():
+        try:
+            score = float(rec.get("score", 1.0))
+        except (TypeError, ValueError):
+            score = 1.0
+        out.append({
+            "client": client,
+            "score": score,
+            "ewma_rtt_s": rec.get("ewma_rtt_s"),
+            "breaches": rec.get("breaches", 0.0),
+            "comm_failures": rec.get("comm_failures", 0.0),
+        })
+    out.sort(key=lambda r: r["score"])
     return out
 
 
@@ -271,6 +347,16 @@ def render_report(records: Iterable[dict]) -> str:
           f"{r['mean_round_trip_s']:.4f}" if "mean_round_trip_s" in r else "-"]
          for r in stragglers],
     ))
+
+    health = client_health_rows(records)
+    if health:
+        sections.append("== client health ==\n" + _table(
+            ["client", "score", "ewma_rtt_s", "breaches", "comm_failures"],
+            [[r["client"], f"{r['score']:.4f}",
+              _s(r["ewma_rtt_s"] if isinstance(r["ewma_rtt_s"], (int, float)) else None),
+              _s(float(r["breaches"] or 0.0)), _s(float(r["comm_failures"] or 0.0))]
+             for r in health],
+        ))
 
     kernels = pallas_kernel_stats(records)
     if kernels:

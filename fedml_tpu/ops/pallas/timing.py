@@ -1,9 +1,12 @@
 """Per-kernel wall-time observation for Pallas entry points.
 
 Every hand-written kernel records its eager invocations into the
-process-global ``pallas_kernel_seconds`` histogram (ROADMAP "Pallas-level
-timing hooks"), labeled by kernel name — scrapable via ``/metrics`` and
-summarized by ``fedml-tpu obs report`` / ``bench.py``.
+process-global ``fedml_pallas_kernel_seconds`` histogram (ROADMAP
+"Pallas-level timing hooks"), labeled by kernel name — scrapable via
+``/metrics`` and summarized by ``fedml-tpu obs report`` / ``bench.py``.
+(The record name shipped over the obs trail stays ``pallas_kernel_seconds``
+— a wire/trail format; the registry family carries the ``fedml_`` namespace
+the metric-name lint enforces.)
 
 Only *eager* calls are observed: inside ``jit``/``vmap``/``scan`` the
 arguments are tracers and host wall-clock around the call would measure
@@ -22,7 +25,7 @@ import jax
 from ...obs import registry as obsreg
 
 PALLAS_KERNEL_TIME = obsreg.REGISTRY.histogram(
-    "pallas_kernel_seconds",
+    "fedml_pallas_kernel_seconds",
     "Wall time of eagerly-invoked Pallas kernels (dispatch to ready), "
     "labeled by kernel.",
     labels=("kernel",),

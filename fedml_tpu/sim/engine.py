@@ -44,7 +44,7 @@ from ..core import pytree as pt, rng
 from ..data.dataset import FederatedDataset, StackedClientData, pad_eval_set, stack_clients
 from ..fl.local_sgd import make_eval_fn
 from ..parallel import mesh as meshlib
-from ..obs import registry as obsreg
+from ..obs import otlp as obsotlp, registry as obsreg
 from ..obs.metrics import MetricsLogger
 from ..obs.trace import traced
 
@@ -163,6 +163,13 @@ class MeshSimulator(RoundCheckpointMixin):
         self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_test))
         self._eval_bs = eval_bs  # the padding multiple of self._test
         self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
+
+        # OTLP egress (gated on extra.otlp_endpoint; None -> spans keep
+        # their no-sink default and no exporter thread exists): the
+        # simulator's chunk/eval spans flow to the same collector the
+        # cross-silo server exports to
+        self._otlp = obsotlp.exporter_from_config(cfg)
+        self._otlp_sink = self._otlp.enqueue_span if self._otlp is not None else None
 
         self.root_key = k0
         self.round_idx = 0
@@ -367,7 +374,7 @@ class MeshSimulator(RoundCheckpointMixin):
         if example_args is not None:
             t0 = time.perf_counter()
             try:
-                with traced("sim.chunk_compile", rounds=n):
+                with traced("sim.chunk_compile", rounds=n, sink=self._otlp_sink):
                     fn = jitted.lower(*example_args).compile()
             except Exception:
                 # AOT unsupported for these inputs — the lazy jit still works
@@ -402,7 +409,8 @@ class MeshSimulator(RoundCheckpointMixin):
         fn = self._get_multi_round_fn(n, example_args=args)
         t0 = time.perf_counter()
         try:
-            with traced("sim.chunk", rounds=n, start_round=self.round_idx):
+            with traced("sim.chunk", rounds=n, start_round=self.round_idx,
+                        sink=self._otlp_sink):
                 gv, ss, cs, nd, stacked = fn(*args)
                 host = jax.device_get(stacked)  # the single host sync for the chunk
         except Exception as e:
@@ -480,7 +488,7 @@ class MeshSimulator(RoundCheckpointMixin):
     # ------------------------------------------------------------------
     def evaluate(self) -> dict:
         t0 = time.perf_counter()
-        with traced("sim.eval", round_idx=self.round_idx):
+        with traced("sim.eval", round_idx=self.round_idx, sink=self._otlp_sink):
             res = self._eval_fn(self.global_vars, *self._test)
             out = {k: float(v) for k, v in res.items()}  # float() syncs
         EVAL_TIME.observe(time.perf_counter() - t0)
@@ -578,6 +586,12 @@ class MeshSimulator(RoundCheckpointMixin):
             scores = self.assess_contribution()
             if scores is not None:
                 self.logger.log({f"contribution_c{i}": float(s) for i, s in enumerate(scores)})
+        if self._otlp is not None:
+            # end-of-fit egress: drain queued spans and ship the registry
+            # snapshot; flush (not close) so a caller running fit again on
+            # the same simulator keeps exporting
+            self._otlp.export_metrics_now()
+            self._otlp.flush(timeout=5.0)
         return history
 
     def _snapshot_pre_round(self, r: int) -> dict:

@@ -1,0 +1,72 @@
+"""Global-registry metric lint (ISSUE 3 satellite).
+
+Every family registered in the process-global registry by any instrumented
+layer must carry the ``fedml_`` namespace (``fedml_[a-z0-9_]+``) with valid
+label names, and a name can never be re-registered with a conflicting
+type/label set — the registry enforces it, this test proves it stays
+enforced.  Runs against the real global registry after importing every
+module that registers metrics, so a new metric with a bad name fails CI
+here, not in someone's Grafana.
+"""
+
+import importlib
+import re
+
+import pytest
+
+#: every module that registers families in the global registry — extend this
+#: list when instrumenting a new layer
+INSTRUMENTED_MODULES = [
+    "fedml_tpu.comm.base",
+    "fedml_tpu.cross_silo.server",
+    "fedml_tpu.obs.health",
+    "fedml_tpu.obs.otlp",
+    "fedml_tpu.obs.remote",
+    "fedml_tpu.ops.pallas.timing",
+    "fedml_tpu.sim.engine",
+]
+
+_NAME = re.compile(r"fedml_[a-z0-9_]+")
+_LABEL = re.compile(r"[a-z][a-z0-9_]*")
+
+
+def test_global_registry_names_are_namespaced_and_unique():
+    for mod in INSTRUMENTED_MODULES:
+        importlib.import_module(mod)
+    from fedml_tpu.obs.registry import REGISTRY
+
+    families = REGISTRY.snapshot()
+    assert families, "instrumented modules registered nothing?"
+    names = [fam["name"] for fam in families]
+    for fam in families:
+        assert _NAME.fullmatch(fam["name"]), (
+            f"metric {fam['name']!r} violates the fedml_[a-z0-9_]+ namespace")
+        assert fam["kind"] in ("counter", "gauge", "histogram"), fam
+        for label in fam["labels"]:
+            assert _LABEL.fullmatch(label), (fam["name"], label)
+            assert label != "le", f"{fam['name']}: 'le' is reserved for histograms"
+    # one family per name — the registry's dict keying guarantees it; keep
+    # the invariant asserted so a refactor can't silently lose it
+    assert len(names) == len(set(names))
+
+
+def test_conflicting_reregistration_is_refused():
+    """No metric can be registered twice with a conflicting type or label
+    set — same-spec re-registration returns the SAME family object."""
+    for mod in INSTRUMENTED_MODULES:
+        importlib.import_module(mod)
+    from fedml_tpu.obs.registry import REGISTRY
+
+    cls_for = {"counter": REGISTRY.counter, "gauge": REGISTRY.gauge,
+               "histogram": REGISTRY.histogram}
+    for fam in REGISTRY.snapshot():
+        # same spec -> same object
+        metric = REGISTRY.get(fam["name"])
+        assert cls_for[fam["kind"]](fam["name"], labels=tuple(fam["labels"])) is metric
+        # conflicting labels -> loud failure
+        with pytest.raises(ValueError):
+            cls_for[fam["kind"]](fam["name"], labels=tuple(fam["labels"]) + ("rogue",))
+        # conflicting type -> loud failure
+        other = REGISTRY.gauge if fam["kind"] != "gauge" else REGISTRY.counter
+        with pytest.raises(ValueError):
+            other(fam["name"], labels=tuple(fam["labels"]))

@@ -13,10 +13,13 @@ from .conftest import tiny_config
 
 def test_shipper_batches_and_collector_aggregates(tmp_path):
     """Unit: shipper flush semantics + collector aggregation/persistence,
-    with a lossy transport that must never raise into the caller."""
+    with a lossy transport that must never raise into the caller.  A batch
+    that fails transiently is re-buffered ONCE and rides the next flush —
+    nothing is lost to a single transport blip."""
     from fedml_tpu.comm.message import Message
     from fedml_tpu.obs.remote import (
-        MSG_TYPE_C2S_OBS, ObsCollector, RemoteObsShipper,
+        MSG_TYPE_C2S_OBS, OBS_REBUFFERED, OBS_SHIPPED,
+        ObsCollector, RemoteObsShipper,
     )
 
     collector = ObsCollector(str(tmp_path / "obs.jsonl"))
@@ -25,28 +28,57 @@ def test_shipper_batches_and_collector_aggregates(tmp_path):
     def send(msg):
         if len(sent) == 0 and msg.get_sender_id() == 7:
             sent.append("dropped")
-            raise OSError("transport down")  # first batch from rank 7 lost
+            raise OSError("transport down")  # first batch from rank 7 fails
         sent.append(msg)
         collector.handle(msg)
 
+    shipped0 = OBS_SHIPPED.value()
+    rebuffered0 = OBS_REBUFFERED.value()
     sh = RemoteObsShipper(send, rank=7, flush_every=3, flush_interval_s=0)
     sh.metric({"train_loss": 1.5, "round": 0})
     sh.event("train", "started", round_idx=0)
     assert sh.shipped == 0  # below flush_every
-    sh.metric({"train_loss": 1.2, "round": 1})  # hits 3 -> flush -> DROPPED
-    assert sh.dropped == 3 and sh.shipped == 0
+    sh.metric({"train_loss": 1.2, "round": 1})  # hits 3 -> flush -> FAILS
+    # re-buffered once, not silently dropped
+    assert sh.dropped == 0 and sh.shipped == 0
+    assert OBS_REBUFFERED.value() - rebuffered0 == 3
     sh.log_lines(["line a", "line b"])
     sh.event("train", "ended", round_idx=1)
-    sh.close()  # flush remaining 2
-    assert sh.shipped == 2
+    sh.close()  # flush ships the re-buffered 3 + the remaining 2
+    assert sh.shipped == 5 and sh.dropped == 0
+    assert OBS_SHIPPED.value() - shipped0 == 5
+    assert sh._thread is None  # no interval thread was started (interval 0)
 
     recs = collector.records(sender=7)
-    assert len(recs) == 2
+    assert len(recs) == 5
     assert collector.records(sender=7, kind="log")[0]["lines"] == ["line a", "line b"]
-    assert collector.counts() == {7: 2}
+    assert collector.counts() == {7: 5}
     collector.close()
     lines = [json.loads(l) for l in (tmp_path / "obs.jsonl").read_text().splitlines()]
-    assert all(l["sender"] == 7 for l in lines) and len(lines) == 2
+    assert all(l["sender"] == 7 for l in lines) and len(lines) == 5
+
+
+def test_shipper_drops_twice_failed_batch_and_joins_thread(tmp_path):
+    """A batch that fails its re-buffered retry too is dropped (bounded —
+    no unbounded growth against a dead transport), counted in the registry;
+    close() joins the interval flush thread."""
+    from fedml_tpu.obs.remote import OBS_DROPPED, RemoteObsShipper
+
+    def send_always_down(msg):
+        raise OSError("transport down")
+
+    dropped0 = OBS_DROPPED.value()
+    sh = RemoteObsShipper(send_always_down, rank=3, flush_every=2,
+                          flush_interval_s=0.05)
+    thread = sh._thread
+    assert thread is not None and thread.is_alive()
+    sh.metric({"a": 1})
+    sh.metric({"a": 2})  # flush -> fail -> re-buffer
+    sh.flush()           # retry -> fail again -> drop
+    assert sh.dropped == 2
+    assert OBS_DROPPED.value() - dropped0 == 2
+    sh.close()
+    assert sh._thread is None and not thread.is_alive()
 
 
 def test_secagg_clients_ship_train_telemetry(eight_devices):

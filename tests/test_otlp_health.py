@@ -1,0 +1,677 @@
+"""OTLP export sink + per-client health ledger (ISSUE 3).
+
+- golden payload-shape tests against a fake stdlib OTLP/HTTP collector:
+  span records -> ResourceSpans (32/16-hex ids, unix-nano clocks, typed
+  attributes) and registry snapshots -> ResourceMetrics (monotonic sums,
+  gauges, histograms with explicit bounds);
+- exponential-backoff retry on 429/503 with registry-visible
+  shipped/dropped/retried accounting, bounded-loss behavior against a dead
+  collector, and the no-endpoint-no-thread gate;
+- the acceptance run: an INPROC cross-silo round exports its COMPLETE
+  distributed span tree (server round/aggregate spans + both clients'
+  train spans under one trace_id per round) plus a registry snapshot;
+- `fedml-tpu obs export` backfills a recorded JSONL trail;
+- the health ledger: EWMA/recovery scoring, deadline breaches recorded on
+  straggler timeouts, and health-aware selection deprioritizing a degraded
+  rank end-to-end.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from .conftest import tiny_config
+
+
+# ---------------------------------------------------------------------------
+# fake OTLP/HTTP collector (stdlib http.server)
+
+
+class FakeOTLPCollector:
+    """Records POSTed JSON bodies per path; optionally fails the first N
+    requests with a configurable status (the 429/5xx retry path)."""
+
+    def __init__(self, fail_first: int = 0, fail_status: int = 503):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.requests: list[tuple[str, dict]] = []
+        self.fail_remaining = fail_first
+        self.fail_status = fail_status
+        self.lock = threading.Lock()
+        collector = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                with collector.lock:
+                    if collector.fail_remaining > 0:
+                        collector.fail_remaining -= 1
+                        status = collector.fail_status
+                    else:
+                        collector.requests.append((self.path, json.loads(body)))
+                        status = 200
+                out = b"{}"
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def log_message(self, *args):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def spans(self) -> list[dict]:
+        out = []
+        with self.lock:
+            for path, payload in self.requests:
+                if path != "/v1/traces":
+                    continue
+                for rs in payload.get("resourceSpans", []):
+                    for ss in rs.get("scopeSpans", []):
+                        out.extend(ss.get("spans", []))
+        return out
+
+    def metrics(self) -> dict:
+        names = {}
+        with self.lock:
+            for path, payload in self.requests:
+                if path != "/v1/metrics":
+                    continue
+                for rm in payload.get("resourceMetrics", []):
+                    for sm in rm.get("scopeMetrics", []):
+                        for m in sm.get("metrics", []):
+                            names[m["name"]] = m
+        return names
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def fake_collector():
+    c = FakeOTLPCollector()
+    yield c
+    c.close()
+
+
+def _attr_map(attrs):
+    return {a["key"]: a["value"] for a in attrs}
+
+
+_HEX = set("0123456789abcdef")
+
+
+# ---------------------------------------------------------------------------
+# golden payload shapes
+
+
+def test_trace_payload_golden_shape(fake_collector):
+    """A native Span round trip: 32/16-hex zero-padded ids, unix-nano
+    string clocks, typed attributes, parent linkage."""
+    from fedml_tpu.obs import trace
+    from fedml_tpu.obs.otlp import OTLPExporter
+
+    with trace.traced("round", round_idx=7, clients=2) as round_span:
+        with trace.traced("train", client_idx=1, rank=1) as train_span:
+            time.sleep(0.002)
+
+    exp = OTLPExporter(fake_collector.endpoint, flush_interval_s=0.05)
+    exp.enqueue_span({"sender": 0, **round_span.to_record()})
+    exp.enqueue_span({"sender": 1, **train_span.to_record()})
+    assert exp.flush(timeout=10.0)
+
+    spans = fake_collector.spans()
+    assert {s["name"] for s in spans} == {"round", "train"}
+    by_name = {s["name"]: s for s in spans}
+    root, child = by_name["round"], by_name["train"]
+    for s in spans:
+        assert len(s["traceId"]) == 32 and set(s["traceId"]) <= _HEX
+        assert len(s["spanId"]) == 16 and set(s["spanId"]) <= _HEX
+        assert s["kind"] == 1
+        # proto3-JSON encodes uint64 nanos as strings
+        assert isinstance(s["startTimeUnixNano"], str)
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"]) > 1e18
+    # native 16-hex ids are zero-padded into the trace id width
+    assert root["traceId"].endswith(round_span.trace_id)
+    assert root["traceId"].startswith("0" * 16)
+    assert child["traceId"] == root["traceId"]
+    assert child["parentSpanId"] == root["spanId"]
+    assert "parentSpanId" not in root
+    attrs = _attr_map(root["attributes"])
+    assert attrs["round_idx"] == {"intValue": "7"}
+    assert attrs["clients"] == {"intValue": "2"}
+    assert attrs["sender"] == {"intValue": "0"}
+    assert int(child["endTimeUnixNano"]) - int(child["startTimeUnixNano"]) >= 2e6
+
+    exp.close()
+    # close ships a final registry snapshot to /v1/metrics
+    assert fake_collector.metrics()
+
+
+def test_metrics_payload_golden_shape(fake_collector):
+    """Registry snapshot mapping: Counter -> monotonic cumulative sum,
+    Gauge -> gauge, Histogram -> histogram with explicit bounds where the
+    +Inf bucket becomes the overflow count."""
+    from fedml_tpu.obs.otlp import OTLPExporter
+    from fedml_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("fedml_demo_requests_total", "requests", labels=("code",))
+    c.inc(3, code="200")
+    g = reg.gauge("fedml_demo_temp", "temperature")
+    g.set(-3.5)
+    h = reg.histogram("fedml_demo_latency_seconds", "latency",
+                      buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+
+    exp = OTLPExporter(fake_collector.endpoint, registry=reg,
+                       flush_interval_s=0.05)
+    assert exp.export_metrics_now()
+    exp.close()
+
+    metrics = fake_collector.metrics()
+    ctr = metrics["fedml_demo_requests_total"]["sum"]
+    assert ctr["isMonotonic"] is True and ctr["aggregationTemporality"] == 2
+    dp = ctr["dataPoints"][0]
+    assert dp["asDouble"] == 3.0
+    assert _attr_map(dp["attributes"]) == {"code": {"stringValue": "200"}}
+
+    gauge_dp = metrics["fedml_demo_temp"]["gauge"]["dataPoints"][0]
+    assert gauge_dp["asDouble"] == -3.5
+
+    hist = metrics["fedml_demo_latency_seconds"]["histogram"]
+    assert hist["aggregationTemporality"] == 2
+    hdp = hist["dataPoints"][0]
+    assert hdp["explicitBounds"] == [0.01, 0.1, 1.0]
+    assert hdp["bucketCounts"] == ["1", "2", "1", "1"]  # len(bounds) + 1
+    assert hdp["count"] == "5"
+    assert abs(hdp["sum"] - 5.605) < 1e-9
+
+
+def test_foreign_ids_hash_deterministically():
+    """Hand-written trail ids (non-hex) still produce consistent 32/16-hex
+    ids, preserving parent/child linkage after conversion."""
+    from fedml_tpu.obs.otlp import span_record_to_otlp
+
+    parent = span_record_to_otlp({"kind": "span", "name": "round", "trace_id": "t0",
+                                  "span_id": "r0", "ts": 100.0, "dur_s": 2.0})
+    child = span_record_to_otlp({"kind": "span", "name": "train", "trace_id": "t0",
+                                 "span_id": "c10", "parent_id": "r0",
+                                 "ts": 100.1, "dur_s": 0.5})
+    assert child["traceId"] == parent["traceId"] and len(parent["traceId"]) == 32
+    assert child["parentSpanId"] == parent["spanId"] and len(parent["spanId"]) == 16
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff / bounded loss
+
+
+def test_retry_backoff_on_503_then_delivers():
+    from fedml_tpu.obs.otlp import OTLP_RETRIED, OTLP_SHIPPED, OTLPExporter
+
+    collector = FakeOTLPCollector(fail_first=2, fail_status=503)
+    try:
+        retried0 = OTLP_RETRIED.value()
+        shipped0 = OTLP_SHIPPED.value(signal="traces")
+        exp = OTLPExporter(collector.endpoint, flush_interval_s=0.05,
+                           backoff_base_s=0.02, max_retries=4)
+        exp.enqueue_span({"kind": "span", "name": "round", "trace_id": "ab" * 8,
+                          "span_id": "cd" * 8, "ts": time.time(), "dur_s": 0.1})
+        assert exp.flush(timeout=15.0)
+        assert OTLP_RETRIED.value() - retried0 >= 2
+        assert OTLP_SHIPPED.value(signal="traces") - shipped0 == 1
+        assert len(collector.spans()) == 1
+        exp.close()
+    finally:
+        collector.close()
+
+
+def test_429_is_retryable_and_4xx_drops():
+    from fedml_tpu.obs.otlp import OTLP_DROPPED, OTLPExporter, post_otlp
+
+    # 429 -> retried until the 200 behind it
+    collector = FakeOTLPCollector(fail_first=1, fail_status=429)
+    try:
+        status = post_otlp(collector.endpoint + "/v1/traces", {"resourceSpans": []},
+                           max_retries=3, backoff_base_s=0.02)
+        assert status == 200
+    finally:
+        collector.close()
+
+    # 400 -> non-retryable: dropped immediately with reason=rejected
+    collector = FakeOTLPCollector(fail_first=10**6, fail_status=400)
+    try:
+        dropped0 = OTLP_DROPPED.value(signal="traces", reason="rejected")
+        exp = OTLPExporter(collector.endpoint, flush_interval_s=0.05,
+                           backoff_base_s=0.02, max_retries=3)
+        exp.enqueue_span({"kind": "span", "name": "x", "trace_id": "ab" * 8,
+                          "span_id": "cd" * 8, "ts": time.time(), "dur_s": 0.0})
+        assert exp.flush(timeout=10.0)
+        assert OTLP_DROPPED.value(signal="traces", reason="rejected") - dropped0 == 1
+        exp.close()
+    finally:
+        collector.close()
+
+
+def test_dead_collector_bounded_loss_accounting():
+    """Against an unreachable endpoint every span is eventually dropped —
+    and every drop is accounted for (queue_full + retries_exhausted sum to
+    exactly what was enqueued).  Telemetry loss is observable, never
+    silent."""
+    from fedml_tpu.obs.otlp import OTLP_DROPPED, OTLP_SHIPPED, OTLPExporter
+
+    def dropped_total():
+        fam = OTLP_DROPPED._snapshot()
+        return sum(s["value"] for s in fam["samples"]
+                   if s["labels"]["signal"] == "traces")
+
+    d0 = dropped_total()
+    s0 = OTLP_SHIPPED.value(signal="traces")
+    exp = OTLPExporter("http://127.0.0.1:9", queue_size=8, batch_size=4,
+                       flush_interval_s=0.02, max_retries=1,
+                       backoff_base_s=0.01, timeout_s=0.2)
+    n = 50
+    for i in range(n):
+        exp.enqueue_span({"kind": "span", "name": f"s{i}", "trace_id": "ab" * 8,
+                          "span_id": f"{i:016d}"[-16:], "ts": time.time(),
+                          "dur_s": 0.0})
+    exp.flush(timeout=20.0)
+    exp.close(timeout=20.0)
+    assert OTLP_SHIPPED.value(signal="traces") == s0
+    assert dropped_total() - d0 == n
+
+
+def test_no_endpoint_means_no_exporter_and_no_thread():
+    from fedml_tpu.obs.otlp import exporter_from_config
+
+    before = [t.name for t in threading.enumerate()
+              if t.name == "fedml-otlp-export"]
+    cfg = tiny_config()
+    assert exporter_from_config(cfg) is None
+    cfg.extra = {"metrics_port": None}
+    assert exporter_from_config(cfg) is None
+    after = [t.name for t in threading.enumerate()
+             if t.name == "fedml-otlp-export"]
+    assert before == after
+
+
+# ---------------------------------------------------------------------------
+# acceptance: cross-silo INPROC run exports the whole round tree
+
+
+def test_cross_silo_exports_complete_round_tree(fake_collector, eight_devices):
+    """With extra.otlp_endpoint set, rank 0 exports the WHOLE distributed
+    round tree — its own round/aggregate/eval spans AND both clients' train
+    spans sharing one trace_id per round — plus a final registry snapshot,
+    all as OTLP/HTTP JSON, stdlib only."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=2, client_num_per_round=2,
+        comm_round=2, learning_rate=0.3, frequency_of_the_test=1, run_id="otlp-e2e",
+    )
+    cfg.extra = {"enable_remote_obs": True, "otlp_endpoint": fake_collector.endpoint}
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("otlp-e2e")
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC") for r in (1, 2)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    assert server.otlp is not None
+    try:
+        history = server.run_until_done(timeout=120.0)
+    finally:
+        for c in clients:
+            c.finish()
+    assert len(history) == 2
+
+    spans = fake_collector.spans()
+    by_name: dict[str, list] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert len(by_name["round"]) == 2
+    assert len(by_name["aggregate"]) == 2
+    assert len(by_name["train"]) == 4  # 2 clients x 2 rounds
+
+    for round_span in by_name["round"]:
+        tid = round_span["traceId"]
+        assert len(tid) == 32 and set(tid) <= _HEX
+        members = [s for s in spans if s["traceId"] == tid and s is not round_span]
+        names = [s["name"] for s in members]
+        assert names.count("train") == 2 and "aggregate" in names
+        # the train spans (client-side halves of the tree) parent to the
+        # server's round span — the stamp each broadcast carried
+        for s in members:
+            if s["name"] == "train":
+                assert s["parentSpanId"] == round_span["spanId"]
+                assert _attr_map(s["attributes"])["sender"]["intValue"] in ("1", "2")
+
+    # the final registry snapshot arrived as ResourceMetrics
+    metrics = fake_collector.metrics()
+    assert all(name.startswith("fedml_") for name in metrics)
+    assert "fedml_crosssilo_client_round_trip_seconds" in metrics
+    hist = metrics["fedml_crosssilo_client_round_trip_seconds"]["histogram"]
+    assert hist["dataPoints"] and hist["aggregationTemporality"] == 2
+    assert "fedml_client_health_score" in metrics
+    assert "fedml_otlp_shipped_total" in metrics  # the exporter observes itself
+
+
+def test_cross_silo_without_endpoint_is_unchanged(eight_devices):
+    """extra.otlp_endpoint unset -> no exporter object, no worker thread,
+    and the default remote-obs path behaves exactly as before."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=2, client_num_per_round=2,
+        comm_round=2, learning_rate=0.3, frequency_of_the_test=0, run_id="otlp-off",
+    )
+    cfg.extra = {"enable_remote_obs": True}
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("otlp-off")
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC") for r in (1, 2)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    assert server.otlp is None
+    assert server.obs_collector is not None and server.obs_collector.otlp is None
+    try:
+        history = server.run_until_done(timeout=120.0)
+    finally:
+        for c in clients:
+            c.finish()
+    assert len(history) == 2
+    assert not [t for t in threading.enumerate() if t.name == "fedml-otlp-export"]
+
+
+# ---------------------------------------------------------------------------
+# obs export backfill
+
+
+def test_obs_export_backfills_trail(tmp_path, fake_collector):
+    from fedml_tpu.cli import main as cli_main
+
+    trail = tmp_path / "obs.jsonl"
+    records = [
+        {"sender": 0, "kind": "span", "name": "round", "trace_id": "t0",
+         "span_id": "r0", "ts": 100.0, "dur_s": 2.0, "round_idx": 0},
+        {"sender": 1, "kind": "span", "name": "train", "trace_id": "t0",
+         "span_id": "c10", "parent_id": "r0", "ts": 100.1, "dur_s": 0.5,
+         "round_idx": 0, "client_idx": 0},
+        {"sender": 0, "kind": "metric", "metric": "client_round_trip_s",
+         "client": 1, "value": 0.6, "round_idx": 0, "trace_id": "t0", "ts": 102.0},
+        {"sender": 1, "kind": "log", "lines": ["not a span"]},
+    ]
+    trail.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+
+    rc = cli_main(["obs", "export", str(trail),
+                   "--endpoint", fake_collector.endpoint])
+    assert rc == 0
+    spans = fake_collector.spans()
+    assert {s["name"] for s in spans} == {"round", "train"}
+    train = next(s for s in spans if s["name"] == "train")
+    root = next(s for s in spans if s["name"] == "round")
+    assert train["parentSpanId"] == root["spanId"]
+    metrics = fake_collector.metrics()
+    dp = metrics["client_round_trip_s"]["gauge"]["dataPoints"][0]
+    assert dp["asDouble"] == 0.6
+    assert _attr_map(dp["attributes"])["client"] == {"intValue": "1"}
+
+
+# ---------------------------------------------------------------------------
+# health ledger
+
+
+def test_health_ledger_scoring_and_recovery():
+    from fedml_tpu.obs.health import ClientHealthLedger
+
+    ledger = ClientHealthLedger(ewma_alpha=0.5, recovery=0.5)
+    assert ledger.score(1) == 1.0  # unknown = healthy
+
+    ledger.observe_rtt(1, 1.0)
+    assert ledger.summary()[1]["ewma_rtt_s"] == 1.0
+    ledger.observe_rtt(1, 2.0)
+    assert abs(ledger.summary()[1]["ewma_rtt_s"] - 1.5) < 1e-9  # EWMA, not mean
+
+    # breaches degrade the score multiplicatively...
+    for _ in range(4):
+        ledger.record_deadline_breach(2)
+    assert ledger.score(2) == pytest.approx(1.0 / 3.0)  # 1/(1+0.5*4)
+    # ...and decay on successful round trips (recovery)
+    ledger.observe_rtt(2, 1.0)
+    ledger.observe_rtt(2, 1.0)
+    assert ledger.score(2) > 0.5
+
+    # an RTT far above the fleet median degrades even without breaches
+    for c in (3, 4, 5):
+        ledger.observe_rtt(c, 0.1)
+    ledger.observe_rtt(6, 10.0)
+    assert ledger.score(6) < 0.5 < ledger.score(3)
+
+    healthy, degraded = ledger.partition([1, 2, 3, 6])
+    assert 6 in degraded and 6 not in healthy
+    assert set(healthy) | set(degraded) == {1, 2, 3, 6}
+
+    recs = ledger.records(trace_id="t-1")
+    assert all(r["kind"] == "metric" and r["metric"] == "client_health"
+               and r["trace_id"] == "t-1" for r in recs)
+    assert {r["client"] for r in recs} == {1, 2, 3, 4, 5, 6}
+
+
+def test_health_ledger_comm_sink_and_gauges():
+    from fedml_tpu.comm import base as comm_base
+    from fedml_tpu.obs.health import ClientHealthLedger
+    from fedml_tpu.obs.registry import REGISTRY
+
+    ledger = ClientHealthLedger().attach_comm()
+    try:
+        comm_base._emit_comm_event("dropped", reason="undecodable")
+        comm_base._emit_comm_event("retried")
+        comm_base._emit_comm_event("retried")
+        assert ledger.summary()["_comm"] == {"drops": 1, "retries": 2}
+        ledger.record_comm_failure(9, 2)
+        assert REGISTRY.get("fedml_client_health_comm_failures").value(client="9") == 2.0
+        assert REGISTRY.get("fedml_client_health_score").value(client="9") == \
+            pytest.approx(1.0 / 1.5)
+    finally:
+        ledger.detach_comm()
+    # after detach the sink no longer counts
+    comm_base._emit_comm_event("retried")
+    assert ledger.summary()["_comm"]["retries"] == 2
+
+
+def test_health_aware_selection_deprioritizes_degraded_rank(eight_devices):
+    """Acceptance: an INPROC run where rank 3 carries injected deadline
+    breaches — behind extra.health_aware_selection the server samples only
+    the healthy ranks, so rank 3 never trains while the others carry every
+    round."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=3, client_num_per_round=2,
+        comm_round=3, learning_rate=0.3, frequency_of_the_test=0, run_id="health-sel",
+    )
+    cfg.extra = {"health_aware_selection": True}
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("health-sel")
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC") for r in (1, 2, 3)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    assert server.health_aware
+    for _ in range(6):  # score 1/(1+0.5*6) = 0.25 < the 0.5 threshold
+        server.health.record_deadline_breach(3)
+    assert server.health.score(3) < 0.5
+    try:
+        history = server.run_until_done(timeout=120.0)
+    finally:
+        for c in clients:
+            c.finish()
+    assert len(history) == 3
+    assert clients[0].rounds_trained == 3
+    assert clients[1].rounds_trained == 3
+    assert clients[2].rounds_trained == 0  # deprioritized every round
+
+
+def test_straggler_timeout_records_deadline_breaches(eight_devices):
+    """The e2e breach source: a client whose uploads vanish breaches the
+    straggler deadline every round, and the server's ledger remembers."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.cross_silo import message_define as md
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=4, client_num_per_round=4,
+        comm_round=2, learning_rate=0.3, frequency_of_the_test=0, run_id="health-brch",
+    )
+    cfg.extra = {"straggler_timeout_s": 1.0, "straggler_quorum_frac": 0.5}
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("health-brch")
+    router = InProcRouter.get("health-brch")
+    router.drop_rule = lambda m: (
+        m.get_sender_id() == 4 and m.get_type() == md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+    )
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC") for r in range(1, 5)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    try:
+        history = server.run_until_done(timeout=60.0)
+    finally:
+        for c in clients:
+            c.finish()
+    assert len(history) == 2
+    summary = server.health.summary()
+    assert summary[4]["breaches"] >= 1.0
+    assert summary[4]["score"] < 1.0
+    # the replying clients stayed healthy
+    for cid in (1, 2, 3):
+        assert summary[cid]["score"] > summary[4]["score"]
+
+
+def test_client_selection_without_health_is_reference_exact():
+    """No ledger -> bit-identical to the reference's round-seeded sampler;
+    with a ledger but everyone healthy -> same draw over the same pool."""
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.core import rng
+    from fedml_tpu.cross_silo import build_aggregator
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.obs.health import ClientHealthLedger
+
+    cfg = tiny_config(client_num_in_total=8, client_num_per_round=3)
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    agg = build_aggregator(cfg, ds, model)
+    ids = list(range(1, 9))
+    expected = [ids[i] for i in rng.sample_clients_np(5, 8, 3)]
+    assert agg.client_selection(5, ids, 3) == expected
+    assert agg.client_selection(5, ids, 3, health=ClientHealthLedger()) == expected
+    # degraded ranks drop out of the sampled pool
+    ledger = ClientHealthLedger()
+    for _ in range(6):
+        ledger.record_deadline_breach(8)
+    selected = agg.client_selection(5, ids, 3, health=ledger)
+    assert 8 not in selected and len(selected) == 3
+    # everyone fits -> everyone participates, degraded or not (reference)
+    assert agg.client_selection(5, ids, 8, health=ledger) == ids
+
+
+# ---------------------------------------------------------------------------
+# report tolerance + health section (satellite)
+
+
+def test_report_tolerates_missing_dur_and_clock_skew():
+    """Records without dur_s and with skewed/missing/non-numeric timestamps
+    must neither raise nor reshuffle the timeline: ordering falls back to
+    collector ingest order."""
+    from fedml_tpu.obs import report
+
+    records = [
+        # round 0 from a host whose clock is AHEAD of round 1's host
+        {"sender": 0, "kind": "span", "name": "round", "trace_id": "t0",
+         "span_id": "r0", "ts": 900.0, "dur_s": None, "round_idx": 0},
+        {"sender": 1, "kind": "span", "name": "train", "trace_id": "t0",
+         "span_id": "c0", "parent_id": "r0", "round_idx": 0},  # no dur_s, no ts
+        {"sender": 0, "kind": "span", "name": "round", "trace_id": "t1",
+         "span_id": "r1", "ts": 100.0, "dur_s": "oops", "round_idx": 1},
+        {"sender": 1, "kind": "span", "name": "train", "trace_id": "t1",
+         "span_id": "c1", "parent_id": "r1", "ts": "not-a-clock",
+         "dur_s": 0.5, "round_idx": 1},
+    ]
+    rows = report.round_rows(records)
+    assert [r["round_idx"] for r in rows] == [0, 1]
+    assert rows[0]["round_dur_s"] == 0.0 and rows[1]["round_dur_s"] == 0.0
+    assert rows[0]["train"][0]["dur_s"] == 0.0
+    assert rows[1]["train"][0]["dur_s"] == 0.5
+
+    trees = report.build_span_trees(records)
+    assert set(trees) == {"t0", "t1"}
+    rendered = report.render_report(records)
+    assert "== round timeline ==" in rendered
+
+    # non-numeric round indexes fall back to ingest order instead of raising
+    mixed = records + [
+        {"sender": 0, "kind": "span", "name": "round", "trace_id": "t2",
+         "span_id": "r2", "ts": 50.0, "dur_s": 1.0, "round_idx": "warmup"},
+    ]
+    rows = report.round_rows(mixed)
+    assert [r["round_idx"] for r in rows] == [0, 1, "warmup"]
+
+
+def test_report_renders_client_health_section():
+    from fedml_tpu.obs import report
+
+    records = [
+        {"sender": 0, "kind": "metric", "metric": "client_health", "client": 1,
+         "score": 1.0, "ewma_rtt_s": 0.2, "breaches": 0.0, "comm_failures": 0.0,
+         "ts": 100.0},
+        {"sender": 0, "kind": "metric", "metric": "client_health", "client": 2,
+         "score": 0.8, "ewma_rtt_s": 0.3, "breaches": 1.0, "comm_failures": 0.0,
+         "ts": 100.0},
+        # a later record for client 2 supersedes the first
+        {"sender": 0, "kind": "metric", "metric": "client_health", "client": 2,
+         "score": 0.25, "ewma_rtt_s": 0.9, "breaches": 3.0, "comm_failures": 1.0,
+         "ts": 101.0},
+    ]
+    rows = report.client_health_rows(records)
+    assert [r["client"] for r in rows] == ["2", "1"]  # worst first
+    assert rows[0]["score"] == 0.25 and rows[0]["breaches"] == 3.0
+    rendered = report.render_report(records)
+    assert "== client health ==" in rendered

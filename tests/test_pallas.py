@@ -235,14 +235,14 @@ def test_fused_sim_smoke_loss_parity(eight_devices, make_tiny_config):
 
 def test_pallas_kernel_seconds_histogram(eight_devices):
     """Eager kernel invocations land in the process-global
-    ``pallas_kernel_seconds`` histogram (labels=kernel) and surface in both
-    the Prometheus rendering and the bench summary helper."""
+    ``fedml_pallas_kernel_seconds`` histogram (labels=kernel) and surface in
+    both the Prometheus rendering and the bench summary helper."""
     from fedml_tpu.obs.registry import REGISTRY
     from fedml_tpu.ops.pallas import (
         fused_bn_relu, kernel_time_summary, quantize_int8_stochastic,
     )
 
-    hist = REGISTRY.get("pallas_kernel_seconds")
+    hist = REGISTRY.get("fedml_pallas_kernel_seconds")
     assert hist is not None
     before = hist.count(kernel="fused_bn_relu")
     y, _, s, b, _ = _fused_inputs((2, 4, 4, 16))
@@ -252,7 +252,7 @@ def test_pallas_kernel_seconds_histogram(eight_devices):
     assert hist.count(kernel="quantize_int8_stochastic") >= 1
     summary = kernel_time_summary()
     assert summary["fused_bn_relu"]["count"] >= 1
-    assert "pallas_kernel_seconds_bucket" in REGISTRY.render()
+    assert "fedml_pallas_kernel_seconds_bucket" in REGISTRY.render()
     # traced invocations are NOT host-timed (wall clock there measures
     # tracing, not the kernel)
     n = hist.count(kernel="fused_bn_relu")
