@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Headline benchmarks with MFU accounting.
 
-Two benches, one JSON line:
+Three benches, one JSON line:
 
 1. **LLM train step** (the headline metric): a 542M-param llama-style
    transformer (d=2048, L=8, SwiGLU 5632, vocab 32k) trained at seq 2048 —
@@ -14,6 +14,10 @@ Two benches, one JSON line:
    twice, unfused and with the fused Pallas conv epilogues
    (``extra.fused_blocks``, ops/pallas/fused_block.py), the round-6 A/B.
    The regression floors are asserted on the UNFUSED number only.
+3. **Compressed cross-silo rounds** (round-7): the qsgd8 wire ratio on the
+   ResNet-20 pytree (floor 3.5x, platform independent) plus an in-proc
+   4-client e2e raw-vs-qsgd8 A/B — wall/round, wire bytes, payload
+   compression ratio, peak buffered updates (streaming accumulator <= 2).
 
 The reference publishes no numeric baselines (BASELINE.md) and has no MFU
 accounting at all; the 0.35 target comes from BASELINE.json's north star.
@@ -135,6 +139,88 @@ def _kernel_microbench(batch):
     return kernel_time_summary()
 
 
+def bench_crosssilo():
+    """Compressed streaming cross-silo rounds (in-proc backend): wire bytes,
+    compression ratio, and round wall time, raw vs qsgd8.
+
+    Two measurements: (1) the qsgd8 wire ratio on the flagship ResNet-20
+    pytree — the floor-guarded number (>= 3.5x, exit 3 on violation; platform
+    independent, so it also runs on CPU), and (2) an e2e 4-client run whose
+    payload bytes / round times / peak-buffered-update count come from the
+    live registry counters and the server's streaming accumulator."""
+    import jax
+    import jax.numpy as jnp
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.comm import codecs, wire
+    from fedml_tpu.comm.base import BYTES_RECEIVED
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub, resnet
+
+    # ---- 1) qsgd8 wire ratio on the ResNet-20 pytree (the floor) ----
+    model = resnet.resnet20(10)
+    variables = jax.device_get(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3), jnp.float32), train=True))
+    raw_wire = wire.encode_pytree({"model_params": variables})
+    comp, _, _ = codecs.compress_pytree(variables, "qsgd8", key=jax.random.PRNGKey(1))
+    comp_wire = wire.encode_pytree({"model_params": comp})
+    resnet_ratio = len(raw_wire) / max(len(comp_wire), 1)
+
+    # ---- 2) e2e in-proc rounds, raw vs qsgd8 ----
+    def run(codec):
+        rounds = int(os.environ.get("BENCH_CS_ROUNDS", "3"))
+        extra = {"mlp_hidden": 512}
+        if codec:
+            extra["comm_compression"] = codec
+        cfg = Config(
+            training_type="cross_silo", dataset="synthetic", model="mlp",
+            client_num_in_total=4, client_num_per_round=4, comm_round=rounds,
+            epochs=1, batch_size=32, learning_rate=0.1, partition_method="homo",
+            synthetic_train_size=2048, synthetic_test_size=512,
+            frequency_of_the_test=0, compute_dtype="float32",
+            metrics_jsonl_path="", run_id=f"bench_cs_{codec or 'raw'}",
+            extra=extra,
+        )
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        mdl = model_hub.create(cfg, ds.class_num)
+        InProcRouter.reset(cfg.run_id)
+        clients = [build_client(cfg, ds, mdl, rank=r, backend="INPROC")
+                   for r in range(1, 5)]
+        for c in clients:
+            c.run_in_thread()
+        server = build_server(cfg, ds, mdl, backend="INPROC")
+        bytes0 = BYTES_RECEIVED.value()
+        t0 = time.perf_counter()
+        try:
+            server.run_until_done(timeout=300.0)
+        finally:
+            for c in clients:
+                c.finish()
+        dt = time.perf_counter() - t0
+        return {
+            "wall_s": round(dt, 3),
+            "rounds_per_sec": round(rounds / dt, 3),
+            "wire_bytes_received": int(BYTES_RECEIVED.value() - bytes0),
+            "peak_buffered_updates": int(server.aggregator.peak_buffered_updates),
+            "streaming": bool(server.aggregator.stream_mode),
+        }
+
+    raw = run(None)
+    qsgd8 = run("qsgd8")
+    return {
+        "qsgd8_ratio_resnet20": round(resnet_ratio, 3),
+        "raw": raw,
+        "qsgd8": qsgd8,
+        "payload_counters": codecs.payload_counters(),
+        "e2e_bytes_reduction": round(
+            raw["wire_bytes_received"] / max(qsgd8["wire_bytes_received"], 1), 3),
+    }
+
+
 def bench_llm(peak):
     import jax
     import jax.numpy as jnp
@@ -203,6 +289,8 @@ def _run_one(mode):
         result = bench_llm(peak)
     elif mode == "fedavg_fused":
         result = bench_fedavg(peak, fused=True)
+    elif mode == "crosssilo":
+        result = bench_crosssilo()
     else:
         result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
@@ -249,6 +337,9 @@ def _subprocess_bench(mode):
 #: tolerating tunnel run-to-run noise.
 LLM_MFU_FLOOR = 0.35
 FEDAVG_MFU_FLOOR = 0.125
+#: qsgd8 wire ratio on the ResNet-20 pytree — platform independent (int8 +
+#: per-block scales vs f32), so it is asserted on CPU too
+CROSSSILO_QSGD8_RATIO_FLOOR = 3.5
 
 
 def main():
@@ -267,6 +358,9 @@ def main():
         fedavg_fused = _subprocess_bench("fedavg_fused")
     except Exception as e:  # noqa: BLE001 — the error string IS the record
         fedavg_fused = {"error": str(e)[-2000:]}
+    # ISSUE-4: compressed streaming cross-silo rounds (in-proc backend) —
+    # bytes-on-wire, compression ratio, and round wall time raw vs qsgd8
+    crosssilo = _subprocess_bench("crosssilo")
 
     on_tpu = "TPU" in str(llm.get("device", ""))
     # one retry per bench before declaring a floor violation: a tunneled chip
@@ -280,6 +374,10 @@ def main():
         violations.append(f"llm mfu {llm['mfu']} < floor {LLM_MFU_FLOOR}")
     if on_tpu and fedavg["mfu"] is not None and fedavg["mfu"] < FEDAVG_MFU_FLOOR:
         violations.append(f"fedavg mfu {fedavg['mfu']} < floor {FEDAVG_MFU_FLOOR}")
+    cs_ratio = crosssilo.get("qsgd8_ratio_resnet20")
+    if cs_ratio is not None and cs_ratio < CROSSSILO_QSGD8_RATIO_FLOOR:
+        violations.append(
+            f"crosssilo qsgd8 ratio {cs_ratio} < floor {CROSSSILO_QSGD8_RATIO_FLOOR}")
 
     mfu = llm["mfu"]
     target = 0.35  # BASELINE.md MFU floor
@@ -301,6 +399,7 @@ def main():
             "fedavg_cifar10_resnet20": fedavg,
             "fedavg_cifar10_resnet20_fused": fedavg_fused,
             "fedavg_fused_speedup": fused_speedup,
+            "crosssilo_comm": crosssilo,
         },
     }))
     if violations:

@@ -36,6 +36,12 @@ class Message:
             MSG_ARG_KEY_SENDER: sender_id,
             MSG_ARG_KEY_RECEIVER: receiver_id,
         }
+        # undecoded tensor section of a received frame: (header, offset, blob)
+        # until first tensor access (lazy decode keeps the receive loop off
+        # the dequantize path and lets a streaming consumer fold leaf-by-leaf)
+        self._tensor_stream = None
+        #: wire size of the frame this message was decoded from (0 if local)
+        self.wire_nbytes: int = 0
 
     # reference API shape
     def add_params(self, key: str, value: Any) -> None:
@@ -44,7 +50,16 @@ class Message:
     add = add_params
 
     def get(self, key: str, default=None) -> Any:
+        if key not in self.msg_params and self._tensor_stream is not None:
+            self._materialize_tensors()
         return self.msg_params.get(key, default)
+
+    def all_params(self) -> dict:
+        """The full params dict; forces tensor materialization on a received
+        message (use :meth:`get` for single keys — control keys stay lazy)."""
+        if self._tensor_stream is not None:
+            self._materialize_tensors()
+        return self.msg_params
 
     def get_type(self) -> int:
         return self.msg_params[MSG_ARG_KEY_TYPE]
@@ -65,7 +80,9 @@ class Message:
 
     # -- wire ---------------------------------------------------------------
     def encode(self) -> bytes:
-        """Control fields as JSON; array-valued params via the pytree wire."""
+        """Control fields as JSON; array-valued params via the pytree wire.
+        Single output allocation: the tensor chunks are zero-copy views
+        joined once, never duplicated through an intermediate blob."""
         control = {}
         tensors = {}
         for k, v in self.msg_params.items():
@@ -73,20 +90,42 @@ class Message:
                 tensors[k] = v
             else:
                 control[k] = v
-        blob = wire.encode_pytree(tensors)
         cbytes = json.dumps(control, separators=(",", ":")).encode("utf-8")
-        return len(cbytes).to_bytes(4, "little") + cbytes + blob
+        parts = [len(cbytes).to_bytes(4, "little"), cbytes]
+        parts.extend(wire.encode_pytree_chunks(tensors))
+        return b"".join(parts)
 
     @classmethod
     def decode(cls, data: bytes) -> "Message":
         clen = int.from_bytes(data[:4], "little")
-        control = json.loads(data[4 : 4 + clen].decode("utf-8"))
-        tensors = wire.decode_pytree(data[4 + clen :])
+        control = json.loads(bytes(data[4 : 4 + clen]).decode("utf-8"))
         msg = cls()
-        msg.msg_params = {**control, **tensors}
+        msg.msg_params = dict(control)
+        # the tensor header is parsed + length-validated NOW (framing
+        # corruption must fail in the receive loop's drop path), but leaf
+        # decode is deferred to first access / the streaming consumer
+        blob = memoryview(data)[4 + clen :]
+        header, offset = wire.decode_header(blob)
+        msg._tensor_stream = (header, offset, blob)
+        msg.wire_nbytes = len(data)
         return msg
 
+    def tensor_stream(self):
+        """``(wire_header, payload_offset, blob)`` while the tensor section
+        is still undecoded (for chunk-by-chunk streaming consumers), else
+        None.  Control params (JSON section) never trigger materialization."""
+        return self._tensor_stream
+
+    def _materialize_tensors(self) -> None:
+        header, offset, blob = self._tensor_stream
+        self._tensor_stream = None
+        tensors = wire.decode_pytree(blob, header=header, offset=offset)
+        if isinstance(tensors, dict):
+            self.msg_params.update(tensors)
+
     def __repr__(self) -> str:
+        if self._tensor_stream is not None:
+            self._materialize_tensors()
         keys = [k for k in self.msg_params if k not in (MSG_ARG_KEY_TYPE, MSG_ARG_KEY_SENDER, MSG_ARG_KEY_RECEIVER)]
         return (
             f"Message(type={self.get_type()}, {self.get_sender_id()}->"
@@ -97,7 +136,7 @@ class Message:
 def _is_arraylike(v) -> bool:
     import numpy as np
 
-    if isinstance(v, np.ndarray):
+    if isinstance(v, (np.ndarray, wire.CompressedLeaf)):
         return True
     # jax arrays / pytrees of arrays
     if isinstance(v, dict):

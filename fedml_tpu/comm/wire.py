@@ -6,22 +6,51 @@ The reference pickles torch ``state_dict``s (MPI/gRPC,
 version-fragile.  Here a pytree serializes to a self-describing, polyglot
 layout:
 
-    [4-byte LE header length][header JSON][raw little-endian buffers...]
+    [4-byte LE header length][header JSON][per-leaf segments...]
 
-header = {"treedef": <json pytree skeleton>, "leaves": [{dtype, shape,
+v1 header = {"treedef": <json pytree skeleton>, "leaves": [{dtype, shape,
 nbytes}...], "version": 1}.  A non-Python client needs only a JSON parser to
 read or produce it.  No pickle anywhere.
+
+**Wire v2** (compressed streaming rounds) extends every leaf spec with a
+``codec`` field and keeps the same envelope:
+
+- ``raw``   — little-endian buffer, exactly the v1 layout.
+- ``qsgd8`` — block-scaled stochastic int8 (the ``ops/pallas/quantize.py``
+  semantics): segment = per-block f32 scales then int8 values; spec carries
+  ``blocks`` and the unpadded ``length``.
+- ``topk``  — sparse delta: segment = int32 indices then f32 values; spec
+  carries the dense ``size`` and ``k``.
+
+v2 frames are emitted only when the tree contains :class:`CompressedLeaf`
+leaves; plain trees keep producing **bit-identical v1 bytes**.  Decode
+accepts both versions.  Encoding is writev-style: ``encode_pytree_chunks``
+yields bounded buffer views (no leaf is ever duplicated through ``tobytes``
+and no giant intermediate blob exists beyond the single final join), and
+decoding returns ``np.frombuffer`` views into the received buffer instead of
+per-leaf copies.  :class:`PytreeStreamDecoder` decodes incrementally from
+bounded chunks so a receiver can fold leaves into an accumulator while the
+rest of the frame is still in flight.
 """
 
 from __future__ import annotations
 
 import json
 import struct
-from typing import Any
+from typing import Any, Iterator, Optional
 
 import numpy as np
 
 WIRE_VERSION = 1
+WIRE_VERSION_V2 = 2
+
+#: bound on the buffer views yielded by :func:`encode_pytree_chunks` — a
+#: large model streams as many bounded chunks instead of one giant blob
+CHUNK_BYTES_DEFAULT = 1 << 20
+
+#: elements per qsgd8 block (matches the (8, 128) f32 tile of
+#: ``ops/pallas/quantize.py``)
+QSGD8_BLOCK = 1024
 
 # JSON pytree skeleton: dict -> {"d": {k: skel}}, list/tuple -> {"l"/"t": [...]},
 # leaf -> {"x": leaf_index}
@@ -47,38 +76,273 @@ def _restore_skeleton(skel, leaves: list):
     return leaves[skel["x"]]
 
 
-def encode_pytree(tree: Any) -> bytes:
-    """Pytree of arrays/scalars -> wire bytes."""
+def flatten_with_skeleton(tree: Any) -> tuple:
+    """(skeleton, leaves) in wire order — the leaf ordering every frame built
+    from ``tree``'s structure uses (sorted dict keys, depth first)."""
     leaves: list = []
     skel = _build_skeleton(tree, leaves)
-    arrs = [np.asarray(l) for l in leaves]
-    header = {
-        "version": WIRE_VERSION,
-        "treedef": skel,
-        "leaves": [
-            {"dtype": a.dtype.str, "shape": list(a.shape), "nbytes": int(a.nbytes)}
-            for a in arrs
-        ],
-    }
+    return skel, leaves
+
+
+def restore_skeleton(skel, leaves: list) -> Any:
+    return _restore_skeleton(skel, leaves)
+
+
+class CompressedLeaf:
+    """A pre-compressed wire-v2 leaf: codec name, dense dtype/shape, codec
+    metadata, and the raw segment arrays whose bytes go on the wire.
+
+    ``qsgd8``: segments = (f32 scales ``(blocks,)``, int8 values
+    ``(blocks*1024,)``), meta = {"blocks", "length"}.
+    ``topk``: segments = (int32 indices ``(k,)``, f32 values ``(k,)``),
+    meta = {"size", "k"}.
+    """
+
+    __slots__ = ("codec", "dtype", "shape", "meta", "segments")
+
+    def __init__(self, codec: str, dtype, shape, meta: dict, segments):
+        self.codec = str(codec)
+        self.dtype = np.dtype(dtype).str
+        self.shape = tuple(int(s) for s in shape)
+        self.meta = dict(meta)
+        self.segments = tuple(np.ascontiguousarray(s) for s in segments)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(s.nbytes) for s in self.segments)
+
+    def spec(self) -> dict:
+        d = {"codec": self.codec, "dtype": self.dtype,
+             "shape": list(self.shape), "nbytes": int(self.nbytes)}
+        d.update(self.meta)
+        return d
+
+    def dense(self) -> np.ndarray:
+        """Decode back to the dense array (test/debug convenience)."""
+        raw = b"".join(_raw_view(s) for s in self.segments)
+        return _decode_leaf(self.spec(), memoryview(raw), 0)
+
+    def __repr__(self) -> str:
+        return (f"CompressedLeaf({self.codec}, dtype={self.dtype}, "
+                f"shape={self.shape}, nbytes={self.nbytes})")
+
+
+def _raw_view(a: np.ndarray):
+    """Zero-copy read view of an array's bytes (no ``tobytes`` duplicate)."""
+    a = np.ascontiguousarray(a)
+    if a.nbytes == 0:
+        return b""
+    return memoryview(a.reshape(-1).view(np.uint8))
+
+
+def _prepare_frame(tree: Any) -> tuple:
+    """(header_dict, [buffer views]) for a pytree; picks v1 vs v2 by whether
+    any leaf is a :class:`CompressedLeaf`.  v1 headers are constructed with
+    exactly the historical key order so plain trees stay bit-identical."""
+    leaves: list = []
+    skel = _build_skeleton(tree, leaves)
+    specs: list[dict] = []
+    buffers: list = []
+    compressed = False
+    for leaf in leaves:
+        if isinstance(leaf, CompressedLeaf):
+            compressed = True
+            specs.append(leaf.spec())
+            buffers.extend(_raw_view(s) for s in leaf.segments)
+        else:
+            # NOTE: spec shape from np.asarray, NOT ascontiguousarray — the
+            # latter promotes 0-d scalars to (1,) and would change v1 bytes
+            a = np.asarray(leaf)
+            specs.append({"dtype": a.dtype.str, "shape": list(a.shape),
+                          "nbytes": int(a.nbytes)})
+            buffers.append(_raw_view(a))
+    if compressed:
+        for spec in specs:
+            spec.setdefault("codec", "raw")
+        header = {"version": WIRE_VERSION_V2, "treedef": skel, "leaves": specs}
+    else:
+        header = {"version": WIRE_VERSION, "treedef": skel, "leaves": specs}
+    return header, buffers
+
+
+def encode_pytree_chunks(tree: Any, chunk_bytes: int = CHUNK_BYTES_DEFAULT) -> Iterator:
+    """Writev-style encoder: yields bounded bytes-like views (header first,
+    then per-leaf segments in ≤ ``chunk_bytes`` pieces).  Nothing here copies
+    a leaf — the views alias the source arrays, so the only full copy of the
+    payload is whatever the transport does with the chunks."""
+    header, buffers = _prepare_frame(tree)
     hbytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
-    parts = [struct.pack("<I", len(hbytes)), hbytes]
-    for a in arrs:
-        parts.append(np.ascontiguousarray(a).tobytes())
-    return b"".join(parts)
+    yield struct.pack("<I", len(hbytes)) + hbytes
+    for buf in buffers:
+        n = len(buf) if isinstance(buf, (bytes, bytearray)) else buf.nbytes
+        if n == 0:
+            continue
+        mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+        if n <= chunk_bytes:
+            yield mv
+        else:
+            for s in range(0, n, chunk_bytes):
+                yield mv[s:s + chunk_bytes]
 
 
-def decode_pytree(data: bytes) -> Any:
-    """Wire bytes -> pytree of numpy arrays."""
-    (hlen,) = struct.unpack_from("<I", data, 0)
-    header = json.loads(data[4 : 4 + hlen].decode("utf-8"))
-    if header.get("version") != WIRE_VERSION:
-        raise ValueError(f"unsupported wire version {header.get('version')}")
-    offset = 4 + hlen
-    leaves = []
-    for spec in header["leaves"]:
-        dt = np.dtype(spec["dtype"])
-        n = spec["nbytes"]
-        arr = np.frombuffer(data, dtype=dt, count=n // dt.itemsize, offset=offset).reshape(spec["shape"])
-        leaves.append(arr.copy())  # own the memory
-        offset += n
+def encode_pytree(tree: Any) -> bytes:
+    """Pytree of arrays/scalars (and/or :class:`CompressedLeaf`) -> wire
+    bytes.  One output allocation; leaves are copied exactly once, into it."""
+    return b"".join(encode_pytree_chunks(tree))
+
+
+def _as_bytes_view(data) -> memoryview:
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    return mv
+
+
+def decode_header(data) -> tuple:
+    """Parse + validate the frame header; returns ``(header, payload_offset)``.
+
+    Validates the version and that the declared leaf bytes exactly fill the
+    buffer, so framing corruption fails HERE (the receive loop's drop path)
+    rather than at first lazy leaf access."""
+    mv = _as_bytes_view(data)
+    if len(mv) < 4:
+        raise ValueError(f"wire frame too short ({len(mv)} bytes)")
+    (hlen,) = struct.unpack_from("<I", mv, 0)
+    if 4 + hlen > len(mv):
+        raise ValueError(f"wire header truncated ({hlen} declared, {len(mv) - 4} present)")
+    header = json.loads(bytes(mv[4:4 + hlen]).decode("utf-8"))
+    version = header.get("version")
+    if version not in (WIRE_VERSION, WIRE_VERSION_V2):
+        raise ValueError(f"unsupported wire version {version}")
+    payload = sum(int(spec["nbytes"]) for spec in header["leaves"])
+    if 4 + hlen + payload != len(mv):
+        raise ValueError(
+            f"wire payload length mismatch: header declares {payload} leaf "
+            f"bytes, buffer has {len(mv) - 4 - hlen}"
+        )
+    return header, 4 + hlen
+
+
+def _decode_leaf(spec: dict, mv: memoryview, offset: int) -> np.ndarray:
+    """One leaf segment -> dense array.  ``raw`` leaves are zero-copy
+    ``np.frombuffer`` views into the receive buffer; compressed codecs
+    dequantize/scatter into fresh arrays."""
+    codec = spec.get("codec", "raw")
+    shape = tuple(spec["shape"])
+    dtype = np.dtype(spec["dtype"])
+    if codec == "raw":
+        n = int(spec["nbytes"])
+        return np.frombuffer(mv, dtype=dtype, count=n // dtype.itemsize,
+                             offset=offset).reshape(shape)
+    if codec == "qsgd8":
+        blocks = int(spec["blocks"])
+        length = int(spec["length"])
+        scales = np.frombuffer(mv, dtype="<f4", count=blocks, offset=offset)
+        values = np.frombuffer(mv, dtype=np.int8, count=blocks * QSGD8_BLOCK,
+                               offset=offset + 4 * blocks)
+        deq = values.reshape(blocks, QSGD8_BLOCK).astype(np.float32) * scales[:, None]
+        return deq.reshape(-1)[:length].astype(dtype, copy=False).reshape(shape)
+    if codec == "topk":
+        size = int(spec["size"])
+        k = int(spec["k"])
+        idx = np.frombuffer(mv, dtype="<i4", count=k, offset=offset)
+        vals = np.frombuffer(mv, dtype="<f4", count=k, offset=offset + 4 * k)
+        out = np.zeros(size, np.float32)
+        out[idx] = vals
+        return out.astype(dtype, copy=False).reshape(shape)
+    raise ValueError(f"unknown wire codec {codec!r}")
+
+
+def iter_leaf_arrays(data, header: Optional[dict] = None,
+                     offset: Optional[int] = None) -> Iterator:
+    """Decode leaf-by-leaf: yields ``(index, spec, dense_array)`` in wire
+    order without ever materializing the whole pytree — the streaming-
+    aggregation primitive (fold each leaf, drop it, move on)."""
+    mv = _as_bytes_view(data)
+    if header is None:
+        header, offset = decode_header(mv)
+    off = int(offset)
+    for i, spec in enumerate(header["leaves"]):
+        yield i, spec, _decode_leaf(spec, mv, off)
+        off += int(spec["nbytes"])
+
+
+def decode_pytree(data, header: Optional[dict] = None,
+                  offset: Optional[int] = None) -> Any:
+    """Wire bytes -> pytree of numpy arrays (v1 or v2; compressed leaves come
+    back dense).  ``raw`` leaves are read-only views into ``data`` — copy
+    before mutating."""
+    mv = _as_bytes_view(data)
+    if header is None:
+        header, offset = decode_header(mv)
+    leaves = [arr for _, _, arr in iter_leaf_arrays(mv, header=header, offset=offset)]
     return _restore_skeleton(header["treedef"], leaves)
+
+
+class PytreeStreamDecoder:
+    """Incremental frame decoder: ``feed()`` bounded chunks as they arrive;
+    each call returns the leaves completed by that chunk as
+    ``(index, spec, array)`` tuples, and consumed bytes are released — peak
+    buffered memory stays ~(largest leaf + chunk), not the whole frame.
+
+    With ``retain_leaves=True`` (default) the decoded leaves are kept so
+    ``result()`` can rebuild the full pytree; a streaming aggregator passes
+    ``False`` and folds each leaf as it completes.
+    """
+
+    def __init__(self, retain_leaves: bool = True):
+        self._buf = bytearray()
+        self._header: Optional[dict] = None
+        self._leaf_idx = 0
+        self._retain = retain_leaves
+        self._leaves: list = []
+
+    @property
+    def header(self) -> Optional[dict]:
+        return self._header
+
+    @property
+    def complete(self) -> bool:
+        return self._header is not None and self._leaf_idx >= len(self._header["leaves"])
+
+    def feed(self, chunk) -> list:
+        self._buf += bytes(chunk) if isinstance(chunk, memoryview) else chunk
+        out: list = []
+        if self._header is None:
+            if len(self._buf) < 4:
+                return out
+            (hlen,) = struct.unpack_from("<I", self._buf, 0)
+            if len(self._buf) < 4 + hlen:
+                return out
+            header = json.loads(bytes(self._buf[4:4 + hlen]).decode("utf-8"))
+            if header.get("version") not in (WIRE_VERSION, WIRE_VERSION_V2):
+                raise ValueError(f"unsupported wire version {header.get('version')}")
+            self._header = header
+            del self._buf[:4 + hlen]
+        specs = self._header["leaves"]
+        while self._leaf_idx < len(specs):
+            spec = specs[self._leaf_idx]
+            n = int(spec["nbytes"])
+            if len(self._buf) < n:
+                break
+            # copy out of the mutable buffer: the view would be invalidated
+            # by the del below (bounded memory beats zero-copy here)
+            arr = _decode_leaf(spec, memoryview(bytes(self._buf[:n])), 0)
+            del self._buf[:n]
+            if self._retain:
+                self._leaves.append(arr)
+            out.append((self._leaf_idx, spec, arr))
+            self._leaf_idx += 1
+        if self.complete and self._buf:
+            raise ValueError(f"{len(self._buf)} trailing bytes after final leaf")
+        return out
+
+    def result(self) -> Any:
+        if not self.complete:
+            raise ValueError(
+                f"frame incomplete: {self._leaf_idx}/"
+                f"{len(self._header['leaves']) if self._header else '?'} leaves decoded"
+            )
+        if not self._retain:
+            raise ValueError("decoder built with retain_leaves=False")
+        return _restore_skeleton(self._header["treedef"], self._leaves)

@@ -15,6 +15,10 @@ MSG_TYPE_S2C_FINISH = 7
 MSG_TYPE_C2S_FINISHED = 8
 
 MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+# TPU-native extension: True when MODEL_PARAMS carries the delta vs the
+# global model the client received (the compressed-upload path) rather than
+# full weights — rides the JSON control section so every transport keeps it
+MSG_ARG_KEY_MODEL_IS_DELTA = "model_is_delta"
 MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
 MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
 MSG_ARG_KEY_CLIENT_STATUS = "client_status"

@@ -30,10 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..algorithms import create as create_algorithm, hparams_from_config
+from ..comm import codecs, wire
 from ..comm.comm_manager import FedMLCommManager
 from ..comm.message import Message
 from ..core import pytree as pt, rng
 from ..data.dataset import pad_eval_set
+from ..fl.algorithm import FedAlgorithm
 from ..fl.local_sgd import make_eval_fn
 from ..obs import registry as obsreg, trace as obstrace
 from ..obs.metrics import MetricsLogger
@@ -56,6 +58,20 @@ AGGREGATE_TIME = obsreg.REGISTRY.histogram(
     "fedml_crosssilo_aggregate_seconds",
     "Server-side aggregation wall time per round.",
 )
+BUFFERED_PEAK = obsreg.REGISTRY.gauge(
+    "fedml_crosssilo_buffered_updates_peak",
+    "Peak client updates simultaneously buffered on the server (streaming "
+    "aggregation holds ~2 regardless of clients-per-round).",
+)
+
+
+def _apply_delta(global_leaf, delta_leaf):
+    """global + delta per leaf, mirroring the client's ``_leaf_delta``
+    (f32 math for float leaves, native add for integers)."""
+    g, d = np.asarray(global_leaf), np.asarray(delta_leaf)
+    if g.dtype.kind in "fc":
+        return (g.astype(np.float32) + d.astype(np.float32)).astype(g.dtype)
+    return g + d
 
 
 def provisional_steps_per_epoch(cfg) -> int:
@@ -70,6 +86,18 @@ def provisional_steps_per_epoch(cfg) -> int:
 class FedMLAggregator:
     """Server-side state: per-round model buffer + the algorithm frame
     (reference ``FedMLAggregator`` ``fedml_aggregator.py``)."""
+
+    # class-level defaults for the streaming-aggregation machinery so that
+    # subclasses which deliberately skip __init__ (LoRAAggregator builds its
+    # own adapter-tree state) inherit the safe exact-mode behavior
+    stream_mode = False
+    _np_global = None
+    _stream_tmpl = None
+    _stream_sum = None
+    _stream_w = 0.0
+    _stream_w_delta = 0.0
+    _stream_folded = 0
+    peak_buffered_updates = 0
 
     def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
         self.cfg = cfg
@@ -93,14 +121,108 @@ class FedMLAggregator:
         tx, ty, n_valid = test_arrays
         self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
         self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=min(256, max(32, cfg.test_batch_size))))
+        # streaming aggregation: fold each arriving update into a running
+        # weighted sum as it lands (overlapping aggregation with the network
+        # tail; peak host memory ~2x model instead of N x model).  Engaged
+        # only when compression / extra.streaming_aggregation asks for it AND
+        # the algorithm uses the stock weighted-mean aggregate AND no trust
+        # pipeline needs the stacked client models — otherwise the exact
+        # buffer-all path below stays reference-bit-exact.
+        extra = getattr(cfg, "extra", {}) or {}
+        self.stream_mode = bool(
+            (codecs.codec_from_config(cfg) or extra.get("streaming_aggregation"))
+            and trust is None
+            and type(self.algorithm).aggregate is FedAlgorithm.aggregate
+        )
+        self._np_global = None      # host copy of global_vars, per round
+        self._stream_tmpl = None    # (template leaves, wire skeleton), per round
+        self._stream_sum: Optional[list] = None
+        self._stream_w = 0.0
+        self._stream_w_delta = 0.0
+        self._stream_folded = 0
+        #: high-water mark of client updates simultaneously buffered (the
+        #: streaming acceptance bound: <= 2 regardless of clients-per-round)
+        self.peak_buffered_updates = 0
 
-    def add_local_trained_result(self, client_idx: int, params, sample_num: float) -> None:
+    # -- receive-side bookkeeping -------------------------------------------
+    def _host_global(self):
+        if self._np_global is None:
+            self._np_global = jax.device_get(self.global_vars)
+        return self._np_global
+
+    def _stream_template(self):
+        if self._stream_tmpl is None:
+            skel, leaves = wire.flatten_with_skeleton(
+                {md.MSG_ARG_KEY_MODEL_PARAMS: self._host_global()}
+            )
+            self._stream_tmpl = ([np.asarray(l) for l in leaves], skel)
+        return self._stream_tmpl
+
+    def _note_buffered(self, inflight: int = 0) -> None:
+        n = len(self.model_dict) + inflight + (1 if self._stream_sum is not None else 0)
+        if n > self.peak_buffered_updates:
+            self.peak_buffered_updates = n
+
+    def has_received(self, client_idx: int) -> bool:
+        return client_idx in self.flag_client_model_uploaded
+
+    def add_local_trained_result(self, client_idx: int, params, sample_num: float,
+                                 is_delta: bool = False) -> None:
+        if is_delta:
+            params = jax.tree_util.tree_map(_apply_delta, self._host_global(), params)
         self.model_dict[client_idx] = params
         self.sample_num_dict[client_idx] = sample_num
         self.flag_client_model_uploaded[client_idx] = True
+        self._note_buffered()
+
+    def ingest_streaming(self, client_idx: int, msg, sample_num: float,
+                         is_delta: bool) -> bool:
+        """Fold the model reply's still-undecoded wire frame straight into
+        the running weighted sum, leaf by leaf (dequantizing compressed
+        leaves as they stream).  Returns False when this update must take
+        the buffered path instead (stream mode off, tensors already
+        materialized, or a frame whose structure doesn't match the model)."""
+        if not self.stream_mode:
+            return False
+        if client_idx in self.flag_client_model_uploaded:
+            # duplicate delivery (at-least-once transports redeliver): the
+            # dict-overwrite of the buffered path was naturally idempotent,
+            # a second fold would double-count — swallow it
+            return True
+        stream = msg.tensor_stream()
+        if stream is None:
+            return False
+        header, offset, blob = stream
+        tmpl, skel = self._stream_template()
+        specs = header["leaves"]
+        if header["treedef"] != skel or len(specs) != len(tmpl):
+            log.warning("client %d frame structure mismatch; buffering densely", client_idx)
+            return False
+        for spec, t in zip(specs, tmpl):
+            if tuple(spec["shape"]) != t.shape:
+                log.warning("client %d leaf shape mismatch; buffering densely", client_idx)
+                return False
+        if self._stream_sum is None:
+            self._stream_sum = [np.zeros(t.shape, np.float32) for t in tmpl]
+        # buffered right now: the accumulator + this in-flight decode (+ any
+        # dense fallbacks) — the quantity the <=2 acceptance bound tracks
+        self._note_buffered(inflight=1)
+        w = float(sample_num)
+        for i, _spec, arr in wire.iter_leaf_arrays(blob, header=header, offset=offset):
+            self._stream_sum[i] += w * np.asarray(arr, dtype=np.float32)
+        self._stream_w += w
+        if is_delta:
+            self._stream_w_delta += w
+        self._stream_folded += 1
+        self.sample_num_dict[client_idx] = sample_num
+        self.flag_client_model_uploaded[client_idx] = True
+        return True
 
     def received_count(self) -> int:
-        return len(self.model_dict)
+        # flag_client_model_uploaded is the one ledger every upload path
+        # maintains (dense buffer, streaming fold, and the secure-agg
+        # subclasses' masked/ciphertext uploads)
+        return len(self.flag_client_model_uploaded)
 
     def check_whether_all_receive(self, expected: int) -> bool:
         return self.received_count() >= expected
@@ -129,6 +251,8 @@ class FedMLAggregator:
 
     def aggregate(self, round_idx: int):
         self._calibrate_schedule()
+        if self._stream_folded:
+            return self._aggregate_streaming(round_idx)
         ids = sorted(self.model_dict.keys())
         trees = [jax.tree_util.tree_map(jnp.asarray, self.model_dict[i]) for i in ids]
         stacked = pt.tree_stack(trees)
@@ -151,10 +275,53 @@ class FedMLAggregator:
         if self.trust is not None:
             new_global = self.trust.on_after_aggregation(new_global, self.global_vars, rkey)
         self.global_vars = new_global
+        self._reset_round()
+        return self.global_vars
+
+    def _aggregate_streaming(self, round_idx: int):
+        """Finalize the running weighted sum: most of the aggregation work
+        already happened as updates landed (overlapping the network tail);
+        what's left is one divide + the algorithm's server step."""
+        tmpl, skel = self._stream_template()
+        # dense-buffered stragglers (structure-mismatch fallbacks) fold now;
+        # add_local_trained_result already reconstructed full params
+        for cid in sorted(self.model_dict):
+            w = float(self.sample_num_dict[cid])
+            _, leaves = wire.flatten_with_skeleton(
+                {md.MSG_ARG_KEY_MODEL_PARAMS: self.model_dict[cid]}
+            )
+            for i, leaf in enumerate(leaves):
+                self._stream_sum[i] += w * np.asarray(leaf, dtype=np.float32)
+            self._stream_w += w
+        tot = max(self._stream_w, 1e-12)
+        out = []
+        for i, t in enumerate(tmpl):
+            acc = self._stream_sum[i]
+            if self._stream_w_delta:
+                # delta senders contributed w*(model - global): add their
+                # share of the base model back before normalizing
+                acc = acc + self._stream_w_delta * np.asarray(t, dtype=np.float32)
+            out.append((acc / tot).astype(t.dtype))
+        agg_np = wire.restore_skeleton(skel, out)[md.MSG_ARG_KEY_MODEL_PARAMS]
+        agg = jax.tree_util.tree_map(jnp.asarray, agg_np)
+        new_global, self.server_state = self.algorithm.server_update(
+            self.global_vars, self.server_state, agg, round_idx
+        )
+        self.global_vars = new_global
+        self._reset_round()
+        return self.global_vars
+
+    def _reset_round(self) -> None:
         self.model_dict.clear()
         self.sample_num_dict.clear()
         self.flag_client_model_uploaded.clear()
-        return self.global_vars
+        self._stream_sum = None
+        self._stream_w = 0.0
+        self._stream_w_delta = 0.0
+        self._stream_folded = 0
+        # the global model changed: host copy + leaf template are stale
+        self._np_global = None
+        self._stream_tmpl = None
 
     def test_on_server(self) -> dict:
         return {k: float(v) for k, v in self._eval_fn(self.global_vars, *self._test).items()}
@@ -256,6 +423,8 @@ class FedMLServerManager(FedMLCommManager):
         self._round_span: Optional[obstrace.Span] = None
         self._sent_at: dict[int, float] = {}
         self._round_rtts: dict[int, float] = {}
+        # wire bytes of this round's model uploads (obs-trail record)
+        self._round_payload_bytes = 0
         # Prometheus exposition, gated on extra['metrics_port']
         self.metrics_server = obsreg.maybe_start_metrics_server(cfg)
 
@@ -308,11 +477,22 @@ class FedMLServerManager(FedMLCommManager):
                 CLIENT_ROUND_TRIP.observe(rtt, client=str(sender))
                 self.health.observe_rtt(sender, rtt)
                 self._round_rtts[sender] = rtt
-            self.aggregator.add_local_trained_result(
-                sender,
-                msg.get(md.MSG_ARG_KEY_MODEL_PARAMS),
-                float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES)),
-            )
+            n_samples = float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES))
+            is_delta = bool(msg.get(md.MSG_ARG_KEY_MODEL_IS_DELTA, False))
+            self._round_payload_bytes += int(getattr(msg, "wire_nbytes", 0) or 0)
+            # streaming path first: fold the still-undecoded frame into the
+            # running weighted sum so aggregation overlaps the network tail;
+            # falls back to the buffer-all (reference-bit-exact) path
+            if not self.aggregator.ingest_streaming(sender, msg, n_samples, is_delta):
+                params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
+                if is_delta:
+                    self.aggregator.add_local_trained_result(
+                        sender, params, n_samples, is_delta=True)
+                else:
+                    # positional, delta-free call: secure-agg subclasses
+                    # (masked/ciphertext uploads) override this method with
+                    # the historical 3-arg signature
+                    self.aggregator.add_local_trained_result(sender, params, n_samples)
             if self.aggregator.check_whether_all_receive(len(self.selected)):
                 self._finish_round()
 
@@ -338,7 +518,7 @@ class FedMLServerManager(FedMLCommManager):
                 # and (behind extra.health_aware_selection) later rounds
                 # deprioritize repeat offenders
                 for cid in self.selected:
-                    if cid not in self.aggregator.model_dict:
+                    if not self.aggregator.has_received(cid):
                         self.health.record_deadline_breach(cid)
                 self._finish_round()
             else:
@@ -355,6 +535,7 @@ class FedMLServerManager(FedMLCommManager):
                              clients_received=received) as agg_span:
             self.aggregator.aggregate(self.round_idx)
         AGGREGATE_TIME.observe(agg_span.duration_s)
+        BUFFERED_PEAK.set(self.aggregator.peak_buffered_updates)
         metrics = {"round": self.round_idx}
         eval_span = None
         if self.cfg.frequency_of_the_test and (
@@ -391,6 +572,14 @@ class FedMLServerManager(FedMLCommManager):
                  "trace_id": round_span.trace_id, "ts": time.time()}
                 for cid, rtt in sorted(self._round_rtts.items())
             ]
+            # per-round wire bytes of model uploads (compression shows up
+            # here as the raw-vs-compressed byte trajectory across rounds)
+            records.append(
+                {"kind": "metric", "metric": "comm_payload_bytes",
+                 "value": int(self._round_payload_bytes),
+                 "round_idx": self.round_idx,
+                 "trace_id": round_span.trace_id, "ts": time.time()}
+            )
             # health trajectory rides the same trail: one client_health
             # record per known client, per round (obs report renders it)
             records += self.health.records(trace_id=round_span.trace_id)
@@ -411,6 +600,7 @@ class FedMLServerManager(FedMLCommManager):
             "round", round_idx=self.round_idx, clients=len(self.selected)
         )
         self._round_rtts.clear()
+        self._round_payload_bytes = 0
         params = jax.device_get(self.aggregator.global_vars)
         for cid in self.selected:
             msg = Message(msg_type, 0, cid)

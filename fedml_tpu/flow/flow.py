@@ -150,7 +150,7 @@ class FedMLAlgorithmFlow(FedMLCommManager):
         step_idx = int(msg.get(MSG_ARG_KEY_FLOW_STEP))
         params = Params({
             k[len(FLOW_PARAM_PREFIX):]: v
-            for k, v in msg.msg_params.items() if k.startswith(FLOW_PARAM_PREFIX)
+            for k, v in msg.all_params().items() if k.startswith(FLOW_PARAM_PREFIX)
         })
         with self._lock:
             box = self._inbox.setdefault(step_idx, {})

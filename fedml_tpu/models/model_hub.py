@@ -30,7 +30,10 @@ def create(cfg: Config, output_dim: int) -> Any:
     if name in ("simple-cnn", "cifar_cnn", "cnn_web"):
         return simple.CifarCNN(num_classes=output_dim)
     if name == "mlp":
-        return simple.MLP(num_classes=output_dim)
+        # extra.mlp_hidden widens the hidden layer (comm-compression benches
+        # need leaves past the qsgd8 block size); default matches upstream
+        return simple.MLP(num_classes=output_dim,
+                          hidden=int(getattr(cfg, "mlp_hidden", 128)))
     # extra.fused_blocks routes the CIFAR-ResNet conv epilogues through the
     # fused Pallas kernel (ops/pallas/fused_block.py); Config.__getattr__
     # falls through to the extra dict, so a recipe-level `fused_blocks: true`

@@ -137,7 +137,8 @@ def round_rows(records: Iterable[dict]) -> list[dict]:
         row = by_trace.setdefault(str(rec["trace_id"]), {
             "trace_id": str(rec["trace_id"]), "round_idx": None,
             "round_dur_s": None, "aggregate_dur_s": None, "eval_dur_s": None,
-            "train": [], "round_trips": {}, "_ingest": ingest,
+            "train": [], "round_trips": {}, "payload_bytes": None,
+            "_ingest": ingest,
         })
         name = rec.get("name")
         if name == "round":
@@ -165,6 +166,15 @@ def round_rows(records: Iterable[dict]) -> list[dict]:
                 try:
                     by_trace[trace_id]["round_trips"][str(rec.get("client"))] = \
                         float(rec.get("value", 0.0))
+                except (TypeError, ValueError):
+                    pass
+        elif rec.get("kind") == "metric" and rec.get("metric") == "comm_payload_bytes":
+            # wire bytes of the round's model uploads (ISSUE-4 compression
+            # shows up as this column shrinking across the trail)
+            trace_id = str(rec.get("trace_id", ""))
+            if trace_id in by_trace:
+                try:
+                    by_trace[trace_id]["payload_bytes"] = float(rec.get("value", 0.0))
                 except (TypeError, ValueError):
                     pass
     rows = [row for row in by_trace.values() if row["round_idx"] is not None]
@@ -322,13 +332,15 @@ def render_report(records: Iterable[dict]) -> str:
             slowest = f"{who} ({train[0]['dur_s']:.4f}s)"
         else:
             slowest = "-"
+        pb = row.get("payload_bytes")
         timeline.append([
             str(row["round_idx"]), str(row["trace_id"]), _s(row["round_dur_s"]),
             _s(row["aggregate_dur_s"]), _s(row["eval_dur_s"]),
-            str(len(train)), slowest,
+            str(len(train)), "-" if pb is None else str(int(pb)), slowest,
         ])
     sections.append("== round timeline ==\n" + _table(
-        ["round", "trace_id", "round_s", "aggregate_s", "eval_s", "clients", "slowest client (train_s)"],
+        ["round", "trace_id", "round_s", "aggregate_s", "eval_s", "clients",
+         "upload_bytes", "slowest client (train_s)"],
         timeline,
     ))
 

@@ -1,0 +1,432 @@
+"""Compressed streaming cross-silo rounds (ISSUE 4).
+
+Covers the wire-v2 codec layer (raw/qsgd8/topk roundtrips + error bounds +
+EF residual), v1 back-compat and bit-identical default bytes, the zero-copy
+fast path (views on decode, bounded peak on encode), the chunked stream
+decoder, streaming-accumulator vs batch-aggregate parity, and the e2e
+in-proc cross-silo run with ``extra.comm_compression=qsgd8``.
+"""
+
+import json
+import struct
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _old_v1_encode(tree):
+    """The pre-ISSUE-4 encoder, verbatim — the bit-compat oracle."""
+    from fedml_tpu.comm import wire
+
+    leaves = []
+    skel = wire._build_skeleton(tree, leaves)
+    arrs = [np.asarray(l) for l in leaves]
+    header = {
+        "version": 1,
+        "treedef": skel,
+        "leaves": [
+            {"dtype": a.dtype.str, "shape": list(a.shape), "nbytes": int(a.nbytes)}
+            for a in arrs
+        ],
+    }
+    hbytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    parts = [struct.pack("<I", len(hbytes)), hbytes]
+    for a in arrs:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    return b"".join(parts)
+
+
+def _tree():
+    r = np.random.RandomState(0)
+    return {
+        "params": {"w": r.randn(3000).astype(np.float32),
+                   "b": r.randn(16).astype(np.float32)},
+        "meta": [np.int32(7), np.array([1.5], np.float64)],
+        "t": (np.ones((2, 2), np.float16),),
+    }
+
+
+# ---------------------------------------------------------------------------
+# wire v1 back-compat + bit-identical default bytes
+# ---------------------------------------------------------------------------
+
+def test_default_encode_bit_identical_to_v1():
+    """Compression off -> today's bytes, bit for bit (message level too)."""
+    from fedml_tpu.comm import wire
+    from fedml_tpu.comm.message import Message
+
+    tree = _tree()
+    assert wire.encode_pytree(tree) == _old_v1_encode(tree)
+
+    msg = Message(3, 2, 0)
+    msg.add_params("model_params", {"w": np.arange(64, dtype=np.float32)})
+    msg.add_params("num_samples", 64.0)
+    control = {k: v for k, v in msg.msg_params.items()
+               if not isinstance(v, dict)}
+    cbytes = json.dumps(control, separators=(",", ":")).encode("utf-8")
+    expected = (len(cbytes).to_bytes(4, "little") + cbytes
+                + _old_v1_encode({"model_params": {"w": np.arange(64, dtype=np.float32)}}))
+    assert msg.encode() == expected
+
+
+def test_wire_v1_frames_still_decode():
+    from fedml_tpu.comm import wire
+
+    tree = _tree()
+    data = _old_v1_encode(tree)
+    out = wire.decode_pytree(data)
+    np.testing.assert_array_equal(out["params"]["w"], tree["params"]["w"])
+    assert out["meta"][0] == 7
+    assert isinstance(out["t"], tuple)
+
+
+def test_wire_rejects_corrupt_frames():
+    from fedml_tpu.comm import wire
+
+    data = wire.encode_pytree({"a": np.zeros(8, np.float32)})
+    with pytest.raises(ValueError, match="unsupported wire version"):
+        wire.decode_pytree(data.replace(b'"version":1', b'"version":9'))
+    with pytest.raises(ValueError, match="length mismatch"):
+        wire.decode_pytree(data[:-4])  # truncated payload
+    # unknown codec in a v2 spec (same-length name keeps the framing valid)
+    comp, _, _ = _compress({"x": np.zeros(2048, np.float32)}, "qsgd8")
+    v2 = wire.encode_pytree(comp)
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        wire.decode_pytree(v2.replace(b'"codec":"qsgd8"', b'"codec":"qsgd9"'))
+
+
+# ---------------------------------------------------------------------------
+# codec roundtrips
+# ---------------------------------------------------------------------------
+
+def _compress(tree, codec, **kw):
+    import jax
+
+    from fedml_tpu.comm import codecs
+
+    kw.setdefault("key", jax.random.PRNGKey(0))
+    return codecs.compress_pytree(tree, codec, **kw)
+
+
+def test_qsgd8_roundtrip_error_bound():
+    """Block-scaled stochastic int8: elementwise error <= one quantization
+    step (block amax / 127); small and integer leaves ride raw exactly."""
+    from fedml_tpu.comm import wire
+
+    tree = _tree()
+    comp, res, stats = _compress(tree, "qsgd8")
+    assert res is None or all(r is None for r in res)  # unbiased: no EF state
+    out = wire.decode_pytree(wire.encode_pytree(comp))
+    w = tree["params"]["w"]
+    err = np.abs(out["params"]["w"] - w).max()
+    assert err <= np.abs(w).max() / 127.0 + 1e-6, err
+    np.testing.assert_array_equal(out["params"]["b"], tree["params"]["b"])  # raw
+    assert out["meta"][0] == 7
+    assert stats["ratio"] > 3.0, stats
+
+
+def test_topk_roundtrip_and_error_feedback():
+    """Sparse top-k: decoded == ef_top_k's dense mask, and the residual is
+    exactly what was dropped (corrected = sent + residual)."""
+    from fedml_tpu.comm import wire
+
+    vec = np.random.RandomState(1).randn(4096).astype(np.float32)
+    tree = {"w": vec}
+    comp, res, _ = _compress(tree, "topk", ratio=0.05)
+    out = wire.decode_pytree(wire.encode_pytree(comp))
+    k = max(1, int(0.05 * vec.size))
+    assert int((out["w"] != 0).sum()) == k
+    # the k kept entries are the largest-|.| ones and exact
+    kept = np.argsort(-np.abs(vec))[:k]
+    np.testing.assert_allclose(np.sort(out["w"][kept]), np.sort(vec[kept]), rtol=1e-6)
+    # EF invariant: sent + residual == corrected (== vec, round 0)
+    np.testing.assert_allclose(out["w"] + res[0], vec, rtol=1e-6, atol=1e-7)
+    # round 2: the residual is carried and folded in
+    comp2, res2, _ = _compress(tree, "topk", ratio=0.05, residuals=res)
+    out2 = wire.decode_pytree(wire.encode_pytree(comp2))
+    np.testing.assert_allclose(out2["w"] + res2[0], vec + res[0], rtol=1e-6, atol=1e-7)
+
+
+def test_compressed_leaf_dense_matches_wire_decode():
+    from fedml_tpu.comm import wire
+
+    comp, _, _ = _compress({"w": np.random.RandomState(2).randn(2048).astype(np.float32)}, "qsgd8")
+    via_wire = wire.decode_pytree(wire.encode_pytree(comp))["w"]
+    np.testing.assert_array_equal(comp["w"].dense(), via_wire)
+
+
+# ---------------------------------------------------------------------------
+# zero-copy fast path
+# ---------------------------------------------------------------------------
+
+def test_decode_returns_views_not_copies():
+    from fedml_tpu.comm import wire
+
+    tree = {"w": np.arange(4096, dtype=np.float32)}
+    data = wire.encode_pytree(tree)
+    out = wire.decode_pytree(data)
+    # raw leaves alias the receive buffer: no ownership, read-only
+    assert not out["w"].flags.owndata
+    assert not out["w"].flags.writeable
+    np.testing.assert_array_equal(out["w"], tree["w"])
+
+
+def test_encode_memory_peak_bounded():
+    """The old encoder duplicated every leaf (tobytes) AND held parts + the
+    joined blob (~2x payload above the output).  The writev path's peak must
+    stay ~1x: the single output allocation plus change."""
+    from fedml_tpu.comm import wire
+
+    payload = 8 << 20  # one 8 MB leaf
+    tree = {"w": np.zeros(payload // 4, np.float32)}
+    wire.encode_pytree(tree)  # warm allocator paths outside the measurement
+    tracemalloc.start()
+    data = wire.encode_pytree(tree)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert len(data) >= payload
+    assert peak < payload * 1.5, f"encode peak {peak} vs payload {payload}"
+    # decode of raw leaves allocates ~nothing (views into data)
+    tracemalloc.start()
+    out = wire.decode_pytree(data)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < payload * 0.25, f"decode peak {peak} vs payload {payload}"
+    del out
+
+
+def test_chunked_encode_and_stream_decoder():
+    from fedml_tpu.comm import wire
+
+    tree = _tree()
+    comp, _, _ = _compress(tree, "qsgd8")
+    chunk_bytes = 1 << 10
+    chunks = list(wire.encode_pytree_chunks(comp, chunk_bytes=chunk_bytes))
+    assert len(chunks) > 3  # the big leaf actually streams
+    assert all(len(bytes(c)) <= chunk_bytes + 512 for c in chunks)
+    dec = wire.PytreeStreamDecoder()
+    seen = []
+    for c in chunks:
+        seen += dec.feed(c)
+    assert dec.complete
+    whole = wire.decode_pytree(b"".join(bytes(c) for c in chunks))
+    np.testing.assert_array_equal(dec.result()["params"]["w"], whole["params"]["w"])
+    assert len(seen) == len(dec.header["leaves"])
+
+
+# ---------------------------------------------------------------------------
+# streaming accumulator vs batch aggregate
+# ---------------------------------------------------------------------------
+
+def _make_aggregator(extra=None):
+    import fedml_tpu
+    from fedml_tpu.cross_silo.server import FedMLAggregator
+    from fedml_tpu.data import loader
+    from fedml_tpu.data.dataset import pad_eval_set
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config()
+    cfg.extra = dict(extra or {})
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    test_arrays = pad_eval_set(ds.test_x, ds.test_y, 32)
+    agg = FedMLAggregator(cfg, model, ds.train_x[: cfg.batch_size], test_arrays)
+    return cfg, agg
+
+
+def _fake_clients(agg, n=3, seed=3):
+    import jax
+
+    r = np.random.RandomState(seed)
+    base = jax.device_get(agg.global_vars)
+    out = {}
+    for cid in range(1, n + 1):
+        out[cid] = (jax.tree_util.tree_map(
+            lambda x: np.asarray(x) + r.randn(*np.shape(x)).astype(np.float32)
+            if np.asarray(x).dtype.kind == "f" else np.asarray(x), base),
+            float(32 * cid))
+    return out
+
+
+def test_exact_path_is_reference_bit_exact():
+    """Compression off -> buffer-all + tree_weighted_mean, bitwise equal to
+    the reference computation (the regression guard for default behavior)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.core import pytree as pt
+
+    _, agg = _make_aggregator()
+    assert not agg.stream_mode
+    clients = _fake_clients(agg)
+    for cid, (params, w) in clients.items():
+        agg.add_local_trained_result(cid, params, w)
+    assert agg.received_count() == 3
+    ids = sorted(clients)
+    stacked = pt.tree_stack([jax.tree_util.tree_map(jnp.asarray, clients[i][0]) for i in ids])
+    weights = jnp.asarray([clients[i][1] for i in ids], jnp.float32)
+    expected = pt.tree_weighted_mean(stacked, weights)
+    got = agg.aggregate(0)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(got)),
+                    jax.tree_util.tree_leaves(jax.device_get(expected))):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_streaming_accumulator_matches_batch_aggregate():
+    """Streaming fold (via real encoded messages) == batch aggregate."""
+    import jax
+
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+
+    _, agg_exact = _make_aggregator()
+    _, agg_stream = _make_aggregator(extra={"streaming_aggregation": True})
+    assert agg_stream.stream_mode
+    clients = _fake_clients(agg_exact)
+    for cid, (params, w) in clients.items():
+        agg_exact.add_local_trained_result(cid, params, w)
+        msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, cid, 0)
+        msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+        decoded = Message.decode(msg.encode())
+        assert agg_stream.ingest_streaming(cid, decoded, w, is_delta=False)
+    assert agg_stream.received_count() == 3
+    assert agg_stream.peak_buffered_updates <= 2
+    exact = agg_exact.aggregate(0)
+    stream = agg_stream.aggregate(0)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(exact)),
+                    jax.tree_util.tree_leaves(jax.device_get(stream))):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_streaming_delta_uploads_match_full_uploads():
+    """w*(global+delta) folds == full-model folds: the delta path's add-back
+    bookkeeping (stream_w_delta) reconstructs the same aggregate."""
+    import jax
+
+    from fedml_tpu.comm.message import Message
+
+    _, agg_full = _make_aggregator(extra={"streaming_aggregation": True})
+    _, agg_delta = _make_aggregator(extra={"streaming_aggregation": True})
+    base = jax.device_get(agg_full.global_vars)
+    clients = _fake_clients(agg_full)
+    for cid, (params, w) in clients.items():
+        assert agg_full.ingest_streaming(
+            cid, Message.decode(_model_msg(params).encode()), w, is_delta=False)
+        delta = jax.tree_util.tree_map(
+            lambda n, g: (np.asarray(n, np.float32) - np.asarray(g, np.float32)).astype(np.asarray(n).dtype),
+            params, base)
+        assert agg_delta.ingest_streaming(
+            cid, Message.decode(_model_msg(delta).encode()), w, is_delta=True)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(agg_full.aggregate(0))),
+                    jax.tree_util.tree_leaves(jax.device_get(agg_delta.aggregate(0)))):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def _model_msg(params):
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+
+    msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 1, 0)
+    msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# e2e: compressed in-proc cross-silo round
+# ---------------------------------------------------------------------------
+
+def test_cross_silo_e2e_qsgd8(eight_devices):
+    """Full protocol with extra.comm_compression=qsgd8: finite accuracy, the
+    acceptance's >= 3.5x payload reduction, and peak buffered updates <= 2
+    regardless of clients-per-round (4 here)."""
+    import fedml_tpu
+    from fedml_tpu.comm import codecs
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(training_type="cross_silo", model="mlp",
+                      client_num_in_total=4, client_num_per_round=4,
+                      comm_round=2, run_id="cs_comp", learning_rate=0.3,
+                      frequency_of_the_test=1)
+    cfg.extra = {"comm_compression": "qsgd8", "mlp_hidden": 512}
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("cs_comp")
+    raw0 = codecs.PAYLOAD_RAW_BYTES.value(codec="qsgd8")
+    wire0 = codecs.PAYLOAD_BYTES.value(codec="qsgd8")
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC")
+               for r in range(1, 5)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    assert server.aggregator.stream_mode
+    try:
+        history = server.run_until_done(timeout=120.0)
+    finally:
+        for c in clients:
+            c.finish()
+    assert len(history) == 2
+    assert np.isfinite(history[-1]["test_acc"])
+    assert history[-1]["test_acc"] > 0.3, history
+    raw_b = codecs.PAYLOAD_RAW_BYTES.value(codec="qsgd8") - raw0
+    wire_b = codecs.PAYLOAD_BYTES.value(codec="qsgd8") - wire0
+    assert raw_b > 0 and wire_b > 0
+    assert raw_b / wire_b >= 3.5, (raw_b, wire_b)
+    assert server.aggregator.peak_buffered_updates <= 2
+
+
+def test_cross_silo_compression_off_unchanged(eight_devices):
+    """Flag unset: stream mode off, uploads are full models over v1 bytes,
+    and the run matches the uncompressed protocol exactly."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(training_type="cross_silo", client_num_in_total=2,
+                      client_num_per_round=2, comm_round=1, run_id="cs_raw",
+                      frequency_of_the_test=1)
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset("cs_raw")
+    captured = []
+    router = InProcRouter.get("cs_raw")
+
+    from fedml_tpu.cross_silo import message_define as md
+
+    orig_route = router.route
+
+    def spy(msg):
+        if msg.get_type() == md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER:
+            captured.append(msg)
+        orig_route(msg)
+
+    router.route = spy
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC") for r in (1, 2)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    assert not server.aggregator.stream_mode
+    try:
+        history = server.run_until_done(timeout=120.0)
+    finally:
+        for c in clients:
+            c.finish()
+    assert len(history) == 1 and np.isfinite(history[0]["test_acc"])
+    assert captured, "no model uploads observed"
+    for msg in captured:
+        assert msg.get(md.MSG_ARG_KEY_MODEL_IS_DELTA, None) is None
+        # the upload's wire bytes are exactly the v1 encoding of its params
+        tensors = {md.MSG_ARG_KEY_MODEL_PARAMS: msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)}
+        blob = msg.encode()
+        clen = int.from_bytes(blob[:4], "little")
+        assert blob[4 + clen:] == _old_v1_encode(tensors)

@@ -18,6 +18,7 @@ import pytest
 #: list when instrumenting a new layer
 INSTRUMENTED_MODULES = [
     "fedml_tpu.comm.base",
+    "fedml_tpu.comm.codecs",
     "fedml_tpu.cross_silo.server",
     "fedml_tpu.obs.health",
     "fedml_tpu.obs.otlp",
@@ -48,6 +49,23 @@ def test_global_registry_names_are_namespaced_and_unique():
     # one family per name — the registry's dict keying guarantees it; keep
     # the invariant asserted so a refactor can't silently lose it
     assert len(names) == len(set(names))
+
+
+def test_comm_compression_families_registered():
+    """ISSUE-4 families must exist under the fedml_comm_*/fedml_crosssilo_*
+    namespaces (the lint above then validates their shapes)."""
+    for mod in INSTRUMENTED_MODULES:
+        importlib.import_module(mod)
+    from fedml_tpu.obs.registry import REGISTRY
+
+    names = {fam["name"] for fam in REGISTRY.snapshot()}
+    for required in (
+        "fedml_comm_payload_bytes_total",
+        "fedml_comm_payload_raw_bytes_total",
+        "fedml_comm_compression_ratio",
+        "fedml_crosssilo_buffered_updates_peak",
+    ):
+        assert required in names, f"{required} not registered"
 
 
 def test_conflicting_reregistration_is_refused():
