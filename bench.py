@@ -349,6 +349,19 @@ def main():
     # The parent must NOT import jax: initializing the TPU runtime here would
     # hold the process-exclusive device lock and starve both child benches.
     # Device identity/peak come back in the children's results.
+    # Static-analysis trajectory (ISSUE 5): the finding count rides the bench
+    # JSON so the record shows the codebase staying clean round over round.
+    # The lint engine is pure stdlib-ast (no jax), so it is parent-safe.
+    from fedml_tpu.analysis.engine import run_lint
+
+    pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fedml_tpu")
+    lint_res = run_lint(pkg, baseline=os.path.join(pkg, "analysis", "baseline.json"))
+    lint_section = {
+        "findings": len(lint_res.findings),
+        "suppressed": len(lint_res.suppressed),
+        "baselined": len(lint_res.baselined),
+        "by_rule": lint_res.counts_by_rule(),
+    }
     llm = _subprocess_bench("llm")
     fedavg = _subprocess_bench("fedavg")
     # round-6 A/B: the identical FedAvg recipe with conv epilogues through
@@ -400,6 +413,7 @@ def main():
             "fedavg_cifar10_resnet20_fused": fedavg_fused,
             "fedavg_fused_speedup": fused_speedup,
             "crosssilo_comm": crosssilo,
+            "lint": lint_section,
         },
     }))
     if violations:

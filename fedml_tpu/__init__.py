@@ -41,7 +41,9 @@ def init(args: Optional[Config] = None, argv=None) -> Config:
     requested = getattr(cfg, "backend_sim", "") in (
         "MULTIPROCESS", constants.SIMULATION_BACKEND_MPI,
     )
-    if requested or (getattr(cfg, "extra", {}) or {}).get("coordinator_address"):
+    from .core.flags import cfg_extra
+
+    if requested or cfg_extra(cfg, "coordinator_address"):
         up = multihost.ensure_initialized(cfg)
         if requested and not up:
             # an explicitly requested multi-process backend must never

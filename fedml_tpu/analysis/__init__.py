@@ -1,0 +1,36 @@
+"""Static analysis for the framework's hard-won invariants (``fedml-tpu lint``).
+
+A stdlib-``ast`` engine (no third-party linter deps) with a rule-plugin
+architecture: :mod:`.engine` parses every module of a package once into
+:class:`~fedml_tpu.analysis.engine.ModuleInfo` and hands the shared walk to
+per-rule visitors under :mod:`.rules`.  Findings carry ``file:line``, a rule
+id, a severity, and a stable key; a checked-in suppression baseline
+(``baseline.json``, shipped empty) plus inline
+``# graftlint: disable=GLxxx(reason)`` comments are the only two ways to
+silence one.
+
+Rules (each encodes a failure mode this codebase hit for real):
+
+====== ======================================================================
+GL001  flag-registry: every ``cfg.extra`` flag read must be declared in
+       ``core/flags.py`` (type, default, doc); dead declarations and legacy
+       access idioms are findings too.
+GL002  jit-purity: host side effects (wall clocks, np.random, logging,
+       global metrics, nonlocal mutation) inside functions handed to
+       ``jax.jit``/``pjit``/``lax.scan``/``pallas_call``.
+GL003  donation-safety: reading a variable after it was passed in a
+       ``donate_argnums`` position of a jitted call (donated buffers are
+       invalid — and corrupt the heap on XLA:CPU, see ``sim/engine.py``).
+GL004  lock-discipline: attributes guarded by a ``threading.Lock`` in one
+       method but accessed without it elsewhere in the same class.
+GL005  metric-namespace: every global-registry metric family must match
+       ``fedml_[a-z0-9_]+`` with valid label names.
+====== ======================================================================
+
+Entry points: ``python -m fedml_tpu.cli lint`` and
+:func:`fedml_tpu.analysis.engine.run_lint` (the tier-1 test wraps the
+latter over the real package).
+"""
+
+from .engine import LintResult, run_lint  # noqa: F401
+from .findings import Finding  # noqa: F401

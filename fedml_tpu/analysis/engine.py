@@ -1,0 +1,151 @@
+"""Shared module walker + rule runner behind ``fedml-tpu lint``.
+
+Every ``.py`` file under the target package parses ONCE into a
+:class:`ModuleInfo` (AST + source + suppression map); each rule then visits
+the shared trees.  Rules are two-phase: :meth:`Rule.check_module` per module,
+then :meth:`Rule.finalize` with the full module list for cross-module
+invariants (GL001's dead-declaration check needs every read site in the
+package before it can call a declaration dead).
+
+Suppression scoping happens here, not in the rules: a
+``# graftlint: disable=GLxxx`` on a ``def``/``class`` line covers the whole
+body, so "caller holds the lock" methods carry ONE annotated suppression
+instead of one per line.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field as dc_field
+from pathlib import Path
+from typing import Iterable, Optional, Sequence
+
+from .findings import Finding, load_baseline, parse_suppressions
+
+
+class ModuleInfo:
+    """One parsed module: path, AST, source, and the expanded suppression map."""
+
+    def __init__(self, relpath: str, source: str, tree: Optional[ast.Module] = None):
+        self.relpath = relpath  # posix, relative to the linted package root
+        self.source = source
+        self.tree = tree if tree is not None else ast.parse(source, filename=relpath)
+        # line -> rule ids silenced there; def/class-line directives expand
+        # to the node's whole span so one annotation covers a method
+        self._suppressions = parse_suppressions(source)
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                ids = self._suppressions.get(node.lineno)
+                if ids:
+                    for line in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+                        self._suppressions.setdefault(line, set()).update(ids)
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        return rule_id in self._suppressions.get(line, ())
+
+
+class Rule:
+    """Base rule plugin: an id, a one-line title, and the two visit hooks."""
+
+    id: str = "GL000"
+    title: str = ""
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, modules: Sequence[ModuleInfo]) -> Iterable[Finding]:
+        return ()
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]                      # active (not suppressed/baselined)
+    suppressed: list[Finding] = dc_field(default_factory=list)
+    baselined: list[Finding] = dc_field(default_factory=list)
+    errors: list[str] = dc_field(default_factory=list)  # unparseable files
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+    def counts_by_rule(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines += [f"lint: failed to parse {e}" for e in self.errors]
+        status = "clean" if self.ok else f"{len(self.findings)} finding(s)"
+        tail = (f"lint: {status}"
+                f" ({len(self.suppressed)} suppressed, {len(self.baselined)} baselined)")
+        return "\n".join(lines + [tail])
+
+
+def default_rules() -> list[Rule]:
+    from .rules import ALL_RULES
+
+    return [cls() for cls in ALL_RULES]
+
+
+def iter_modules(root: str | Path) -> tuple[list[ModuleInfo], list[str]]:
+    """Parse every ``*.py`` under ``root`` (or the single file ``root``).
+    Returns (modules, unparseable-file descriptions)."""
+    rootp = Path(root)
+    paths = [rootp] if rootp.is_file() else sorted(rootp.rglob("*.py"))
+    modules, errors = [], []
+    for p in paths:
+        if "__pycache__" in p.parts:
+            continue
+        rel = p.name if rootp.is_file() else p.relative_to(rootp).as_posix()
+        try:
+            modules.append(ModuleInfo(rel, p.read_text()))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(f"{rel}: {e}")
+    return modules, errors
+
+
+def run_lint(root: str | Path, rules: Optional[Sequence[Rule]] = None,
+             baseline: Optional[str | Path] = None) -> LintResult:
+    """The full pass: parse package, run every rule, split findings into
+    active / inline-suppressed / baselined."""
+    modules, errors = iter_modules(root)
+    by_rel = {m.relpath: m for m in modules}
+    rules = list(rules) if rules is not None else default_rules()
+    raw: list[Finding] = []
+    for rule in rules:
+        for mod in modules:
+            raw.extend(rule.check_module(mod))
+        raw.extend(rule.finalize(modules))
+    baseline_keys = load_baseline(baseline) if baseline else set()
+    result = LintResult(findings=[], errors=errors)
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule, f.symbol)):
+        mod = by_rel.get(f.path)
+        if mod is not None and mod.is_suppressed(f.rule, f.line):
+            result.suppressed.append(f)
+        elif f.key in baseline_keys:
+            result.baselined.append(f)
+        else:
+            result.findings.append(f)
+    return result
+
+
+# -- tiny shared AST helpers used by several rules ---------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.lax.scan' for Attribute/Name chains; '' when not a plain chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

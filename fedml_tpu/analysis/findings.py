@@ -1,0 +1,100 @@
+"""Findings model: the result record, inline suppressions, and the baseline.
+
+A :class:`Finding` is one rule violation at one site.  Its :attr:`Finding.key`
+is deliberately line-independent (``rule:path:symbol``) so baseline entries
+survive unrelated edits above the finding; only when a rule has no natural
+symbol does the line number anchor the key.
+
+Suppressions are pylint-style comments::
+
+    x = extra.get("weird")  # graftlint: disable=GL001(migrating in PR 12)
+    def caller_holds_lock(self):  # graftlint: disable=GL004(single caller owns _agg_lock)
+
+A suppression on a ``def``/``class`` line covers that whole body; anywhere
+else it covers its own line.  The reason in parentheses is required reading
+for reviewers, not parsed.
+
+The baseline (``analysis/baseline.json``) is the escape hatch for
+pre-existing findings a PR cannot fix; this repo ships it EMPTY — the
+tier-1 gate means every new finding is either fixed or suppressed inline
+with a reason, never silently baselined.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable
+
+SEVERITIES = ("error", "warning")
+
+_DISABLE_RE = re.compile(r"graftlint:\s*disable=([A-Za-z0-9_,()\s][^#]*)")
+_RULE_ID_RE = re.compile(r"(GL\d{3})(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Finding:
+    rule: str          # "GL001"
+    path: str          # package-relative posix path, e.g. "cross_silo/server.py"
+    line: int
+    message: str
+    severity: str = "error"
+    symbol: str = ""   # stable anchor (flag name, attribute, metric family)
+
+    @property
+    def key(self) -> str:
+        anchor = self.symbol if self.symbol else f"L{self.line}"
+        return f"{self.rule}:{self.path}:{anchor}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.severity}] {self.message}"
+
+
+def parse_suppressions(source: str) -> dict[int, set[str]]:
+    """``{lineno: {rule ids disabled on that line}}`` from graftlint comments.
+
+    Works on raw source lines (not tokenize) so even syntactically bold
+    fixture snippets parse; a ``#`` inside a string literal that happens to
+    spell a directive would over-suppress, which is harmless and unheard of.
+    """
+    out: dict[int, set[str]] = {}
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        if "graftlint" not in text:
+            continue
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        ids = {rid for rid, _reason in _RULE_ID_RE.findall(m.group(1))}
+        if ids:
+            out.setdefault(lineno, set()).update(ids)
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str | Path) -> set[str]:
+    """The set of finding keys grandfathered by the checked-in baseline."""
+    p = Path(path)
+    if not p.exists():
+        return set()
+    doc = json.loads(p.read_text())
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {p}: {doc.get('version')!r}")
+    return {entry["key"] for entry in doc.get("findings", [])}
+
+
+def save_baseline(path: str | Path, findings: Iterable[Finding]) -> None:
+    doc = {
+        "version": BASELINE_VERSION,
+        "findings": [
+            {"key": f.key, "rule": f.rule, "path": f.path, "line": f.line,
+             "message": f.message}
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    Path(path).write_text(json.dumps(doc, indent=1) + "\n")

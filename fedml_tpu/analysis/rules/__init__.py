@@ -1,0 +1,23 @@
+"""Rule plugins for ``fedml-tpu lint`` — one module per rule.
+
+Adding a rule: subclass :class:`fedml_tpu.analysis.engine.Rule`, give it the
+next ``GLxxx`` id, and append the class to :data:`ALL_RULES`; the engine,
+CLI, baseline, and suppression syntax pick it up with no further wiring.
+"""
+
+from .gl001_flags import FlagRegistryRule
+from .gl002_jit_purity import JitPurityRule
+from .gl003_donation import DonationSafetyRule
+from .gl004_locks import LockDisciplineRule
+from .gl005_metrics import MetricNamespaceRule
+
+ALL_RULES = [
+    FlagRegistryRule,
+    JitPurityRule,
+    DonationSafetyRule,
+    LockDisciplineRule,
+    MetricNamespaceRule,
+]
+
+__all__ = ["ALL_RULES", "FlagRegistryRule", "JitPurityRule", "DonationSafetyRule",
+           "LockDisciplineRule", "MetricNamespaceRule"]

@@ -1,0 +1,159 @@
+"""GL001 — flag-registry: every ``cfg.extra`` read declared, no dead flags.
+
+Detected read idioms (all must name a flag declared in ``core/flags.py``):
+
+- ``cfg_extra(cfg, "name"[, default])`` — the blessed accessor;
+- ``extra.get("name", ...)`` / ``extra.setdefault("name", ...)`` /
+  ``extra["name"]`` / ``"name" in extra`` where the receiver is extra-like
+  (a ``cfg.extra`` attribute, a ``getattr(cfg, "extra", ...)`` expression,
+  or a local assigned from one) — these legacy idioms additionally get a
+  migrate-to-``cfg_extra`` finding so the accessor stays the ONE idiom;
+- ``getattr(cfg, "name", default)`` duck-typed fallthrough reads, counted
+  only when ``name`` is already declared (an undeclared duck-typed read is
+  indistinguishable from a normal attribute — ``cfg_extra`` catches those
+  at runtime instead).
+
+Cross-module direction: a declaration with no read anywhere in the package
+is dead and flagged at its line in ``core/flags.py``.  The registry is read
+STATICALLY (the ``FlagSpec(...)`` calls in the flags module), so fixtures
+can lint self-contained packages without importing anything.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Sequence
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name, str_const
+
+FLAGS_MODULE = "core/flags.py"
+
+#: receivers whose ``.get``/subscript is an extra read even without tracking
+#: an assignment (the near-universal local variable name)
+_EXTRA_NAMES = {"extra"}
+
+
+def _is_extra_expr(node: ast.AST, extra_vars: set[str]) -> bool:
+    """Does this expression evaluate to a cfg.extra dict?"""
+    if isinstance(node, ast.Name):
+        return node.id in extra_vars or node.id in _EXTRA_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr == "extra"
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn == "getattr" and len(node.args) >= 2 and str_const(node.args[1]) == "extra":
+            return True
+        if fn == "dict" and node.args and _is_extra_expr(node.args[0], extra_vars):
+            return True
+        return False
+    if isinstance(node, ast.BoolOp):  # (getattr(cfg, "extra", {}) or {})
+        return any(_is_extra_expr(v, extra_vars) for v in node.values)
+    return False
+
+
+def declared_flags(flags_mod: ModuleInfo) -> dict[str, int]:
+    """{flag name: declaration line} from the FlagSpec(...) calls."""
+    out: dict[str, int] = {}
+    for node in ast.walk(flags_mod.tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func).endswith("FlagSpec"):
+            name = str_const(node.args[0]) if node.args else None
+            if name is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name = str_const(kw.value)
+            if name is not None:
+                out[name] = node.lineno
+    return out
+
+
+class _ReadSite:
+    __slots__ = ("name", "line", "legacy", "duck")
+
+    def __init__(self, name: Optional[str], line: int, legacy: bool, duck: bool = False):
+        self.name = name      # None = non-literal flag name
+        self.line = line
+        self.legacy = legacy  # pre-cfg_extra idiom
+        self.duck = duck      # getattr(cfg, "<flag>", ...) fallthrough
+
+
+def _collect_reads(mod: ModuleInfo, declared: dict[str, int]) -> list[_ReadSite]:
+    extra_vars: set[str] = set()
+    reads: list[_ReadSite] = []
+    for node in ast.walk(mod.tree):
+        # track `extra = getattr(cfg, "extra", {}) or {}` style locals
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and _is_extra_expr(node.value, extra_vars):
+            extra_vars.add(node.targets[0].id)
+            continue
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn.split(".")[-1] == "cfg_extra" and len(node.args) >= 2:
+                reads.append(_ReadSite(str_const(node.args[1]), node.lineno, legacy=False))
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("get", "setdefault") \
+                    and node.args and _is_extra_expr(node.func.value, extra_vars):
+                reads.append(_ReadSite(str_const(node.args[0]), node.lineno, legacy=True))
+                continue
+            if fn == "getattr" and len(node.args) >= 2:
+                name = str_const(node.args[1])
+                if name in declared:
+                    reads.append(_ReadSite(name, node.lineno, legacy=False, duck=True))
+                continue
+        if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load) \
+                and _is_extra_expr(node.value, extra_vars):
+            reads.append(_ReadSite(str_const(node.slice), node.lineno, legacy=True))
+            continue
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and _is_extra_expr(node.comparators[0], extra_vars):
+            reads.append(_ReadSite(str_const(node.left), node.lineno, legacy=True))
+    return reads
+
+
+class FlagRegistryRule(Rule):
+    id = "GL001"
+    title = "cfg.extra flag reads must be declared in core/flags.py (and vice versa)"
+
+    # whole-rule runs in finalize: the registry module can sort after its
+    # readers, so per-module checking would race the declaration harvest
+    def finalize(self, modules: Sequence[ModuleInfo]) -> Iterable[Finding]:
+        flags_mod = next((m for m in modules if m.relpath.endswith(FLAGS_MODULE)), None)
+        declared = declared_flags(flags_mod) if flags_mod is not None else {}
+        used: set[str] = set()
+        findings: list[Finding] = []
+        for mod in modules:
+            if mod.relpath.endswith(FLAGS_MODULE):
+                continue  # the accessor's own extra.get is not a flag read site
+            for site in _collect_reads(mod, declared):
+                if site.name is None:
+                    findings.append(Finding(
+                        self.id, mod.relpath, site.line,
+                        "extra flag read with a non-literal name — GL001 cannot "
+                        "verify it against the registry; use a literal flag name",
+                        symbol=f"nonliteral:L{site.line}"))
+                    continue
+                used.add(site.name)
+                if not site.duck and site.name not in declared:
+                    findings.append(Finding(
+                        self.id, mod.relpath, site.line,
+                        f"extra flag {site.name!r} is not declared in core/flags.py "
+                        "(add a FlagSpec with type, default, and doc)",
+                        symbol=f"undeclared:{site.name}"))
+                if site.legacy:
+                    findings.append(Finding(
+                        self.id, mod.relpath, site.line,
+                        f"legacy extra access for {site.name!r} — read it via "
+                        "cfg_extra(cfg, name, default) from core/flags.py",
+                        symbol=f"legacy:{site.name}"))
+        if flags_mod is not None:
+            findings += [
+                Finding(self.id, flags_mod.relpath, line,
+                        f"flag {name!r} is declared but never read anywhere in the "
+                        "package — delete the declaration or wire the feature",
+                        symbol=f"dead:{name}")
+                for name, line in sorted(declared.items())
+                if name not in used
+            ]
+        return findings

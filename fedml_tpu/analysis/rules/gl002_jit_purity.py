@@ -1,0 +1,184 @@
+"""GL002 — jit-purity: no host side effects inside traced functions.
+
+A function handed to ``jax.jit`` / ``pjit`` / ``jax.lax.scan`` /
+``pl.pallas_call`` runs ONCE at trace time; host side effects inside it
+silently execute at compile time (wall clocks measure tracing, metrics
+record once, ``np.random`` freezes a single draw into the program) — the
+exact class of bug FedJAX's design notes warn a JAX FL stack about.
+
+Flagged inside a traced function body:
+
+- host clocks: ``time.time/perf_counter/monotonic/sleep``, ``datetime.now``;
+- host randomness: ``np.random.*`` / ``random.*`` (JAX keys are fine);
+- logging/printing: ``print``, ``log.*``/``logger.*``/``logging.*``;
+- global metrics: calls on module-level objects created from
+  ``REGISTRY.counter/gauge/histogram``, or any ``REGISTRY.*`` chain;
+- ``global`` / ``nonlocal`` declarations (trace-time host mutation).
+
+The rule resolves the traced callable statically when it is a lambda, a
+local ``def`` in the enclosing scope, or a module-level ``def``; dynamic
+targets (``self._fn``, call results) are out of scope — the donation rule
+and runtime behavior cover those.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name
+
+#: call-chain suffixes that enter tracing with the callable as first arg
+JIT_ENTRY_SUFFIXES = ("jax.jit", "jit", "pjit", "jax.lax.scan", "lax.scan",
+                      "pallas_call", "pl.pallas_call")
+
+_CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic", "time.sleep",
+                "time.process_time", "datetime.now", "datetime.utcnow",
+                "datetime.datetime.now", "datetime.datetime.utcnow"}
+_LOG_RECEIVERS = {"log", "logger", "logging"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception", "critical"}
+_METRIC_METHODS = {"observe", "inc", "set", "labels"}
+
+
+def _is_jit_entry(fn_chain: str) -> bool:
+    return any(fn_chain == s or fn_chain.endswith("." + s) for s in JIT_ENTRY_SUFFIXES)
+
+
+def module_metric_names(tree: ast.Module) -> set[str]:
+    """Module-level names bound to REGISTRY.counter/gauge/histogram(...)."""
+    out: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            chain = dotted_name(node.value.func)
+            if "REGISTRY." in chain and chain.rsplit(".", 1)[-1] in (
+                    "counter", "gauge", "histogram"):
+                out.update(t.id for t in node.targets if isinstance(t, ast.Name))
+    return out
+
+
+class _ImpurityScan(ast.NodeVisitor):
+    def __init__(self, metric_names: set[str]):
+        self.metric_names = metric_names
+        self.hits: list[tuple[int, str]] = []  # (line, description)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.hits.append((node.lineno, f"`global {', '.join(node.names)}` mutation"))
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        self.hits.append((node.lineno, f"`nonlocal {', '.join(node.names)}` mutation"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = dotted_name(node.func)
+        tail = chain.rsplit(".", 1)[-1] if chain else ""
+        if chain in _CLOCK_CALLS or (chain and any(
+                chain.endswith("." + c) for c in _CLOCK_CALLS)):
+            self.hits.append((node.lineno, f"host clock call {chain}()"))
+        elif chain == "print":
+            self.hits.append((node.lineno, "print()"))
+        elif chain.startswith(("np.random.", "numpy.random.", "random.")):
+            self.hits.append((node.lineno, f"host randomness {chain}()"))
+        elif "REGISTRY." in chain:
+            self.hits.append((node.lineno, f"global metrics registry call {chain}()"))
+        elif isinstance(node.func, ast.Attribute) and tail in _LOG_METHODS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in _LOG_RECEIVERS:
+            self.hits.append((node.lineno, f"logging call {chain}()"))
+        elif isinstance(node.func, ast.Attribute) and tail in _METRIC_METHODS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in self.metric_names:
+            self.hits.append(
+                (node.lineno, f"metric mutation {chain}() on a registry family"))
+        self.generic_visit(node)
+
+
+def _local_defs(scope_body: list[ast.stmt]) -> dict[str, ast.AST]:
+    """name -> FunctionDef/Lambda bound directly in this statement list."""
+    out: dict[str, ast.AST] = {}
+    for stmt in scope_body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[stmt.name] = stmt
+        elif isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Lambda):
+            out.update({t.id: stmt.value for t in stmt.targets
+                        if isinstance(t, ast.Name)})
+    return out
+
+
+class JitPurityRule(Rule):
+    id = "GL002"
+    title = "host side effects inside jit/pjit/scan/pallas_call traced functions"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        metric_names = module_metric_names(mod.tree)
+        module_defs = _local_defs(mod.tree.body)
+        findings: list[Finding] = []
+
+        def resolve(candidate: ast.AST, scopes: list[dict[str, ast.AST]]) -> Optional[ast.AST]:
+            if isinstance(candidate, ast.Lambda):
+                return candidate
+            if isinstance(candidate, ast.Name):
+                for defs in reversed(scopes):
+                    if candidate.id in defs:
+                        return defs[candidate.id]
+            return None
+
+        seen: set[tuple[str, int, str]] = set()
+
+        def scan_target(target: ast.AST, entry: str, entry_line: int, fn_name: str) -> None:
+            scanner = _ImpurityScan(metric_names)
+            if isinstance(target, ast.Lambda):
+                scanner.visit(target.body)
+            else:  # FunctionDef: the whole body, nested closures included —
+                for stmt in target.body:  # they trace with it
+                    scanner.visit(stmt)
+            for line, what in scanner.hits:
+                if (fn_name, line, what) in seen:
+                    continue
+                seen.add((fn_name, line, what))
+                findings.append(Finding(
+                    self.id, mod.relpath, line,
+                    f"{what} inside {fn_name!r}, traced by {entry} at line "
+                    f"{entry_line} — hoist host side effects out of traced code",
+                    symbol=f"{fn_name}:L{line}"))
+
+        def shallow_walk(stmt: ast.stmt):
+            """Walk a statement without descending into nested function/class
+            bodies — those belong to the recursive scope walk below."""
+            stack: list[ast.AST] = [stmt]
+            while stack:
+                node = stack.pop()
+                yield node
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        continue
+                    stack.append(child)
+
+        def walk_scope(body: list[ast.stmt], scopes: list[dict[str, ast.AST]]) -> None:
+            defs = _local_defs(body)
+            scopes = scopes + [defs]
+            for stmt in body:
+                # decorator form: @jax.jit / @partial(jax.jit, ...)
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in stmt.decorator_list:
+                        chain = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+                        inner = ""
+                        if isinstance(dec, ast.Call) and chain.endswith("partial") and dec.args:
+                            inner = dotted_name(dec.args[0])
+                        if _is_jit_entry(chain) or _is_jit_entry(inner):
+                            scan_target(stmt, chain or inner, stmt.lineno, stmt.name)
+                for node in shallow_walk(stmt):
+                    if isinstance(node, ast.Call) and _is_jit_entry(dotted_name(node.func)):
+                        if not node.args:
+                            continue
+                        target = resolve(node.args[0], scopes)
+                        if target is None:
+                            continue
+                        fn_name = (node.args[0].id if isinstance(node.args[0], ast.Name)
+                                   else "<lambda>")
+                        scan_target(target, dotted_name(node.func), node.lineno, fn_name)
+                # recurse into nested function bodies with their scope chain
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    walk_scope(stmt.body, scopes)
+
+        walk_scope(mod.tree.body, [module_defs])
+        return findings

@@ -1,0 +1,140 @@
+"""GL003 — donation-safety: never read a variable after donating it.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument buffers to XLA for
+in-place reuse: the caller's arrays are invalid afterwards, and on XLA:CPU
+(jax 0.4.37) touching them corrupts the heap outright — the tier-1 suite's
+historical wandering segfaults (``sim/engine.py``, ROADMAP).  The rule
+tracks, per function scope:
+
+1. names bound to ``jax.jit(fn, donate_argnums=<positions>)`` (constant
+   tuples/ints, ``name = <const>`` indirection, and either arm of a
+   conditional expression are resolved);
+2. calls through those names — positional args that are plain names become
+   tainted at the call line;
+3. any later ``Load`` of a tainted name in the same scope is a finding,
+   until an assignment rebinds it (the ``x = donating_fn(x)`` idiom is the
+   correct pattern and stays clean).
+
+Starred/keyword args and attribute targets are out of static reach and are
+skipped — the rule is deliberately precise-over-complete so every finding
+is actionable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name
+
+
+def _const_positions(node: ast.AST, env: dict[str, ast.AST], depth: int = 0) -> Optional[set[int]]:
+    """Evaluate a donate_argnums expression to a set of argument positions."""
+    if depth > 4:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: set[int] = set()
+        for elt in node.elts:
+            got = _const_positions(elt, env, depth + 1)
+            if got is None:
+                return None
+            out |= got
+        return out
+    if isinstance(node, ast.IfExp):  # e.g. () if cpu else (0, 1, 2)
+        a = _const_positions(node.body, env, depth + 1) or set()
+        b = _const_positions(node.orelse, env, depth + 1) or set()
+        return a | b  # conservative union: donated on SOME path = donated
+    if isinstance(node, ast.Name) and node.id in env:
+        return _const_positions(env[node.id], env, depth + 1)
+    return None
+
+
+def _jit_donations(call: ast.Call, env: dict[str, ast.AST]) -> Optional[set[int]]:
+    """Donated positions when ``call`` is a jax.jit/pjit with donate_argnums."""
+    chain = dotted_name(call.func)
+    if chain.rsplit(".", 1)[-1] not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            if kw.arg == "donate_argnames":
+                return set()  # names unmappable statically; still jit-tracked
+            return _const_positions(kw.value, env)
+    return None
+
+
+class DonationSafetyRule(Rule):
+    id = "GL003"
+    title = "variable read after being donated to a jitted call"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+
+        def scan_scope(body: list[ast.stmt]) -> None:
+            env: dict[str, ast.AST] = {}          # simple name -> last value expr
+            donating: dict[str, set[int]] = {}    # jitted-fn name -> positions
+            tainted: dict[str, int] = {}          # var -> donation line
+
+            class ScopeVisitor(ast.NodeVisitor):
+                def visit_FunctionDef(self, node):  # new scope: recurse separately
+                    scan_scope(node.body)
+
+                visit_AsyncFunctionDef = visit_FunctionDef
+
+                def visit_ClassDef(self, node):
+                    scan_scope(node.body)
+
+                def visit_Lambda(self, node):
+                    pass  # separate (expression) scope; nothing donated inside
+
+                def visit_Assign(self, node):
+                    self.visit(node.value)
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            env[t.id] = node.value
+                            tainted.pop(t.id, None)  # rebinding un-taints
+                            donated = (_jit_donations(node.value, env)
+                                       if isinstance(node.value, ast.Call) else None)
+                            if donated:
+                                donating[t.id] = donated
+
+                def visit_Call(self, node):
+                    # direct jax.jit(f, donate_argnums=...)(a, b) application
+                    donated: Optional[set[int]] = None
+                    if isinstance(node.func, ast.Call):
+                        donated = _jit_donations(node.func, env)
+                    elif isinstance(node.func, ast.Name) and node.func.id in donating:
+                        donated = donating[node.func.id]
+                    if donated:
+                        for pos, arg in enumerate(node.args):
+                            if isinstance(arg, ast.Starred):
+                                break  # positions unknowable past a splat
+                            if pos in donated and isinstance(arg, ast.Name):
+                                tainted.setdefault(arg.id, node.lineno)
+                        # args themselves are reads AT the call — fine; visit
+                        # keywords/func only so the donated args don't self-flag
+                        for kw in node.keywords:
+                            self.visit(kw.value)
+                        return
+                    self.generic_visit(node)
+
+                def visit_Name(self, node):
+                    if isinstance(node.ctx, ast.Load) and node.id in tainted \
+                            and node.lineno > tainted[node.id]:
+                        findings.append(Finding(
+                            DonationSafetyRule.id, mod.relpath, node.lineno,
+                            f"{node.id!r} was donated to a jitted call at line "
+                            f"{tainted[node.id]} (donate_argnums) and read again "
+                            "here — donated buffers are invalid after the call "
+                            "(and corrupt the heap on XLA:CPU)",
+                            symbol=f"{node.id}:L{node.lineno}"))
+                    elif isinstance(node.ctx, ast.Store):
+                        tainted.pop(node.id, None)
+
+            v = ScopeVisitor()
+            for stmt in body:
+                v.visit(stmt)
+
+        scan_scope(mod.tree.body)
+        return findings

@@ -1,0 +1,139 @@
+"""GL004 — lock-discipline: guarded attributes stay guarded.
+
+For every class that owns a ``threading.Lock``/``RLock`` (an attribute
+assigned ``threading.Lock()`` anywhere in the class), the rule computes the
+set of instance attributes WRITTEN inside ``with self.<lock>:`` blocks — the
+class's own declaration of what the lock protects — and then flags any
+read or write of those attributes outside a lock-held region in any other
+method.  The threaded comm managers and ``FedMLServerManager._agg_lock``
+are the motivating targets: the receive-loop thread, the straggler
+``threading.Timer``, and the caller's thread all touch round state.
+
+Conventions the rule understands:
+
+- ``__init__``/``__new__`` are construction — no concurrent access exists
+  yet, so unguarded writes there are fine (they typically CREATE the
+  guarded state);
+- a method that runs entirely with the lock held by its caller carries one
+  ``# graftlint: disable=GL004(caller holds <lock>)`` on its ``def`` line —
+  the suppression IS the documentation of that invariant;
+- nested functions defined inside a ``with self._lock:`` block count as
+  lock-held (they run under the caller's critical section only if called
+  there, which is the dominant pattern; escaping closures deserve the
+  finding anyway).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name
+
+_CTOR_METHODS = {"__init__", "__new__"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    """self.<X> assigned threading.Lock()/RLock() anywhere in the class."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            chain = dotted_name(node.value.func)
+            if chain.rsplit(".", 1)[-1] in ("Lock", "RLock"):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        out.add(t.attr)
+    return out
+
+
+def _is_lock_withitem(item: ast.withitem, locks: set[str]) -> bool:
+    ctx = item.context_expr
+    if isinstance(ctx, ast.Attribute) and isinstance(ctx.value, ast.Name) \
+            and ctx.value.id == "self" and ctx.attr in locks:
+        return True
+    # self._lock.acquire_timeout()-style helpers: treat any with on the lock
+    # attribute's methods as holding it
+    if isinstance(ctx, ast.Call) and isinstance(ctx.func, ast.Attribute):
+        inner = ctx.func.value
+        if isinstance(inner, ast.Attribute) and isinstance(inner.value, ast.Name) \
+                and inner.value.id == "self" and inner.attr in locks:
+            return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+class _MethodAccesses(ast.NodeVisitor):
+    """(attr, line, is_write, lock_held) for every self.<attr> access."""
+
+    def __init__(self, locks: set[str]):
+        self.locks = locks
+        self.held = 0
+        self.accesses: list[tuple[str, int, bool, bool]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        lock_items = sum(1 for item in node.items if _is_lock_withitem(item, self.locks))
+        for item in node.items:
+            self.visit(item.context_expr)
+        self.held += lock_items
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= lock_items
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr and attr not in self.locks:
+            self.accesses.append(
+                (attr, node.lineno, isinstance(node.ctx, (ast.Store, ast.Del)),
+                 self.held > 0))
+        self.generic_visit(node)
+
+
+class LockDisciplineRule(Rule):
+    id = "GL004"
+    title = "attribute guarded by a lock in one method, accessed bare elsewhere"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _lock_attrs(cls)
+            if not locks:
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+            per_method: dict[str, list[tuple[str, int, bool, bool]]] = {}
+            guarded: set[str] = set()
+            guarded_in: dict[str, str] = {}
+            for m in methods:
+                v = _MethodAccesses(locks)
+                for stmt in m.body:
+                    v.visit(stmt)
+                per_method[m.name] = v.accesses
+                for attr, _line, is_write, held in v.accesses:
+                    if held and is_write:
+                        guarded.add(attr)
+                        guarded_in.setdefault(attr, m.name)
+            if not guarded:
+                continue
+            for m in methods:
+                if m.name in _CTOR_METHODS:
+                    continue
+                for attr, line, is_write, held in per_method[m.name]:
+                    if attr in guarded and not held:
+                        verb = "written" if is_write else "read"
+                        findings.append(Finding(
+                            self.id, mod.relpath, line,
+                            f"{cls.name}.{attr} is written under the lock in "
+                            f"{guarded_in[attr]}() but {verb} here without it — "
+                            "take the lock or document the single-writer "
+                            "invariant with a GL004 suppression",
+                            symbol=f"{cls.name}.{attr}:L{line}"))
+        return findings

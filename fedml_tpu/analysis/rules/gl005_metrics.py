@@ -1,0 +1,77 @@
+"""GL005 — metric-namespace: registry families match ``fedml_[a-z0-9_]+``.
+
+The static half of ``tests/test_metric_lint.py`` (which imports every
+instrumented module and asserts over the live registry — it now delegates
+its name/label validation here): every ``REGISTRY.counter/gauge/histogram``
+call with a literal family name must carry the ``fedml_`` namespace, label
+names must be valid Prometheus label identifiers, and ``le`` is reserved
+for histogram buckets.  Catching it in lint means a bad family name fails
+before anything imports, including in modules no test exercises yet.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name, str_const
+
+METRIC_NAME_RE = re.compile(r"fedml_[a-z0-9_]+")
+LABEL_RE = re.compile(r"[a-z][a-z0-9_]*")
+_FACTORIES = ("counter", "gauge", "histogram")
+
+
+def _is_registry_call(call: ast.Call) -> bool:
+    chain = dotted_name(call.func)
+    if "." not in chain:
+        return False
+    recv, tail = chain.rsplit(".", 1)
+    return tail in _FACTORIES and recv.rsplit(".", 1)[-1] == "REGISTRY"
+
+
+class MetricNamespaceRule(Rule):
+    id = "GL005"
+    title = "global-registry metric families must be fedml_-namespaced"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and _is_registry_call(node)):
+                continue
+            name = str_const(node.args[0]) if node.args else None
+            if name is None:
+                findings.append(Finding(
+                    self.id, mod.relpath, node.lineno,
+                    "metric family registered with a non-literal name — GL005 "
+                    "cannot verify the fedml_ namespace",
+                    symbol=f"nonliteral:L{node.lineno}"))
+                continue
+            if not METRIC_NAME_RE.fullmatch(name):
+                findings.append(Finding(
+                    self.id, mod.relpath, node.lineno,
+                    f"metric family {name!r} violates the fedml_[a-z0-9_]+ "
+                    "namespace",
+                    symbol=name))
+            for kw in node.keywords:
+                if kw.arg != "labels":
+                    continue
+                if not isinstance(kw.value, (ast.Tuple, ast.List)):
+                    continue  # non-literal labels: runtime lint still covers it
+                for elt in kw.value.elts:
+                    label = str_const(elt)
+                    if label is None:
+                        continue
+                    if not LABEL_RE.fullmatch(label):
+                        findings.append(Finding(
+                            self.id, mod.relpath, node.lineno,
+                            f"metric {name!r} label {label!r} is not a valid "
+                            "label name ([a-z][a-z0-9_]*)",
+                            symbol=f"{name}:{label}"))
+                    elif label == "le":
+                        findings.append(Finding(
+                            self.id, mod.relpath, node.lineno,
+                            f"metric {name!r} label 'le' is reserved for "
+                            "histogram buckets",
+                            symbol=f"{name}:le"))
+        return findings

@@ -172,7 +172,7 @@ class Config:
                 object.__setattr__(self, name, tuple(v))
 
     # reference code reads duck-typed attributes; keep that working for extras
-    def __getattr__(self, name: str) -> Any:
+    def __getattr__(self, name: str) -> Any:  # graftlint: disable=GL001(the dynamic extra fallback cfg_extra builds on)
         extra = object.__getattribute__(self, "__dict__").get("extra", {})
         if name in extra:
             return extra[name]

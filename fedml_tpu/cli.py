@@ -361,6 +361,47 @@ def cmd_obs(args) -> int:
     return 2
 
 
+# -- lint (analysis/: AST invariant checker, tier-1-enforced) ----------------
+
+def cmd_lint(args) -> int:
+    """Run the GL001-GL005 static invariant rules over a package tree.
+
+    Exit 0 = clean (counting inline suppressions and the baseline),
+    1 = unsuppressed findings or unparseable files.  Deliberately imports no
+    jax: the bench/dryrun drivers run this in processes that must not touch
+    the accelerator runtime."""
+    from fedml_tpu.analysis import engine as lint_engine
+    from fedml_tpu.analysis import findings as lint_findings
+
+    pkg_dir = Path(__file__).resolve().parent
+    target = Path(args.path) if args.path else pkg_dir
+    if not target.exists():
+        print(f"error: no such path {target}", file=sys.stderr)
+        return 2
+    baseline = Path(args.baseline) if args.baseline else pkg_dir / "analysis" / "baseline.json"
+    result = lint_engine.run_lint(target, baseline=baseline if baseline.exists() else None)
+    if args.write_baseline:
+        lint_findings.save_baseline(baseline, result.findings)
+        print(f"baselined {len(result.findings)} finding(s) into {baseline}")
+        return 0
+    if args.format == "json":
+        print(json.dumps({
+            "ok": result.ok,
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "severity": f.severity, "message": f.message, "key": f.key}
+                for f in result.findings
+            ],
+            "counts_by_rule": result.counts_by_rule(),
+            "suppressed": len(result.suppressed),
+            "baselined": len(result.baselined),
+            "parse_errors": result.errors,
+        }))
+    else:
+        print(result.render())
+    return 0 if result.ok else 1
+
+
 def cmd_diagnosis(args) -> int:
     """Reference diagnosis.py checks SaaS/MQTT/S3 connectivity; here the
     self-hosted equivalents: jax backend usable, a jit executes, the spool is
@@ -508,6 +549,16 @@ def main(argv=None) -> int:
     oserve = osub.add_parser("serve", help="serve /metrics + /healthz for this process")
     oserve.add_argument("--port", type=int, default=9109)
     p.set_defaults(fn=cmd_obs)
+
+    p = sub.add_parser("lint", help="AST invariant checker (GL001-GL005) over fedml_tpu/")
+    p.add_argument("path", nargs="?", default="",
+                   help="package dir or single .py file (default: the installed fedml_tpu package)")
+    p.add_argument("--baseline", default="",
+                   help="suppression baseline JSON (default: fedml_tpu/analysis/baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings into the baseline instead of failing")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("diagnosis", help="environment/connectivity self-check")
     p.set_defaults(fn=cmd_diagnosis)

@@ -57,7 +57,9 @@ DEFAULT_MIN_COMPRESS_ELEMS = 1024
 def codec_from_config(cfg) -> Optional[str]:
     """``extra.comm_compression`` -> validated codec name, or None when
     compression is off (unset / ``no`` / ``off`` / ``raw``)."""
-    name = str((getattr(cfg, "extra", {}) or {}).get("comm_compression") or "").strip().lower()
+    from ..core.flags import cfg_extra
+
+    name = str(cfg_extra(cfg, "comm_compression") or "").strip().lower()
     if name in ("", "no", "off", "none", "raw"):
         return None
     if name not in CODECS:

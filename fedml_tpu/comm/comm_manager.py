@@ -13,6 +13,7 @@ import time
 from typing import Callable, Optional
 
 from .. import constants as C
+from ..core.flags import cfg_extra
 from ..obs import trace as obstrace
 from .base import BaseCommunicationManager, MSG_SENT, Observer, SEND_LATENCY
 from .message import Message
@@ -83,8 +84,8 @@ class FedMLCommManager(Observer):
         if b == C.COMM_BACKEND_GRPC:
             from .grpc_backend import GRPCCommManager
 
-            base_port = int((getattr(self.cfg, "extra", {}) or {}).get("grpc_base_port", 8890))
-            ip_config = (getattr(self.cfg, "extra", {}) or {}).get("grpc_ip_config", {})
+            base_port = int(cfg_extra(self.cfg, "grpc_base_port"))
+            ip_config = cfg_extra(self.cfg, "grpc_ip_config", {})
             return GRPCCommManager(
                 "0.0.0.0", base_port + self.rank, self.rank,
                 ip_config=ip_config, base_port=base_port,
@@ -92,20 +93,21 @@ class FedMLCommManager(Observer):
         if b == C.COMM_BACKEND_MQTT_S3:
             from .mqtt_s3 import MqttS3CommManager
 
-            extra = getattr(self.cfg, "extra", {}) or {}
             run_id = getattr(self.cfg, "run_id", "0")
             broker = store = None
-            if extra.get("mqtt_host"):
+            mqtt_host = cfg_extra(self.cfg, "mqtt_host")
+            if mqtt_host:
                 # real MQTT over TCP (in-repo MiniMqttBroker or any external
                 # 3.1.1 broker); payloads ride the HTTP object store when one
                 # is configured (reference: broker + S3, run_cross_silo.sh)
                 from .mqtt_real import TcpMqttBroker
 
                 broker = TcpMqttBroker(
-                    extra["mqtt_host"], int(extra.get("mqtt_port", 1883)),
+                    mqtt_host, int(cfg_extra(self.cfg, "mqtt_port")),
                     client_id=f"{run_id}_{self.rank}",
                 )
-                if not extra.get("object_store_url"):
+                store_url = cfg_extra(self.cfg, "object_store_url")
+                if not store_url:
                     # a cross-process broker with the per-process in-memory
                     # store would strand every >8KB payload: the sender
                     # offloads to ITS store and the receiver can't resolve
@@ -119,7 +121,7 @@ class FedMLCommManager(Observer):
                     )
                 from .object_store_http import HttpObjectStore
 
-                store = HttpObjectStore(extra["object_store_url"])
+                store = HttpObjectStore(store_url)
             return MqttS3CommManager(
                 run_id, self.rank,
                 broker=broker, store=store,
@@ -131,8 +133,8 @@ class FedMLCommManager(Observer):
         if b == C.COMM_BACKEND_TCP:
             from .tcp_backend import TCPCommManager
 
-            base_port = int((getattr(self.cfg, "extra", {}) or {}).get("tcp_base_port", 9690))
-            ip_config = (getattr(self.cfg, "extra", {}) or {}).get("tcp_ip_config", {})
+            base_port = int(cfg_extra(self.cfg, "tcp_base_port"))
+            ip_config = cfg_extra(self.cfg, "tcp_ip_config", {})
             return TCPCommManager(
                 "0.0.0.0", base_port + self.rank, self.rank,
                 ip_config=ip_config, base_port=base_port,

@@ -1,0 +1,236 @@
+"""Central registry for every ``cfg.extra`` feature flag + the one accessor.
+
+``Config.extra`` is the escape hatch for recipe knobs that are not typed
+dataclass fields — and before this registry it was read at ~40 sites with
+two inconsistent idioms (``extra.get(...)`` on a local, inline
+``(getattr(cfg, "extra", {}) or {}).get(...)``) and no inventory at all: a
+typo'd recipe key silently fell back to its default, the main source of
+silent cross-silo misconfiguration.  Now:
+
+- every flag is declared ONCE here as a :class:`FlagSpec` (type, default,
+  one-line doc);
+- every read goes through :func:`cfg_extra`, which refuses undeclared names
+  at runtime;
+- the GL001 lint rule (``fedml_tpu/analysis/rules/gl001_flags.py``) enforces
+  both directions statically: an undeclared read and a dead declaration are
+  tier-1 failures;
+- ``docs/FLAGS.md`` is generated from this registry
+  (:func:`render_flag_reference`, ``python -m fedml_tpu.core.flags``).
+
+``default=None`` with a ``derived:`` doc means the default is computed at
+the call site (e.g. ``secagg_target_u`` defaults to ``t + 1``) — the caller
+passes it explicitly to :func:`cfg_extra`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+__all__ = ["FlagSpec", "FLAGS", "cfg_extra", "render_flag_reference"]
+
+
+@dataclass(frozen=True)
+class FlagSpec:
+    name: str
+    type: str       # bool | int | float | str | dict | list
+    default: Any    # None with a "derived:" doc = computed at the call site
+    doc: str
+
+
+_UNSET = object()
+
+
+def _specs(*specs: FlagSpec) -> dict[str, FlagSpec]:
+    out: dict[str, FlagSpec] = {}
+    for s in specs:
+        if s.name in out:
+            raise ValueError(f"duplicate flag declaration {s.name!r}")
+        out[s.name] = s
+    return out
+
+
+FLAGS: dict[str, FlagSpec] = _specs(
+    # -- training / model ----------------------------------------------------
+    FlagSpec("fused_blocks", "bool", False,
+             "Route CIFAR-ResNet conv epilogues through the fused Pallas "
+             "BasicBlock kernel (BN scale/shift + residual + ReLU in one pass)."),
+    FlagSpec("mlp_hidden", "int", 128,
+             "Hidden width of the synthetic `mlp` model (comm benches widen it "
+             "past the compression block size)."),
+    FlagSpec("silo_dp", "bool", True,
+             "Intra-silo data parallelism over local devices when batch_size "
+             "divides the local device count."),
+    FlagSpec("unitedllm", "bool", False,
+             "Cross-cloud runs exchange ONLY LoRA adapters (federated LLM "
+             "training, UnitedLLM protocol)."),
+    FlagSpec("lora_r", "int", None,
+             "LoRA adapter rank; derived: surface default (8 FedLLM, 4 UnitedLLM)."),
+    FlagSpec("lora_alpha", "float", 16.0, "LoRA scaling alpha."),
+    FlagSpec("lora_targets", "list", None,
+             "Module name substrings receiving LoRA adapters; derived: "
+             "llm.lora.DEFAULT_TARGETS."),
+    # -- simulator workloads -------------------------------------------------
+    FlagSpec("seg_base", "int", 8, "UNet base channel width for FedSeg."),
+    FlagSpec("gan_z_dim", "int", 64, "FedGAN generator latent dimension."),
+    FlagSpec("decentralized_mode", "str", "dsgd",
+             "Decentralized topology/algorithm: dsgd | ring."),
+    FlagSpec("topology_neighbor_num", "int", 2,
+             "Neighbors per node in the decentralized mixing topology."),
+    FlagSpec("ta_group_num", "int", 4, "TurboAggregate group count."),
+    FlagSpec("ta_dropout_prob", "float", 0.0,
+             "TurboAggregate simulated per-client dropout probability."),
+    FlagSpec("group_assignment", "str", "balanced",
+             "HierarchicalFL client-to-group assignment: balanced | random."),
+    FlagSpec("vfl_party_num", "int", 2, "Vertical-FL party count."),
+    FlagSpec("vfl_embed_dim", "int", 16, "Vertical-FL per-party embedding dim."),
+    FlagSpec("nas_cells", "int", 2, "FedNAS DARTS cell count."),
+    FlagSpec("nas_features", "int", 16, "FedNAS DARTS feature width."),
+    FlagSpec("nas_arch_lr", "float", 3e-3, "FedNAS architecture learning rate."),
+    FlagSpec("condshift_clusters", "int", 2,
+             "Conditional-shift synthetic partitioner: label cluster count."),
+    FlagSpec("condshift_scale", "float", 0.9,
+             "Conditional-shift synthetic partitioner: shift strength."),
+    # -- communication / transports ------------------------------------------
+    FlagSpec("comm_compression", "str", None,
+             "Upload codec for cross-silo model replies: qsgd8 | topk "
+             "(unset = raw wire v1, byte-identical to the uncompressed protocol)."),
+    FlagSpec("comm_topk_ratio", "float", None,
+             "top-k codec keep ratio; derived: cfg.compression_ratio (0.01)."),
+    FlagSpec("comm_compress_min_size", "int", 1024,
+             "Minimum leaf element count before a float leaf is compressed "
+             "(block padding would EXPAND smaller leaves)."),
+    FlagSpec("streaming_aggregation", "bool", False,
+             "Fold arriving client updates into a running weighted sum even "
+             "without a codec (peak buffered updates <= 2)."),
+    FlagSpec("grpc_base_port", "int", 8890, "gRPC backend rank-0 port."),
+    FlagSpec("grpc_ip_config", "dict", None,
+             "gRPC backend rank -> host mapping (unset = localhost)."),
+    FlagSpec("tcp_base_port", "int", 9690, "TCP backend rank-0 port."),
+    FlagSpec("tcp_ip_config", "dict", None,
+             "TCP backend rank -> host mapping (unset = localhost)."),
+    FlagSpec("mqtt_host", "str", None,
+             "Real MQTT broker host for the MQTT_S3 backend (unset = in-proc "
+             "loopback broker)."),
+    FlagSpec("mqtt_port", "int", 1883, "Real MQTT broker port."),
+    FlagSpec("object_store_url", "str", None,
+             "HTTP object store for >8KB MQTT payloads (required with mqtt_host)."),
+    # -- cross-silo / cross-device server ------------------------------------
+    FlagSpec("straggler_timeout_s", "float", 0.0,
+             "Bounded-wait straggler deadline per round; 0 = wait forever."),
+    FlagSpec("straggler_quorum_frac", "float", 0.5,
+             "Fraction of selected clients that must arrive before a "
+             "straggler-timeout round proceeds."),
+    FlagSpec("health_aware_selection", "bool", False,
+             "client_selection deprioritizes degraded ranks using the "
+             "per-client health ledger."),
+    FlagSpec("device_max_missed_rounds", "int", 2,
+             "Cross-device liveness: rounds a device may miss before "
+             "exclusion from candidate selection."),
+    FlagSpec("cross_device_timeout_s", "float", 600.0,
+             "Cross-device server run deadline."),
+    # -- secure aggregation / crypto -----------------------------------------
+    FlagSpec("secagg_method", "str", "lightsecagg",
+             "Secure-aggregation protocol: lightsecagg | shamir."),
+    FlagSpec("secagg_privacy_t", "int", None,
+             "Secret-sharing privacy threshold; derived: max(1, n_clients // 2)."),
+    FlagSpec("secagg_target_u", "int", None,
+             "LightSecAgg surviving-client target; derived: privacy_t + 1."),
+    FlagSpec("secagg_q_bits", "int", 16, "Secure-aggregation quantization bits."),
+    FlagSpec("fhe_key_seed", "int", None,
+             "RLWE key seed (out-of-band in production); derived: "
+             "random_seed * 7919 + 17."),
+    FlagSpec("fhe_ring_dim", "int", 1024, "RLWE ring dimension."),
+    FlagSpec("fhe_frac_bits", "int", 16, "FHE fixed-point fractional bits."),
+    # -- trust: attacks / defenses -------------------------------------------
+    FlagSpec("attack_boost", "float", 10.0, "Model-replacement attack boost."),
+    FlagSpec("attack_original_class", "int", 0, "Backdoor source class."),
+    FlagSpec("attack_target_class", "int", 1, "Backdoor target class."),
+    FlagSpec("attack_poison_frac", "float", 0.5,
+             "Fraction of an attacker's shard that is poisoned."),
+    FlagSpec("edge_case_type", "str", "southwest",
+             "Edge-case backdoor variant (reference attack zoo name)."),
+    FlagSpec("soteria_percentile", "float", 1.0,
+             "Soteria defense: percentile of elements perturbed."),
+    FlagSpec("wbc_pert_strength", "float", 1.0, "WBC defense perturbation strength."),
+    FlagSpec("wbc_lr", "float", 0.1, "WBC defense inner learning rate."),
+    # -- observability -------------------------------------------------------
+    FlagSpec("metrics_port", "int", None,
+             "Serve /metrics + /healthz on this port (unset = no server)."),
+    FlagSpec("otlp_endpoint", "str", None,
+             "OTLP/HTTP collector base URL; unset = no exporter object, no "
+             "worker thread ($FEDML_TPU_OTLP_ENDPOINT overrides)."),
+    FlagSpec("enable_remote_obs", "bool", False,
+             "Clients ship telemetry batches to the server's ObsCollector "
+             "over the FL transport."),
+    FlagSpec("obs_jsonl_path", "str", None,
+             "Server-side collector JSONL trail path (obs report input)."),
+    # -- multi-host ----------------------------------------------------------
+    FlagSpec("coordinator_address", "str", None,
+             "jax.distributed coordinator host:port "
+             "($JAX_COORDINATOR_ADDRESS fallback)."),
+    FlagSpec("num_processes", "int", None,
+             "jax.distributed process count ($JAX_NUM_PROCESSES fallback)."),
+    FlagSpec("process_id", "int", None,
+             "jax.distributed process id ($JAX_PROCESS_ID fallback)."),
+    # -- serving -------------------------------------------------------------
+    FlagSpec("end_point_name", "str", None,
+             "Serving endpoint name; derived: 'ep-<run_id>'."),
+    FlagSpec("serving_model_name", "str", None,
+             "Model card name for deploy; derived: cfg.model."),
+    FlagSpec("model_version", "str", "v1", "Model card version for deploy."),
+)
+
+
+def cfg_extra(cfg, name: str, default: Any = _UNSET) -> Any:
+    """Read the declared flag ``name`` from ``cfg``.
+
+    Resolution order matches the historical duck-typed behavior: a direct
+    attribute on ``cfg`` wins (tests ``setattr`` flags straight onto Config,
+    and ``Config.__getattr__`` itself falls through to ``extra``), then the
+    ``cfg.extra`` dict, then ``default`` (the registry default when the call
+    site passes none).  ``cfg=None`` short-circuits to the default — several
+    constructors accept an optional config.
+
+    Raises ``KeyError`` for names missing from :data:`FLAGS`: an undeclared
+    flag read is a bug here exactly like it is in GL001.
+    """
+    spec = FLAGS.get(name)
+    if spec is None:
+        raise KeyError(
+            f"undeclared extra flag {name!r} — declare it in fedml_tpu/core/flags.py")
+    fallback = spec.default if default is _UNSET else default
+    if cfg is None:
+        return fallback
+    value = getattr(cfg, name, _UNSET)
+    if value is _UNSET:
+        extra = getattr(cfg, "extra", None) or {}
+        value = extra.get(name, _UNSET)  # graftlint: disable=GL001(the accessor itself)
+    return fallback if value is _UNSET else value
+
+
+def render_flag_reference() -> str:
+    """The generated flag-reference markdown (checked in as ``docs/FLAGS.md``)."""
+    lines = [
+        "# `cfg.extra` flag reference",
+        "",
+        "Generated from `fedml_tpu/core/flags.py` — regenerate with",
+        "`python -m fedml_tpu.core.flags > docs/FLAGS.md` after editing the",
+        "registry.  Every flag is read through `cfg_extra(cfg, name, default)`;",
+        "the GL001 lint rule fails tier-1 on undeclared reads and dead",
+        "declarations, so this table is complete by construction.",
+        "",
+        "| Flag | Type | Default | Description |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(FLAGS):
+        s = FLAGS[name]
+        default = "`None`" if s.default is None else f"`{s.default!r}`"
+        doc = s.doc.replace("|", "\\|")  # keep literal pipes out of the table grid
+        lines.append(f"| `{name}` | {s.type} | {default} | {doc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(render_flag_reference(), end="")

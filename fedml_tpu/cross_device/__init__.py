@@ -21,6 +21,7 @@ import time
 from pathlib import Path
 from typing import Optional
 
+from ..core.flags import cfg_extra
 from ..cross_silo import build_aggregator
 from ..cross_silo import message_define as md
 from ..cross_silo.server import FedMLServerManager
@@ -86,10 +87,12 @@ class ServerMNN(FedMLServerManager):
 
     def __init__(self, cfg, aggregator, backend: Optional[str] = None, logger=None):
         super().__init__(cfg, aggregator, backend=backend, logger=logger)
-        extra = getattr(cfg, "extra", {}) or {}
-        self.global_model_file_path = extra.get("global_model_file_path", "")
+        # NOTE: global_model_file_path is a typed Config field — the old
+        # extra.get read could never see a recipe value (known keys land on
+        # the dataclass, not in extra)
+        self.global_model_file_path = getattr(cfg, "global_model_file_path", "") or ""
         self.registry = DeviceRegistry(
-            max_missed=int(extra.get("device_max_missed_rounds", 2))
+            max_missed=int(cfg_extra(cfg, "device_max_missed_rounds"))
         )
         self._uploaded_this_round: set[int] = set()
 
@@ -199,7 +202,7 @@ class _CrossDeviceRunner:
         backend = self.cfg.backend if self.cfg.backend not in ("", "MESH", "INPROC") else None
         server = build_cross_device_server(self.cfg, self.dataset, self.model,
                                            backend=backend)
-        timeout = float((getattr(self.cfg, "extra", {}) or {}).get("cross_device_timeout_s", 600.0))
+        timeout = float(cfg_extra(self.cfg, "cross_device_timeout_s"))
         return server.run_until_done(timeout=timeout)
 
 

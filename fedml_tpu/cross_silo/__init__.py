@@ -18,6 +18,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..core.flags import cfg_extra
 from ..data.dataset import pad_eval_set
 from .client import ClientMasterManager, FedMLTrainer
 from .server import FedMLAggregator, FedMLServerManager
@@ -84,7 +85,7 @@ class _CrossSiloRunner:
             # two secure-agg variants, as in the reference: LightSecAgg
             # (cross_silo/lightsecagg/) and Shamir pairwise-mask SecAgg
             # (cross_silo/secagg/) — selected by secagg_method
-            method = str((getattr(cfg, "extra", {}) or {}).get("secagg_method", "lightsecagg")).lower()
+            method = str(cfg_extra(cfg, "secagg_method")).lower()
             if method in ("shamir", "secagg", "pairwise"):
                 from .secagg_shamir import build_sa_client, build_sa_server, run_shamir_secagg_process_group
 

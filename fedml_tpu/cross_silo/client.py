@@ -27,6 +27,7 @@ from ..algorithms import hparams_from_config
 from ..comm.comm_manager import FedMLCommManager
 from ..comm.message import Message
 from ..core import rng
+from ..core.flags import cfg_extra
 from ..fl.local_sgd import make_local_train_fn
 from . import message_define as md
 
@@ -104,7 +105,7 @@ class FedMLTrainer:
         """Minibatch sharding constraint for this silo's device set; the
         distributed-silo subclass overrides this with the global mesh."""
         n_local = len(jax.local_devices())
-        if n_local > 1 and bool((getattr(cfg, "extra", {}) or {}).get("silo_dp", True)):
+        if n_local > 1 and bool(cfg_extra(cfg, "silo_dp")):
             if cfg.batch_size % n_local == 0:
                 from ..parallel import mesh as meshlib
 
@@ -147,13 +148,12 @@ class ClientMasterManager(FedMLCommManager):
         # send path below is byte-identical to the uncompressed protocol.
         from ..comm import codecs
 
-        extra = getattr(cfg, "extra", {}) or {}
         self.comm_codec = codecs.codec_from_config(cfg)
         self._comm_residuals = None
-        self._comm_ratio = float(extra.get("comm_topk_ratio",
-                                           getattr(cfg, "compression_ratio", 0.01) or 0.01))
-        self._comm_min_elems = int(extra.get("comm_compress_min_size",
-                                             codecs.DEFAULT_MIN_COMPRESS_ELEMS))
+        self._comm_ratio = float(cfg_extra(
+            cfg, "comm_topk_ratio", getattr(cfg, "compression_ratio", 0.01) or 0.01))
+        self._comm_min_elems = int(cfg_extra(
+            cfg, "comm_compress_min_size", codecs.DEFAULT_MIN_COMPRESS_ELEMS))
         # remote observability: per-round events (+ anything the caller
         # ships via self.obs — perf samples, RuntimeLogDaemon batches) ride
         # the FL transport to the server's ObsCollector.  The train events
@@ -162,7 +162,7 @@ class ClientMasterManager(FedMLCommManager):
         # the same telemetry.
         self.obs = None
         self._pallas_sink = None
-        if (getattr(cfg, "extra", {}) or {}).get("enable_remote_obs"):
+        if cfg_extra(cfg, "enable_remote_obs"):
             from ..obs import trace as obstrace
             from ..obs.remote import RemoteObsShipper
             from ..ops.pallas import timing as pallas_timing

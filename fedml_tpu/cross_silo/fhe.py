@@ -28,6 +28,7 @@ import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.flags import cfg_extra
 from ..trust.fhe.rlwe import RLWECipher, RLWEParams, add_ciphertexts
 from ..comm.message import Message
 from . import message_define as md
@@ -40,11 +41,10 @@ MSG_ARG_KEY_FHE_LEN = "fhe_len"
 
 
 def fhe_cipher(cfg) -> RLWECipher:
-    extra = getattr(cfg, "extra", {}) or {}
-    key_seed = int(extra.get("fhe_key_seed", cfg.random_seed * 7919 + 17))
+    key_seed = int(cfg_extra(cfg, "fhe_key_seed", cfg.random_seed * 7919 + 17))
     params = RLWEParams(
-        n=int(extra.get("fhe_ring_dim", 1024)),
-        frac_bits=int(extra.get("fhe_frac_bits", 16)),
+        n=int(cfg_extra(cfg, "fhe_ring_dim")),
+        frac_bits=int(cfg_extra(cfg, "fhe_frac_bits")),
     )
     return RLWECipher(params, key_seed=key_seed)
 

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..comm.message import Message
+from ..core.flags import cfg_extra
 from ..trust.secagg.field import DEFAULT_PRIME, dequantize_from_field, quantize_to_field
 from ..trust.secagg.lightsecagg import LightSecAggProtocol
 from . import message_define as md
@@ -71,10 +72,9 @@ def secagg_params(cfg):
     (``lsa_fedml_aggregator.py:60``: T = floor(N/2); U = T + 1 is the
     minimum reconstruction threshold)."""
     n = cfg.client_num_in_total
-    extra = getattr(cfg, "extra", {}) or {}
-    t = int(extra.get("secagg_privacy_t", max(1, n // 2)))
-    u = int(extra.get("secagg_target_u", t + 1))
-    q_bits = int(extra.get("secagg_q_bits", 16))
+    t = int(cfg_extra(cfg, "secagg_privacy_t", max(1, n // 2)))
+    u = int(cfg_extra(cfg, "secagg_target_u", t + 1))
+    q_bits = int(cfg_extra(cfg, "secagg_q_bits"))
     if not (0 < t < u <= n):
         raise ValueError(f"LightSecAgg needs 0 < T({t}) < U({u}) <= N({n})")
     # trust features that inspect or transform individual updates cannot run

@@ -61,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..comm.message import Message
+from ..core.flags import cfg_extra
 from ..trust.secagg.field import DEFAULT_PRIME, dequantize_from_field, quantize_to_field
 from ..trust.secagg.shamir import (
     masked_input,
@@ -133,9 +134,8 @@ def shamir_secagg_params(cfg):
     """(T, q_bits): T = privacy threshold, reconstruction needs T+1 shares
     (reference ``sa_fedml_aggregator.py:53``: T = floor(N/2))."""
     n = cfg.client_num_in_total
-    extra = getattr(cfg, "extra", {}) or {}
-    t = int(extra.get("secagg_privacy_t", max(1, n // 2)))
-    q_bits = int(extra.get("secagg_q_bits", 16))
+    t = int(cfg_extra(cfg, "secagg_privacy_t", max(1, n // 2)))
+    q_bits = int(cfg_extra(cfg, "secagg_q_bits"))
     if not (0 < t < n):
         raise ValueError(f"Shamir SecAgg needs 0 < T({t}) < N({n})")
     incompatible = [

@@ -34,6 +34,7 @@ from ..comm import codecs, wire
 from ..comm.comm_manager import FedMLCommManager
 from ..comm.message import Message
 from ..core import pytree as pt, rng
+from ..core.flags import cfg_extra
 from ..data.dataset import pad_eval_set
 from ..fl.algorithm import FedAlgorithm
 from ..fl.local_sgd import make_eval_fn
@@ -128,9 +129,8 @@ class FedMLAggregator:
         # the algorithm uses the stock weighted-mean aggregate AND no trust
         # pipeline needs the stacked client models — otherwise the exact
         # buffer-all path below stays reference-bit-exact.
-        extra = getattr(cfg, "extra", {}) or {}
         self.stream_mode = bool(
-            (codecs.codec_from_config(cfg) or extra.get("streaming_aggregation"))
+            (codecs.codec_from_config(cfg) or cfg_extra(cfg, "streaming_aggregation"))
             and trust is None
             and type(self.algorithm).aggregate is FedAlgorithm.aggregate
         )
@@ -384,8 +384,8 @@ class FedMLServerManager(FedMLCommManager):
         self.history: list[dict] = []
         self.logger = logger or MetricsLogger(cfg.metrics_jsonl_path or None)
         # bounded-wait straggler handling
-        self.straggler_timeout = float((getattr(cfg, "extra", {}) or {}).get("straggler_timeout_s", 0) or 0)
-        self.quorum_frac = float((getattr(cfg, "extra", {}) or {}).get("straggler_quorum_frac", 0.5) or 0.5)
+        self.straggler_timeout = float(cfg_extra(cfg, "straggler_timeout_s") or 0)
+        self.quorum_frac = float(cfg_extra(cfg, "straggler_quorum_frac") or 0.5)
         self._round_timer: Optional[threading.Timer] = None
         self._agg_lock = threading.Lock()
         self._init_sent = False
@@ -395,20 +395,19 @@ class FedMLServerManager(FedMLCommManager):
         # remote observability (reference mlops_metrics over MQTT): telemetry
         # rides THIS comm manager — client shippers target rank 0
         self.obs_collector = None
-        extra = getattr(cfg, "extra", {}) or {}
         # OTLP egress (obs/otlp.py): gated on extra.otlp_endpoint — unset
         # means no exporter object, no worker thread, default path unchanged
         from ..obs import otlp as obsotlp
 
         self.otlp = obsotlp.exporter_from_config(cfg)
-        if extra.get("enable_remote_obs") or self.otlp is not None:
+        if cfg_extra(cfg, "enable_remote_obs") or self.otlp is not None:
             from ..obs.remote import ObsCollector
 
             # the exporter tees on collector ingest, so rank 0 exports the
             # whole distributed round tree (its own spans + every
             # client-shipped span under one trace_id per round)
             self.obs_collector = ObsCollector(
-                extra.get("obs_jsonl_path") or None, otlp=self.otlp
+                cfg_extra(cfg, "obs_jsonl_path") or None, otlp=self.otlp
             ).attach(self)
         # per-client health ledger (obs/health.py): EWMA RTT, deadline
         # breaches, comm failures -> fedml_client_health_* gauges.  Always
@@ -417,7 +416,7 @@ class FedMLServerManager(FedMLCommManager):
         from ..obs.health import ClientHealthLedger
 
         self.health = ClientHealthLedger().attach_comm()
-        self.health_aware = bool(extra.get("health_aware_selection"))
+        self.health_aware = bool(cfg_extra(cfg, "health_aware_selection"))
         # distributed round tracing: one trace per round, stamped on every
         # broadcast so client train spans join it (obs.trace module doc)
         self._round_span: Optional[obstrace.Span] = None
@@ -451,15 +450,23 @@ class FedMLServerManager(FedMLCommManager):
     def handle_message_client_status(self, msg: Message) -> None:
         if msg.get(md.MSG_ARG_KEY_CLIENT_STATUS) == md.CLIENT_STATUS_ONLINE:
             self.active_clients.add(msg.get_sender_id())
-        # once only: a status reply arriving mid-run (e.g. a liveness probe
-        # answer from a cross-device fleet) must not re-fire round 0
-        if not self._init_sent and len(self.active_clients) == len(self.client_ids):
+        if len(self.active_clients) == len(self.client_ids):
             self.send_init_msg()
 
     def send_init_msg(self) -> None:
-        """Reference ``send_init_msg`` (:48): global model + per-client index."""
-        self._init_sent = True
-        self._broadcast_model(md.MSG_TYPE_S2C_INIT_CONFIG)
+        """Reference ``send_init_msg`` (:48): global model + per-client index.
+
+        Runs under ``_agg_lock`` — the broadcast rewrites round state
+        (``selected``, ``_sent_at``, ``_round_payload_bytes``) that the
+        receive and straggler-timer threads touch under the same lock, and
+        the ``_init_sent`` check makes the call idempotent: a status reply
+        arriving mid-run (e.g. a liveness probe answer from a cross-device
+        fleet) must not re-fire round 0."""
+        with self._agg_lock:
+            if self._init_sent:
+                return
+            self._init_sent = True
+            self._broadcast_model(md.MSG_TYPE_S2C_INIT_CONFIG)
 
     def _candidate_ids(self) -> list[int]:
         """The candidate set for this round's selection — subclasses narrow
@@ -554,7 +561,7 @@ class FedMLServerManager(FedMLCommManager):
             return
         self._broadcast_model(md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
 
-    def _close_round_trace(self, *child_spans) -> None:
+    def _close_round_trace(self, *child_spans) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: only _finish_round calls this)
         """End the round span, record its duration, and persist the server's
         half of the round trace (spans + per-client round trips) into the
         same collector trail the clients ship to."""
@@ -587,7 +594,7 @@ class FedMLServerManager(FedMLCommManager):
         self._round_rtts.clear()
         self._round_span = None
 
-    def _broadcast_model(self, msg_type: int) -> None:
+    def _broadcast_model(self, msg_type: int) -> None:  # graftlint: disable=GL004(callers hold _agg_lock: send_init_msg and _finish_round)
         """Select clients, send them the global model for this round, arm the
         straggler timer — shared by round 0 (INIT) and later rounds (SYNC)."""
         self.selected = self.aggregator.client_selection(

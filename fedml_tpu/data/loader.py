@@ -26,6 +26,7 @@ import numpy as np
 log = logging.getLogger("fedml_tpu.data.loader")
 
 from ..arguments import Config
+from ..core.flags import cfg_extra
 from . import partition as part
 from .dataset import FederatedDataset
 
@@ -285,8 +286,7 @@ def _load_condshift(cfg: Config) -> FederatedDataset:
     rng = np.random.RandomState(0xC04D ^ (cfg.random_seed * 2654435761 % (2**31)))
     d, classes = 64, 6
     n_clients = cfg.client_num_in_total
-    extra = getattr(cfg, "extra", {}) or {}
-    clusters = int(extra.get("condshift_clusters", 2))
+    clusters = int(cfg_extra(cfg, "condshift_clusters"))
     if not 1 <= clusters <= 6:
         # np.roll wraps at classes=6: more clusters would silently alias
         # earlier label permutations and measure LESS shift than configured
@@ -296,7 +296,7 @@ def _load_condshift(cfg: Config) -> FederatedDataset:
         )
     per_client = int((cfg.synthetic_train_size or 4800) // max(n_clients, 1))
     test_per_client = int((cfg.synthetic_test_size or 1200) // max(n_clients, 1))
-    scale = float(extra.get("condshift_scale", 0.9))
+    scale = float(cfg_extra(cfg, "condshift_scale"))
 
     # shared prototype directions (unit-ish), one per class
     protos = rng.normal(0, 1.0, size=(classes, d)).astype(np.float32)

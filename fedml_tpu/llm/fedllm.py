@@ -25,6 +25,7 @@ import optax
 
 from ..arguments import Config
 from ..core import pytree as pt, rng
+from ..core.flags import cfg_extra
 from ..models.transformer import Transformer, TransformerConfig
 from ..obs.metrics import MetricsLogger
 from . import lora as lora_lib
@@ -43,9 +44,8 @@ class FedLLMSimulator(RoundCheckpointMixin):
     def __init__(self, cfg: Config, dataset, tcfg: Optional[TransformerConfig] = None):
         self.cfg = cfg
         self.dataset = dataset
-        extra = getattr(cfg, "extra", {}) or {}
-        self.rank = int(extra.get("lora_r", 8))
-        self.alpha = float(extra.get("lora_alpha", 16.0))
+        self.rank = int(cfg_extra(cfg, "lora_r", 8))
+        self.alpha = float(cfg_extra(cfg, "lora_alpha"))
         self.tcfg = tcfg or TransformerConfig.tiny(vocab_size=dataset.class_num)
         self.model = Transformer(self.tcfg)
         k0 = rng.root_key(cfg.random_seed)
@@ -53,7 +53,7 @@ class FedLLMSimulator(RoundCheckpointMixin):
         self.base_params = self.model.init({"params": jax.random.fold_in(k0, 1)}, sample)["params"]
         self.global_lora = lora_lib.init_lora(
             self.base_params, self.rank, jax.random.fold_in(k0, 2),
-            targets=extra.get("lora_targets", lora_lib.DEFAULT_TARGETS),
+            targets=cfg_extra(cfg, "lora_targets", lora_lib.DEFAULT_TARGETS),
         )
         self.root_key = k0
         self.round_idx = 0

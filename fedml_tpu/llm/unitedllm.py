@@ -32,6 +32,7 @@ import numpy as np
 import optax
 
 from ..core import rng
+from ..core.flags import cfg_extra
 from ..cross_silo.client import ClientMasterManager
 from ..cross_silo.server import FedMLAggregator, FedMLServerManager
 from ..models.transformer import Transformer, TransformerConfig
@@ -43,17 +44,16 @@ log = logging.getLogger("fedml_tpu.llm.unitedllm")
 def _build_base(cfg, dataset):
     """Deterministic (cfg.random_seed-keyed) frozen base model shared by all
     parties — the stand-in for 'every cloud loads the same checkpoint'."""
-    extra = getattr(cfg, "extra", {}) or {}
     tcfg = TransformerConfig.tiny(vocab_size=dataset.class_num)
     model = Transformer(tcfg)
     k0 = rng.root_key(cfg.random_seed)
     sample = jnp.zeros((cfg.batch_size, dataset.train_x.shape[1]), jnp.int32)
     base_params = model.init({"params": jax.random.fold_in(k0, 1)}, sample)["params"]
     lora0 = lora_lib.init_lora(
-        base_params, int(extra.get("lora_r", 4)), jax.random.fold_in(k0, 2),
-        targets=extra.get("lora_targets", lora_lib.DEFAULT_TARGETS),
+        base_params, int(cfg_extra(cfg, "lora_r", 4)), jax.random.fold_in(k0, 2),
+        targets=cfg_extra(cfg, "lora_targets", lora_lib.DEFAULT_TARGETS),
     )
-    alpha = float(extra.get("lora_alpha", 16.0))
+    alpha = float(cfg_extra(cfg, "lora_alpha"))
     return model, base_params, lora0, alpha
 
 

@@ -13,6 +13,7 @@ from typing import Any
 import jax.numpy as jnp
 
 from ..arguments import Config
+from ..core.flags import cfg_extra
 from . import cnn_zoo, resnet, rnn, simple
 
 
@@ -33,12 +34,12 @@ def create(cfg: Config, output_dim: int) -> Any:
         # extra.mlp_hidden widens the hidden layer (comm-compression benches
         # need leaves past the qsgd8 block size); default matches upstream
         return simple.MLP(num_classes=output_dim,
-                          hidden=int(getattr(cfg, "mlp_hidden", 128)))
+                          hidden=int(cfg_extra(cfg, "mlp_hidden")))
     # extra.fused_blocks routes the CIFAR-ResNet conv epilogues through the
-    # fused Pallas kernel (ops/pallas/fused_block.py); Config.__getattr__
-    # falls through to the extra dict, so a recipe-level `fused_blocks: true`
-    # lands here without a dedicated field
-    fused = bool(getattr(cfg, "fused_blocks", False))
+    # fused Pallas kernel (ops/pallas/fused_block.py); cfg_extra also honors
+    # a direct cfg attribute, so a recipe-level `fused_blocks: true` lands
+    # here without a dedicated field
+    fused = bool(cfg_extra(cfg, "fused_blocks"))
     if name == "resnet20":
         return resnet.resnet20(output_dim, norm, dtype, fused=fused)
     if name == "resnet32":

@@ -401,8 +401,9 @@ def exporter_from_config(cfg, **kwargs) -> Optional[OTLPExporter]:
     """The gate: an exporter (and its worker thread) exists ONLY when
     ``cfg.extra['otlp_endpoint']`` or ``$FEDML_TPU_OTLP_ENDPOINT`` is set;
     otherwise None and the default path is byte-for-byte unchanged."""
-    extra = (getattr(cfg, "extra", {}) or {}) if cfg is not None else {}
-    endpoint = extra.get("otlp_endpoint") or os.environ.get("FEDML_TPU_OTLP_ENDPOINT")
+    from ..core.flags import cfg_extra
+
+    endpoint = cfg_extra(cfg, "otlp_endpoint") or os.environ.get("FEDML_TPU_OTLP_ENDPOINT")
     if not endpoint:
         return None
     return OTLPExporter(str(endpoint), **kwargs)

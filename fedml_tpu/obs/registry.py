@@ -342,7 +342,9 @@ def maybe_start_metrics_server(cfg) -> Optional[MetricsHTTPServer]:
     """Start the exposition endpoint when ``cfg.extra['metrics_port']`` is
     set (0 = ephemeral port); None (and no server) otherwise — shared gate
     for the control plane and the cross-silo server."""
-    port = (getattr(cfg, "extra", {}) or {}).get("metrics_port")
+    from ..core.flags import cfg_extra
+
+    port = cfg_extra(cfg, "metrics_port")
     if port is None:
         return None
     return MetricsHTTPServer(REGISTRY, port=int(port)).start()

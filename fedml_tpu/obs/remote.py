@@ -148,7 +148,9 @@ class RemoteObsShipper:
     def close(self) -> None:
         self._stop.set()
         self.flush()
-        if self._rebuffer:
+        with self._lock:
+            pending = bool(self._rebuffer)
+        if pending:
             self.flush()  # the bounded retry of a batch that failed at close
         if self._thread is not None:
             self._thread.join(timeout=max(2.0, 2 * self.flush_interval_s))

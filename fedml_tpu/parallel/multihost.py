@@ -59,9 +59,10 @@ def ensure_initialized(cfg=None) -> bool:
     global _initialized
     if _initialized:
         return jax.process_count() > 1
-    extra = (getattr(cfg, "extra", {}) or {}) if cfg is not None else {}
+    from ..core.flags import cfg_extra
+
     coord = (
-        extra.get("coordinator_address")
+        cfg_extra(cfg, "coordinator_address")
         or os.environ.get("JAX_COORDINATOR_ADDRESS")
         or os.environ.get("COORDINATOR_ADDRESS")
     )
@@ -75,8 +76,8 @@ def ensure_initialized(cfg=None) -> bool:
         # second initialize
         _initialized = True
         return jax.process_count() > 1
-    nproc = int(extra.get("num_processes") or os.environ.get("JAX_NUM_PROCESSES") or 0)
-    pid = extra.get("process_id", os.environ.get("JAX_PROCESS_ID"))
+    nproc = int(cfg_extra(cfg, "num_processes") or os.environ.get("JAX_NUM_PROCESSES") or 0)
+    pid = cfg_extra(cfg, "process_id", os.environ.get("JAX_PROCESS_ID"))
     kwargs: dict[str, Any] = {"coordinator_address": coord}
     if nproc:
         kwargs["num_processes"] = nproc

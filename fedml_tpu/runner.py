@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Optional
 
 from . import constants as C
+from .core.flags import cfg_extra
 from .arguments import Config
 
 
@@ -224,7 +225,7 @@ class FedMLRunner:
 
     def _init_cross_cloud_runner(self):
         cfg = self.cfg
-        llm_mode = bool((getattr(cfg, "extra", {}) or {}).get("unitedllm", False))
+        llm_mode = bool(cfg_extra(cfg, "unitedllm"))
         if self.dataset is None:
             from .data import loader
 
@@ -252,10 +253,9 @@ class FedMLRunner:
                     "training_type='cross_silo' and deploy the result"
                 )
         dataset, model = self._load_data_model()
-        extra = getattr(cfg, "extra", {}) or {}
-        end_point = str(extra.get("end_point_name", f"ep-{cfg.run_id}"))
-        model_name = str(extra.get("serving_model_name", cfg.model))
-        version = str(extra.get("model_version", "v1"))
+        end_point = str(cfg_extra(cfg, "end_point_name", f"ep-{cfg.run_id}"))
+        model_name = str(cfg_extra(cfg, "serving_model_name", cfg.model))
+        version = str(cfg_extra(cfg, "model_version"))
         from .serving.federated import FedMLModelServingClient, FedMLModelServingServer
 
         if cfg.role == "server":

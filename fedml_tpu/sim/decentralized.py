@@ -28,6 +28,7 @@ import numpy as np
 from ..algorithms import hparams_from_config
 from ..arguments import Config
 from ..core import pytree as pt, rng
+from ..core.flags import cfg_extra
 from ..data.dataset import pad_eval_set, stack_clients
 from ..fl.local_sgd import make_eval_fn, make_local_train_fn
 from ..obs.metrics import MetricsLogger
@@ -43,7 +44,7 @@ class DecentralizedSimulator:
         self.dataset = dataset
         self.model = model
         if mode is None:
-            mode = (getattr(cfg, "extra", {}) or {}).get("decentralized_mode", "dsgd")
+            mode = cfg_extra(cfg, "decentralized_mode")
         self.mode = mode
         n = dataset.n_clients
         stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
@@ -52,7 +53,7 @@ class DecentralizedSimulator:
         self._local_train = make_local_train_fn(model, self.hp)
         self.mesh = mesh if mesh is not None else meshlib.mesh_from_config(cfg)
 
-        neighbor_num = int(getattr(cfg, "extra", {}).get("topology_neighbor_num", 2) or 2)
+        neighbor_num = int(cfg_extra(cfg, "topology_neighbor_num") or 2)
         if mode == "pushsum":
             # column-stochastic so the push weights evolve and x/w recovers
             # the uniform average (see topology.column_stochastic)

@@ -20,6 +20,7 @@ import optax
 
 from ..arguments import Config
 from ..core import pytree as pt, rng
+from ..core.flags import cfg_extra
 from ..models.gan import Discriminator, Generator
 from ..obs.metrics import MetricsLogger
 
@@ -32,8 +33,7 @@ class FedGANSimulator:
     def __init__(self, cfg: Config, dataset, mesh=None):
         self.cfg = cfg
         self.dataset = dataset
-        extra = getattr(cfg, "extra", {}) or {}
-        self.z_dim = int(extra.get("gan_z_dim", 64))
+        self.z_dim = int(cfg_extra(cfg, "gan_z_dim"))
         out_shape = tuple(dataset.train_x.shape[1:])
         self.gen = Generator(out_shape=out_shape, z_dim=self.z_dim)
         self.disc = Discriminator()

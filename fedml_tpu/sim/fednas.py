@@ -23,6 +23,7 @@ import optax
 
 from ..arguments import Config
 from ..core import rng
+from ..core.flags import cfg_extra
 from ..models.darts import DARTSSuperNet, derive_genotype
 from ..obs.metrics import MetricsLogger
 
@@ -31,13 +32,12 @@ class FedNASSimulator:
     def __init__(self, cfg: Config, dataset, mesh=None):
         self.cfg = cfg
         self.dataset = dataset
-        extra = getattr(cfg, "extra", {}) or {}
         self.model = DARTSSuperNet(
             num_classes=dataset.class_num,
-            n_cells=int(extra.get("nas_cells", 2)),
-            features=int(extra.get("nas_features", 16)),
+            n_cells=int(cfg_extra(cfg, "nas_cells")),
+            features=int(cfg_extra(cfg, "nas_features")),
         )
-        self.arch_lr = float(extra.get("nas_arch_lr", 3e-3))
+        self.arch_lr = float(cfg_extra(cfg, "nas_arch_lr"))
         k0 = rng.root_key(cfg.random_seed)
         x0 = jnp.zeros((2,) + tuple(dataset.train_x.shape[1:]), jnp.float32)
         self.variables = self.model.init({"params": k0}, x0)

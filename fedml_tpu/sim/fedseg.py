@@ -23,6 +23,7 @@ import optax
 
 from ..arguments import Config
 from ..core import rng
+from ..core.flags import cfg_extra
 from ..models.segmentation import UNet, segmentation_metrics
 from ..obs.metrics import MetricsLogger
 
@@ -47,9 +48,8 @@ class FedSegSimulator:
     def __init__(self, cfg: Config, dataset, mesh=None):
         self.cfg = cfg
         self.dataset = dataset
-        extra = getattr(cfg, "extra", {}) or {}
         self.num_classes = max(int(dataset.class_num), 2)
-        self.model = UNet(num_classes=self.num_classes, base=int(extra.get("seg_base", 8)))
+        self.model = UNet(num_classes=self.num_classes, base=int(cfg_extra(cfg, "seg_base")))
         k0 = rng.root_key(cfg.random_seed)
         feat = tuple(dataset.train_x.shape[1:])
         assert len(feat) == 3, "FedSeg needs (H, W, C) image data"

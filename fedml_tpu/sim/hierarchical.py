@@ -31,6 +31,7 @@ import numpy as np
 from ..algorithms import hparams_from_config
 from ..arguments import Config
 from ..core import pytree as pt, rng
+from ..core.flags import cfg_extra
 from ..data.dataset import pad_eval_set, stack_clients
 from ..fl.local_sgd import make_eval_fn, make_local_train_fn
 from ..obs.metrics import MetricsLogger
@@ -52,7 +53,7 @@ class HierarchicalSimulator:
         # ragged Dirichlet shards, round-robin groups can differ by 10x in
         # total work.  "round_robin" keeps the reference's even partition of
         # the client list (hierarchical_fl trainer.py:10).
-        assignment_mode = (getattr(cfg, "extra", {}) or {}).get("group_assignment", "balanced")
+        assignment_mode = cfg_extra(cfg, "group_assignment")
         if assignment_mode == "balanced":
             from ..sched.seq_scheduler import SeqTrainScheduler
 

@@ -35,6 +35,7 @@ import numpy as np
 from ..algorithms import create as create_algorithm, hparams_from_config
 from ..arguments import Config
 from ..core import pytree as pt, rng
+from ..core.flags import cfg_extra
 from ..data.dataset import pad_eval_set, stack_clients
 from ..fl.local_sgd import make_eval_fn, make_local_train_fn
 from ..obs.metrics import MetricsLogger
@@ -45,9 +46,8 @@ class TurboAggregateSimulator:
         self.cfg = cfg
         self.dataset = dataset
         self.model = model
-        extra = getattr(cfg, "extra", {}) or {}
-        self.n_groups = max(2, int(extra.get("ta_group_num", 4)))
-        self.dropout_prob = float(extra.get("ta_dropout_prob", 0.0))
+        self.n_groups = max(2, int(cfg_extra(cfg, "ta_group_num")))
+        self.dropout_prob = float(cfg_extra(cfg, "ta_dropout_prob"))
 
         stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
         spe = max(1, -(-stacked.capacity // cfg.batch_size))

@@ -27,6 +27,7 @@ from flax import linen as nn
 from ..algorithms import hparams_from_config
 from ..arguments import Config
 from ..core import rng
+from ..core.flags import cfg_extra
 from ..obs.metrics import MetricsLogger
 
 
@@ -57,7 +58,7 @@ class VFLSimulator:
     def __init__(self, cfg: Config, dataset, mesh=None):
         self.cfg = cfg
         self.dataset = dataset
-        self.n_parties = max(2, int(getattr(cfg, "extra", {}).get("vfl_party_num", 2) or 2))
+        self.n_parties = max(2, int(cfg_extra(cfg, "vfl_party_num") or 2))
         x = dataset.train_x.reshape(dataset.train_x.shape[0], -1).astype(np.float32)
         tx = dataset.test_x.reshape(dataset.test_x.shape[0], -1).astype(np.float32)
         d = x.shape[1]
@@ -75,7 +76,7 @@ class VFLSimulator:
 
         spe = max(1, math.ceil(x.shape[0] / cfg.batch_size))
         self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
-        embed = int(getattr(cfg, "extra", {}).get("vfl_embed_dim", 16) or 16)
+        embed = int(cfg_extra(cfg, "vfl_embed_dim") or 16)
         self.bottom = PartyBottom(embed_dim=embed)
         self.top = HostTop(num_classes=dataset.class_num)
 

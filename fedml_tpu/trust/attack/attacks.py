@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...core.flags import cfg_extra
+
 log = logging.getLogger("fedml_tpu.trust.attack")
 
 
@@ -180,11 +182,10 @@ class FedMLAttacker:
                 f"unknown attack_type {self.attack_type!r}; known: {sorted(KNOWN_ATTACKS)}"
             )
         self.attackers = tuple(getattr(cfg, "poisoned_client_list", ()) or ())
-        extra = getattr(cfg, "extra", {}) or {}
-        self.boost = float(extra.get("attack_boost", 10.0))
-        self.original_class = int(extra.get("attack_original_class", 0))
-        self.target_class = int(extra.get("attack_target_class", 1))
-        self.poison_frac = float(extra.get("attack_poison_frac", 0.5))
+        self.boost = float(cfg_extra(cfg, "attack_boost"))
+        self.original_class = int(cfg_extra(cfg, "attack_original_class"))
+        self.target_class = int(cfg_extra(cfg, "attack_target_class"))
+        self.poison_frac = float(cfg_extra(cfg, "attack_poison_frac"))
 
     def is_model_attack(self) -> bool:
         return self.enabled and self.attack_type in MODEL_ATTACKS
@@ -216,10 +217,9 @@ class FedMLAttacker:
 
             from ...data.extra_loaders import load_edge_case_sets
 
-            extra = getattr(self.cfg, "extra", {}) or {}
             sets = load_edge_case_sets(
                 Path(os.path.expanduser(getattr(self.cfg, "data_cache_dir", "") or ".")),
-                str(extra.get("edge_case_type", "southwest")),
+                str(cfg_extra(self.cfg, "edge_case_type")),
             )
             new_x, new_y = edge_case_backdoor(
                 ds.train_x, ds.client_idx, self.attackers,

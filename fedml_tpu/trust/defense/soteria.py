@@ -23,6 +23,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...core.flags import cfg_extra
 from .base import Defense
 
 
@@ -62,8 +63,7 @@ class SoteriaDefense(Defense):
 
     def __init__(self, cfg=None):
         super().__init__(cfg)
-        extra = (getattr(cfg, "extra", {}) or {}) if cfg is not None else {}
-        self.percentile = float(extra.get("soteria_percentile", 1.0))
+        self.percentile = float(cfg_extra(cfg, "soteria_percentile"))
 
     def before(self, updates, weights, global_flat):
         delta = updates - global_flat[None, :]
@@ -77,9 +77,8 @@ class WBCDefense(Defense):
 
     def __init__(self, cfg=None):
         super().__init__(cfg)
-        extra = (getattr(cfg, "extra", {}) or {}) if cfg is not None else {}
-        self.strength = float(extra.get("wbc_pert_strength", 1.0))
-        self.lr = float(extra.get("wbc_lr", 0.1))
+        self.strength = float(cfg_extra(cfg, "wbc_pert_strength"))
+        self.lr = float(cfg_extra(cfg, "wbc_lr"))
         self._prev_delta = None
         self._key = jax.random.PRNGKey(0)
 

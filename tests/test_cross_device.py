@@ -27,7 +27,10 @@ def test_cross_device_runner_with_native_fleet(native_binary, tmp_path, eight_de
         client_num_in_total=2, client_num_per_round=2, comm_round=2,
         batch_size=16, synthetic_train_size=320, synthetic_test_size=160,
         frequency_of_the_test=1,
-        extra={"tcp_base_port": base_port, "global_model_file_path": str(artifact)},
+        # global_model_file_path is a typed Config field (YAML model_args
+        # lands there); only tcp_base_port is an extra knob
+        global_model_file_path=str(artifact),
+        extra={"tcp_base_port": base_port},
     )
     fedml_tpu.init(cfg)
     from fedml_tpu.data import loader

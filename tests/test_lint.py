@@ -1,0 +1,393 @@
+"""The lint engine's own tests (ISSUE 5).
+
+Each GL rule is proven BOTH ways on fixture packages — it fires on the
+violation and goes quiet under a ``# graftlint: disable=...`` — plus the
+baseline round-trips, and the real ``fedml_tpu`` package lints clean with
+the SHIPPED (empty) baseline: the same invariant the tier-1 gate enforces
+forever after.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from fedml_tpu.analysis.engine import run_lint
+from fedml_tpu.analysis.findings import (
+    Finding, load_baseline, parse_suppressions, save_baseline,
+)
+
+PKG_ROOT = Path(__file__).resolve().parent.parent / "fedml_tpu"
+
+#: a minimal registry module for GL001 fixtures
+FLAGS_FIXTURE = """
+    class FlagSpec:
+        def __init__(self, name, type, default, doc):
+            pass
+
+    FLAGS = {
+        "declared_flag": FlagSpec("declared_flag", "int", 1, "declared + read"),
+        "dead_flag": FlagSpec("dead_flag", "bool", False, "declared, never read"),
+    }
+"""
+
+
+def lint_files(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_lint(tmp_path)
+
+
+def rules_fired(result):
+    return {f.rule for f in result.findings}
+
+
+# -- GL001: flag registry -----------------------------------------------------
+
+def test_gl001_undeclared_read_fires(tmp_path):
+    r = lint_files(tmp_path, {
+        "core/flags.py": FLAGS_FIXTURE,
+        "mod.py": """
+            from .core.flags import cfg_extra
+
+            def f(cfg):
+                return cfg_extra(cfg, "mystery_flag")
+        """,
+    })
+    assert any(f.rule == "GL001" and "mystery_flag" in f.message for f in r.findings)
+
+
+def test_gl001_declared_cfg_extra_read_is_clean(tmp_path):
+    r = lint_files(tmp_path, {
+        "core/flags.py": FLAGS_FIXTURE,
+        "mod.py": """
+            from .core.flags import cfg_extra
+
+            def f(cfg):
+                return cfg_extra(cfg, "declared_flag", 3)
+        """,
+    })
+    assert not any(f.symbol == "undeclared:declared_flag" for f in r.findings)
+    # only the dead_flag declaration should fire
+    assert [f.symbol for f in r.findings] == ["dead:dead_flag"]
+
+
+def test_gl001_dead_declaration_fires_and_reads_clear_it(tmp_path):
+    r = lint_files(tmp_path, {"core/flags.py": FLAGS_FIXTURE, "mod.py": "x = 1\n"})
+    symbols = {f.symbol for f in r.findings if f.rule == "GL001"}
+    assert symbols == {"dead:dead_flag", "dead:declared_flag"}
+
+
+def test_gl001_legacy_idioms_fire(tmp_path):
+    r = lint_files(tmp_path, {
+        "core/flags.py": FLAGS_FIXTURE,
+        "mod.py": """
+            def f(cfg):
+                extra = getattr(cfg, "extra", {}) or {}
+                a = extra.get("declared_flag", 1)
+                b = (getattr(cfg, "extra", {}) or {}).get("inline_flag")
+                c = extra["declared_flag"]
+                return a, b, c
+        """,
+    })
+    syms = {f.symbol for f in r.findings if f.rule == "GL001"}
+    assert "legacy:declared_flag" in syms           # .get and subscript
+    assert "legacy:inline_flag" in syms             # inline chained idiom
+    assert "undeclared:inline_flag" in syms         # and it is undeclared too
+
+
+def test_gl001_nonliteral_name_fires_and_suppression_silences(tmp_path):
+    r = lint_files(tmp_path, {
+        "core/flags.py": FLAGS_FIXTURE,
+        "mod.py": """
+            from .core.flags import cfg_extra
+
+            def f(cfg, name):
+                bad = cfg_extra(cfg, name)
+                ok = cfg_extra(cfg, name)  # graftlint: disable=GL001(fixture reason)
+                return bad, ok
+        """,
+    })
+    nonliteral = [f for f in r.findings if f.symbol.startswith("nonliteral")]
+    assert len(nonliteral) == 1
+    assert len(r.suppressed) == 1
+
+
+def test_gl001_duck_typed_getattr_counts_as_read(tmp_path):
+    # getattr(cfg, "<declared flag>", d) keeps a declaration alive but is
+    # not itself flagged (Config.__getattr__ falls through to extra)
+    r = lint_files(tmp_path, {
+        "core/flags.py": FLAGS_FIXTURE,
+        "mod.py": """
+            def f(cfg):
+                return getattr(cfg, "declared_flag", False)
+        """,
+    })
+    assert [f.symbol for f in r.findings] == ["dead:dead_flag"]
+
+
+# -- GL002: jit purity --------------------------------------------------------
+
+GL002_CASES = [
+    ("import time\nimport jax\n\ndef step(x):\n    t = time.time()\n    return x + t\n\njitted = jax.jit(step)\n",
+     "host clock"),
+    ("import numpy as np\nimport jax\n\ndef step(x):\n    return x + np.random.rand()\n\njitted = jax.jit(step)\n",
+     "host randomness"),
+    ("import jax\n\ndef step(x):\n    print(x)\n    return x\n\njitted = jax.jit(step)\n",
+     "print"),
+    ("import logging\nimport jax\nlog = logging.getLogger(__name__)\n\ndef step(x):\n    log.info('hi')\n    return x\n\njitted = jax.jit(step)\n",
+     "logging"),
+    ("import jax\n\ndef outer():\n    n = 0\n    def step(x):\n        nonlocal n\n        n += 1\n        return x\n    return jax.jit(step)\n",
+     "nonlocal"),
+]
+
+
+@pytest.mark.parametrize("src,what", GL002_CASES, ids=[w for _, w in GL002_CASES])
+def test_gl002_impurities_fire(tmp_path, src, what):
+    r = lint_files(tmp_path, {"mod.py": src})
+    assert rules_fired(r) == {"GL002"}, (what, r.render())
+
+
+def test_gl002_metric_and_scan_and_decorator_forms(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+        from .obs import registry as obsreg
+
+        COUNTER = obsreg.REGISTRY.counter("fedml_fixture_total", "doc")
+
+        @jax.jit
+        def decorated(x):
+            COUNTER.inc()
+            return x
+
+        def body(carry, x):
+            COUNTER.inc()
+            return carry, x
+
+        def run(xs):
+            return jax.lax.scan(body, 0, xs)
+    """})
+    gl002 = [f for f in r.findings if f.rule == "GL002"]
+    assert len(gl002) == 2  # the decorated fn AND the scan body
+    assert all("metric mutation" in f.message for f in gl002)
+
+
+def test_gl002_pure_fn_and_suppression(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import time
+        import jax
+
+        def pure(x):
+            return x * 2
+
+        def timed(x):
+            t = time.time()  # graftlint: disable=GL002(fixture: trace-time stamp is intended)
+            return x + t
+
+        a = jax.jit(pure)
+        b = jax.jit(timed)
+    """})
+    assert not r.findings
+    assert len(r.suppressed) == 1
+
+
+# -- GL003: donation safety ---------------------------------------------------
+
+def test_gl003_read_after_donation_fires(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+
+        def run(state, x):
+            step = jax.jit(lambda s, v: s, donate_argnums=(0,))
+            out = step(state, x)
+            return state  # read after donation
+    """})
+    assert [f.rule for f in r.findings] == ["GL003"]
+    assert "state" in r.findings[0].message
+
+
+def test_gl003_rebinding_is_clean_and_conditional_donate_unions(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+
+        def ok(state, x):
+            step = jax.jit(lambda s, v: s, donate_argnums=(0,))
+            state = step(state, x)   # the correct donate idiom: rebind
+            return state
+
+        def conditional(state, x, on_cpu):
+            donate = () if on_cpu else (0,)
+            step = jax.jit(lambda s, v: s, donate_argnums=donate)
+            out = step(state, x)
+            return state  # donated on SOME path -> finding
+    """})
+    assert len(r.findings) == 1
+    assert r.findings[0].line > 0 and r.findings[0].rule == "GL003"
+
+
+def test_gl003_suppression(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+
+        def run(state, x):
+            step = jax.jit(lambda s, v: s, donate_argnums=(0,))
+            out = step(state, x)
+            return state  # graftlint: disable=GL003(fixture: CPU-gated path)
+    """})
+    assert not r.findings and len(r.suppressed) == 1
+
+
+# -- GL004: lock discipline ---------------------------------------------------
+
+GL004_SRC = """
+    import threading
+
+    class Manager:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.counter = 0   # ctor writes are exempt
+
+        def locked_write(self):
+            with self._lock:
+                self.counter += 1
+
+        def racy_read(self):
+            return self.counter
+
+        def documented(self):  # graftlint: disable=GL004(caller holds _lock)
+            return self.counter
+"""
+
+
+def test_gl004_fires_outside_lock_and_def_line_suppression_covers_body(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": GL004_SRC})
+    assert [f.rule for f in r.findings] == ["GL004"]
+    assert "Manager.counter" in r.findings[0].symbol
+    assert len(r.suppressed) == 1  # documented() is covered by its def line
+
+
+def test_gl004_lockless_class_is_ignored(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        class Plain:
+            def __init__(self):
+                self.counter = 0
+
+            def bump(self):
+                self.counter += 1
+    """})
+    assert not r.findings
+
+
+# -- GL005: metric namespace --------------------------------------------------
+
+def test_gl005_bad_name_label_and_le(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        from .obs import registry as obsreg
+
+        BAD_NAME = obsreg.REGISTRY.counter("unnamespaced_total", "doc")
+        BAD_LABEL = obsreg.REGISTRY.gauge("fedml_ok", "doc", labels=("Client",))
+        RESERVED = obsreg.REGISTRY.histogram("fedml_h", "doc", labels=("le",))
+        GOOD = obsreg.REGISTRY.counter("fedml_good_total", "doc", labels=("client",))
+    """})
+    syms = {f.symbol for f in r.findings if f.rule == "GL005"}
+    assert syms == {"unnamespaced_total", "fedml_ok:Client", "fedml_h:le"}
+
+
+def test_gl005_suppression(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        from .obs import registry as obsreg
+
+        LEGACY = obsreg.REGISTRY.counter("legacy_total", "doc")  # graftlint: disable=GL005(fixture: grandfathered dashboard)
+    """})
+    assert not r.findings and len(r.suppressed) == 1
+
+
+# -- suppressions / baseline machinery ---------------------------------------
+
+def test_parse_suppressions_multiple_ids_and_reasons():
+    sup = parse_suppressions(
+        "x = 1  # graftlint: disable=GL001(why),GL004\n"
+        "y = 2\n"
+        "z = 3  # graftlint: disable=GL005\n"
+    )
+    assert sup == {1: {"GL001", "GL004"}, 3: {"GL005"}}
+
+
+def test_baseline_round_trip(tmp_path):
+    files = {
+        "core/flags.py": FLAGS_FIXTURE,
+        "mod.py": "def f(cfg):\n    extra = getattr(cfg, 'extra', {}) or {}\n    return extra.get(\"rogue\")\n",
+    }
+    r = lint_files(tmp_path, files)
+    assert r.findings
+    baseline = tmp_path / "baseline.json"
+    save_baseline(baseline, r.findings)
+    assert load_baseline(baseline) == {f.key for f in r.findings}
+    r2 = run_lint(tmp_path, baseline=baseline)
+    assert r2.ok and len(r2.baselined) == len(r.findings)
+
+
+def test_baseline_keys_are_line_independent():
+    a = Finding("GL001", "m.py", 10, "msg", symbol="undeclared:x")
+    b = Finding("GL001", "m.py", 99, "msg", symbol="undeclared:x")
+    assert a.key == b.key
+
+
+def test_unparseable_file_is_reported_not_crashed(tmp_path):
+    r = lint_files(tmp_path, {"broken.py": "def f(:\n"})
+    assert not r.ok and r.errors and "broken.py" in r.errors[0]
+
+
+# -- the real package ---------------------------------------------------------
+
+def test_fedml_tpu_package_lints_clean_with_shipped_baseline():
+    """The tier-1 gate: every rule active over the real package, zero
+    unsuppressed findings, and the SHIPPED baseline stays empty."""
+    baseline_path = PKG_ROOT / "analysis" / "baseline.json"
+    assert load_baseline(baseline_path) == set(), (
+        "the shipped baseline must stay EMPTY — fix or inline-suppress new "
+        "findings instead of baselining them")
+    result = run_lint(PKG_ROOT, baseline=baseline_path)
+    assert result.ok, "\n" + result.render()
+
+
+def test_cli_lint_json_over_package():
+    from fedml_tpu.cli import main
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["lint", "--format", "json"])
+    doc = json.loads(buf.getvalue())
+    assert rc == 0 and doc["ok"] and doc["findings"] == []
+
+
+# -- the flag registry + accessor --------------------------------------------
+
+def test_cfg_extra_resolution_order_and_undeclared_rejection():
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.core.flags import FLAGS, cfg_extra
+
+    cfg = Config(extra={"gan_z_dim": 32})
+    assert cfg_extra(cfg, "gan_z_dim") == 32           # extra dict
+    assert cfg_extra(cfg, "seg_base") == 8             # registry default
+    assert cfg_extra(cfg, "seg_base", 99) == 99        # explicit default wins
+    assert cfg_extra(None, "seg_base") == 8            # cfg=None short-circuit
+    cfg.fused_blocks = True
+    assert cfg_extra(cfg, "fused_blocks") is True      # direct attr wins
+    with pytest.raises(KeyError):
+        cfg_extra(cfg, "not_a_flag")
+    assert all(s.name == n for n, s in FLAGS.items())
+
+
+def test_flag_reference_renders_every_flag():
+    from fedml_tpu.core.flags import FLAGS, render_flag_reference
+
+    doc = render_flag_reference()
+    for name in FLAGS:
+        assert f"`{name}`" in doc

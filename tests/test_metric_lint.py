@@ -1,18 +1,23 @@
-"""Global-registry metric lint (ISSUE 3 satellite).
+"""Global-registry metric lint (ISSUE 3 satellite; ISSUE 5 moved the
+name/label rule into the static engine as GL005).
 
-Every family registered in the process-global registry by any instrumented
-layer must carry the ``fedml_`` namespace (``fedml_[a-z0-9_]+``) with valid
-label names, and a name can never be re-registered with a conflicting
-type/label set — the registry enforces it, this test proves it stays
-enforced.  Runs against the real global registry after importing every
-module that registers metrics, so a new metric with a bad name fails CI
-here, not in someone's Grafana.
+The namespace rule itself now lives in
+``fedml_tpu/analysis/rules/gl005_metrics.py`` and runs over every module in
+tier-1 via ``fedml-tpu lint`` — this file DELEGATES to it (same compiled
+regexes, plus a whole-package static pass) and keeps the complementary
+RUNTIME checks the static rule cannot do: families registered with computed
+names, and re-registration conflict behavior of the live registry.
 """
 
 import importlib
-import re
 
 import pytest
+
+from fedml_tpu.analysis.rules.gl005_metrics import (
+    LABEL_RE as _LABEL,
+    METRIC_NAME_RE as _NAME,
+    MetricNamespaceRule,
+)
 
 #: every module that registers families in the global registry — extend this
 #: list when instrumenting a new layer
@@ -27,8 +32,19 @@ INSTRUMENTED_MODULES = [
     "fedml_tpu.sim.engine",
 ]
 
-_NAME = re.compile(r"fedml_[a-z0-9_]+")
-_LABEL = re.compile(r"[a-z][a-z0-9_]*")
+
+def test_static_gl005_pass_over_package_is_clean():
+    """The engine's own rule over the real package: every literal
+    REGISTRY.counter/gauge/histogram registration anywhere in fedml_tpu/
+    (imported by a test or not) is fedml_-namespaced with valid labels."""
+    from pathlib import Path
+
+    from fedml_tpu.analysis.engine import run_lint
+
+    pkg = Path(importlib.import_module("fedml_tpu").__file__).parent
+    result = run_lint(pkg, rules=[MetricNamespaceRule()],
+                      baseline=pkg / "analysis" / "baseline.json")
+    assert result.ok, "\n" + result.render()
 
 
 def test_global_registry_names_are_namespaced_and_unique():
