@@ -18,6 +18,11 @@ Three benches, one JSON line:
    ResNet-20 pytree (floor 3.5x, platform independent) plus an in-proc
    4-client e2e raw-vs-qsgd8 A/B — wall/round, wire bytes, payload
    compression ratio, peak buffered updates (streaming accumulator <= 2).
+4. **Million-client population round** (ISSUE 6): a 1M-id population in the
+   sharded on-disk client store, a 10k-client cohort per round streamed
+   through the vmapped round step — samples/s/chip, gather/scatter seconds,
+   prefetch overlap, and a cohort-bounded host-RSS ceiling (platform
+   independent, floor-guarded).
 
 The reference publishes no numeric baselines (BASELINE.md) and has no MFU
 accounting at all; the 0.35 target comes from BASELINE.json's north star.
@@ -221,6 +226,89 @@ def bench_crosssilo():
     }
 
 
+def bench_population():
+    """Million-client population round (ISSUE 6): a 1M-id population backed
+    by the sharded on-disk client store, a 10k-client active cohort per
+    round streamed through the MeshSimulator's vmapped round step with
+    double-buffered prefetch.
+
+    Platform independent (the population layer is host-side; the round runs
+    wherever the chips are), so it runs on CPU too.  The guarded number is
+    ``rss_multiple``: tracemalloc peak of the streamed rounds over the
+    cohort's data bytes — the store's bounded LRU (8 shards of 4096 clients
+    ≈ 3.3x a 10k cohort) plus the double-buffered gather must keep host
+    memory proportional to the COHORT, never the 1M population."""
+    import tempfile
+    import tracemalloc
+
+    import numpy as np
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.runner import FedMLRunner
+    from fedml_tpu.population.store import GATHER_TIME, SCATTER_TIME
+
+    population = int(os.environ.get("BENCH_POP_SIZE", "1000000"))
+    cohort = int(os.environ.get("BENCH_POP_COHORT", "10000"))
+    rounds = int(os.environ.get("BENCH_POP_ROUNDS", "3"))
+    batch = 16
+    samples_per_client = 16
+    base_clients = 64
+
+    with tempfile.TemporaryDirectory() as root:
+        cfg = Config(
+            dataset="synthetic", model="lr",
+            client_num_in_total=base_clients, client_num_per_round=cohort,
+            comm_round=rounds + 1, epochs=1, batch_size=batch,
+            learning_rate=0.1, partition_method="homo",
+            synthetic_train_size=base_clients * samples_per_client,
+            synthetic_test_size=512, frequency_of_the_test=0,
+            compute_dtype="float32", metrics_jsonl_path="",
+            extra={"population_store": root, "population_size": population},
+        )
+        fedml_tpu.init(cfg)
+        sim = FedMLRunner(cfg).runner
+        sim.run_rounds(1)  # compile + warm (materializes the first shards)
+        g0, g0n = GATHER_TIME.sum(), GATHER_TIME.count()
+        s0 = SCATTER_TIME.sum()
+        tracemalloc.start()
+        t0 = time.perf_counter()
+        history = sim.run_rounds(rounds)
+        dt = time.perf_counter() - t0
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        pop = sim._population
+        spec = pop.store.spec
+        sample_bytes = (
+            int(np.prod(spec.x_shape or (1,))) * np.dtype(spec.x_dtype).itemsize
+            + int(np.prod(spec.y_shape or (1,))) * np.dtype(spec.y_dtype).itemsize)
+        cohort_bytes = cohort * spec.capacity * sample_bytes
+        overlap = pop.pipeline.overlap_mean()
+        shards_on_disk = len([f for f in os.listdir(root) if f.endswith(".npz")])
+
+    steps_per_client = -(-samples_per_client // batch)
+    samples_per_round = cohort * steps_per_client * batch
+    n_chips = len(jax.devices())
+    return {
+        "population_clients": population,
+        "cohort_clients": cohort,
+        "rounds": rounds,
+        "samples_per_sec_chip": round(samples_per_round * rounds / dt / n_chips, 1),
+        "rounds_per_sec": round(rounds / dt, 4),
+        "train_loss_last": round(float(history[-1]["train_loss"]), 4),
+        "gather_seconds": round(GATHER_TIME.sum() - g0, 4),
+        "gathers": int(GATHER_TIME.count() - g0n),
+        "scatter_seconds": round(SCATTER_TIME.sum() - s0, 4),
+        "prefetch_overlap_fraction": round(overlap, 4) if overlap is not None else None,
+        "cohort_bytes": int(cohort_bytes),
+        "peak_tracemalloc_bytes": int(peak),
+        "rss_multiple": round(peak / cohort_bytes, 3),
+        "shards_touched": shards_on_disk,
+        "shard_size": spec.shard_size,
+    }
+
+
 def bench_llm(peak):
     import jax
     import jax.numpy as jnp
@@ -291,6 +379,8 @@ def _run_one(mode):
         result = bench_fedavg(peak, fused=True)
     elif mode == "crosssilo":
         result = bench_crosssilo()
+    elif mode == "population":
+        result = bench_population()
     else:
         result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
@@ -340,6 +430,11 @@ FEDAVG_MFU_FLOOR = 0.125
 #: qsgd8 wire ratio on the ResNet-20 pytree — platform independent (int8 +
 #: per-block scales vs f32), so it is asserted on CPU too
 CROSSSILO_QSGD8_RATIO_FLOOR = 3.5
+#: Peak host memory of the streamed 1M-population rounds, as a multiple of
+#: the active cohort's data bytes — platform independent (host-side layer).
+#: Budget: 8 resident shards of 4096 clients ≈ 3.3x a 10k cohort, plus the
+#: double-buffered in-flight cohorts and npz materialization transients.
+POPULATION_RSS_MULTIPLE_FLOOR = 16.0
 
 
 def main():
@@ -374,6 +469,10 @@ def main():
     # ISSUE-4: compressed streaming cross-silo rounds (in-proc backend) —
     # bytes-on-wire, compression ratio, and round wall time raw vs qsgd8
     crosssilo = _subprocess_bench("crosssilo")
+    # ISSUE-6: 1M-client population round streamed from the sharded store —
+    # samples/s/chip at a 10k cohort, gather/scatter seconds, prefetch
+    # overlap, and the cohort-bounded host-RSS multiple (floor-guarded)
+    population = _subprocess_bench("population")
 
     on_tpu = "TPU" in str(llm.get("device", ""))
     # one retry per bench before declaring a floor violation: a tunneled chip
@@ -391,6 +490,11 @@ def main():
     if cs_ratio is not None and cs_ratio < CROSSSILO_QSGD8_RATIO_FLOOR:
         violations.append(
             f"crosssilo qsgd8 ratio {cs_ratio} < floor {CROSSSILO_QSGD8_RATIO_FLOOR}")
+    pop_rss = population.get("rss_multiple")
+    if pop_rss is not None and pop_rss > POPULATION_RSS_MULTIPLE_FLOOR:
+        violations.append(
+            f"population rss multiple {pop_rss} > ceiling "
+            f"{POPULATION_RSS_MULTIPLE_FLOOR} (host memory not cohort-bounded)")
 
     mfu = llm["mfu"]
     target = 0.35  # BASELINE.md MFU floor
@@ -413,6 +517,7 @@ def main():
             "fedavg_cifar10_resnet20_fused": fedavg_fused,
             "fedavg_fused_speedup": fused_speedup,
             "crosssilo_comm": crosssilo,
+            "population": population,
             "lint": lint_section,
         },
     }))

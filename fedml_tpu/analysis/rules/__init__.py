@@ -10,6 +10,7 @@ from .gl002_jit_purity import JitPurityRule
 from .gl003_donation import DonationSafetyRule
 from .gl004_locks import LockDisciplineRule
 from .gl005_metrics import MetricNamespaceRule
+from .gl006_tracer_branch import TracerBranchRule
 
 ALL_RULES = [
     FlagRegistryRule,
@@ -17,7 +18,8 @@ ALL_RULES = [
     DonationSafetyRule,
     LockDisciplineRule,
     MetricNamespaceRule,
+    TracerBranchRule,
 ]
 
 __all__ = ["ALL_RULES", "FlagRegistryRule", "JitPurityRule", "DonationSafetyRule",
-           "LockDisciplineRule", "MetricNamespaceRule"]
+           "LockDisciplineRule", "MetricNamespaceRule", "TracerBranchRule"]

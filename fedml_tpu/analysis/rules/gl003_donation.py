@@ -6,23 +6,27 @@ in-place reuse: the caller's arrays are invalid afterwards, and on XLA:CPU
 historical wandering segfaults (``sim/engine.py``, ROADMAP).  The rule
 tracks, per function scope:
 
-1. names bound to ``jax.jit(fn, donate_argnums=<positions>)`` (constant
-   tuples/ints, ``name = <const>`` indirection, and either arm of a
-   conditional expression are resolved);
-2. calls through those names — positional args that are plain names become
-   tainted at the call line;
+1. names bound to ``jax.jit(fn, donate_argnums=<positions>)`` or
+   ``jax.jit(fn, donate_argnames=<names>)`` (constant tuples/ints/strs,
+   ``name = <const>`` indirection, and either arm of a conditional
+   expression are resolved; argNAMES map to positions when the jitted
+   callable is a lambda whose parameter list is visible);
+2. calls through those names — positional args at donated positions and
+   keyword args matching donated argnames become tainted at the call line;
+   a ``*args`` splat covering a donated position taints the splatted
+   sequence name itself (its elements were donated through it);
 3. any later ``Load`` of a tainted name in the same scope is a finding,
    until an assignment rebinds it (the ``x = donating_fn(x)`` idiom is the
    correct pattern and stays clean).
 
-Starred/keyword args and attribute targets are out of static reach and are
-skipped — the rule is deliberately precise-over-complete so every finding
-is actionable.
+Attribute targets remain out of static reach and are skipped — the rule is
+deliberately precise-over-complete so every finding is actionable.
 """
 
 from __future__ import annotations
 
 import ast
+from dataclasses import dataclass
 from typing import Iterable, Optional
 
 from ..engine import Finding, ModuleInfo, Rule, dotted_name
@@ -51,17 +55,65 @@ def _const_positions(node: ast.AST, env: dict[str, ast.AST], depth: int = 0) -> 
     return None
 
 
-def _jit_donations(call: ast.Call, env: dict[str, ast.AST]) -> Optional[set[int]]:
-    """Donated positions when ``call`` is a jax.jit/pjit with donate_argnums."""
+def _const_names(node: ast.AST, env: dict[str, ast.AST], depth: int = 0) -> set[str]:
+    """Evaluate a donate_argnames expression to a set of parameter names."""
+    if depth > 4:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: set[str] = set()
+        for elt in node.elts:
+            out |= _const_names(elt, env, depth + 1)
+        return out
+    if isinstance(node, ast.IfExp):
+        return _const_names(node.body, env, depth + 1) | \
+            _const_names(node.orelse, env, depth + 1)
+    if isinstance(node, ast.Name) and node.id in env:
+        return _const_names(env[node.id], env, depth + 1)
+    return set()
+
+
+def _callable_params(node: ast.AST) -> Optional[list[str]]:
+    """Positional parameter names of an inline lambda target (the one form
+    whose signature is visible at the jit() call itself)."""
+    if isinstance(node, ast.Lambda):
+        return [a.arg for a in node.args.args]
+    return None
+
+
+@dataclass(frozen=True)
+class _Donation:
+    """What a jitted name donates: argument positions and/or argnames."""
+
+    positions: frozenset
+    names: frozenset
+
+    def __bool__(self) -> bool:
+        return bool(self.positions) or bool(self.names)
+
+
+def _jit_donations(call: ast.Call, env: dict[str, ast.AST]) -> Optional[_Donation]:
+    """The donation set when ``call`` is a jax.jit/pjit with donate_arg*."""
     chain = dotted_name(call.func)
     if chain.rsplit(".", 1)[-1] not in ("jit", "pjit"):
         return None
+    positions: set[int] = set()
+    names: set[str] = set()
+    seen = False
     for kw in call.keywords:
-        if kw.arg in ("donate_argnums", "donate_argnames"):
-            if kw.arg == "donate_argnames":
-                return set()  # names unmappable statically; still jit-tracked
-            return _const_positions(kw.value, env)
-    return None
+        if kw.arg == "donate_argnums":
+            seen = True
+            positions |= _const_positions(kw.value, env) or set()
+        elif kw.arg == "donate_argnames":
+            seen = True
+            got = _const_names(kw.value, env)
+            names |= got
+            # map names to positions when the callable's signature is visible
+            params = _callable_params(call.args[0]) if call.args else None
+            if params is not None:
+                positions |= {params.index(n) for n in got if n in params}
+    return _Donation(frozenset(positions), frozenset(names)) if seen else None
 
 
 class DonationSafetyRule(Rule):
@@ -73,7 +125,7 @@ class DonationSafetyRule(Rule):
 
         def scan_scope(body: list[ast.stmt]) -> None:
             env: dict[str, ast.AST] = {}          # simple name -> last value expr
-            donating: dict[str, set[int]] = {}    # jitted-fn name -> positions
+            donating: dict[str, _Donation] = {}   # jitted-fn name -> donations
             tainted: dict[str, int] = {}          # var -> donation line
 
             class ScopeVisitor(ast.NodeVisitor):
@@ -101,7 +153,7 @@ class DonationSafetyRule(Rule):
 
                 def visit_Call(self, node):
                     # direct jax.jit(f, donate_argnums=...)(a, b) application
-                    donated: Optional[set[int]] = None
+                    donated: Optional[_Donation] = None
                     if isinstance(node.func, ast.Call):
                         donated = _jit_donations(node.func, env)
                     elif isinstance(node.func, ast.Name) and node.func.id in donating:
@@ -109,12 +161,20 @@ class DonationSafetyRule(Rule):
                     if donated:
                         for pos, arg in enumerate(node.args):
                             if isinstance(arg, ast.Starred):
-                                break  # positions unknowable past a splat
-                            if pos in donated and isinstance(arg, ast.Name):
+                                # the splat covers every remaining position:
+                                # if any of them is donated, the splatted
+                                # sequence's buffers went with the call
+                                if isinstance(arg.value, ast.Name) and any(
+                                        p >= pos for p in donated.positions):
+                                    tainted.setdefault(arg.value.id, node.lineno)
+                                break
+                            if pos in donated.positions and isinstance(arg, ast.Name):
                                 tainted.setdefault(arg.id, node.lineno)
                         # args themselves are reads AT the call — fine; visit
                         # keywords/func only so the donated args don't self-flag
                         for kw in node.keywords:
+                            if kw.arg in donated.names and isinstance(kw.value, ast.Name):
+                                tainted.setdefault(kw.value.id, node.lineno)
                             self.visit(kw.value)
                         return
                     self.generic_visit(node)

@@ -1,0 +1,254 @@
+"""GL006 — no Python-side control flow on traced values.
+
+Inside a function traced by ``jax.jit`` / ``pjit`` / ``jax.lax.scan`` /
+``pallas_call``, the arguments are TRACERS: ``if x:`` (or ``while x:``,
+``x if c else y``, ``assert x``) forces a concrete boolean out of an
+abstract value and raises ``TracerBoolConversionError`` at trace time — or
+worse, when the value happens to be weakly-typed-concrete at trace time,
+silently BAKES one branch into the compiled program (the classic
+"conditional evaluated once, at compile time" bug).  Use ``jax.lax.cond`` /
+``jnp.where`` / ``lax.select`` instead.
+
+The rule reuses GL002's static resolution of traced callables (inline
+lambdas, local/module ``def``s handed to a jit entry, decorator and
+``partial`` forms).  Within a traced body it taints the function's
+parameters (``self``/``cls`` excluded) and propagates through assignments,
+tuple unpacking, and ``for`` targets; a branch condition containing a
+tainted name is a finding.
+
+Deliberately NOT flagged — these are static (Python-value) predicates on
+structure, not on traced data:
+
+- ``x is None`` / ``x is not None`` (optional-pytree dispatch, e.g. the
+  engine's stateless-algorithm branch);
+- ``isinstance(x, ...)`` / ``callable(x)`` / ``hasattr(x, ...)``;
+- ``len(x)`` and the static array attributes ``x.shape`` / ``x.ndim`` /
+  ``x.size`` / ``x.dtype`` (shape math is resolved at trace time by
+  design).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name
+from .gl002_jit_purity import _is_jit_entry, _local_defs
+
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+_STATIC_CALLS = {"isinstance", "callable", "hasattr", "len", "type"}
+
+
+def _params_of(target: ast.AST) -> list[str]:
+    if isinstance(target, ast.Lambda):
+        args = target.args
+    elif isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        args = target.args
+    else:
+        return []
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+class _TaintedUse(ast.NodeVisitor):
+    """Finds Load uses of tainted names in an expression, skipping the
+    static-predicate forms documented in the module docstring."""
+
+    def __init__(self, tainted: set[str]):
+        self.tainted = tainted
+        self.hits: list[tuple[int, str]] = []
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # `x is None` / `x is not None`: identity against None is a Python
+        # structure test, never a tracer bool
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and (
+            any(isinstance(c, ast.Constant) and c.value is None
+                for c in [node.left, *node.comparators])
+        ):
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = dotted_name(node.func)
+        if chain in _STATIC_CALLS:
+            return  # len()/isinstance()/... of a tracer is a static value
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in _STATIC_ATTRS:
+            return  # x.shape / x.ndim / ... are static metadata
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load) and node.id in self.tainted:
+            self.hits.append((node.lineno, node.id))
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _bind_targets(target: ast.AST, out: set[str]) -> None:
+    if isinstance(target, ast.Name):
+        out.add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            _bind_targets(elt, out)
+    elif isinstance(target, ast.Starred):
+        _bind_targets(target.value, out)
+
+
+class _TracedBodyScan:
+    """Taint-propagating, SOURCE-ORDER walk of one traced function body
+    (taint must flow forward: ``y = x + 1`` taints ``y`` only for the
+    statements after it)."""
+
+    def __init__(self, fn_name: str):
+        self.fn_name = fn_name
+        self.hits: list[tuple[int, str]] = []
+
+    def _check(self, test: ast.AST, tainted: set[str], kind: str) -> None:
+        v = _TaintedUse(tainted)
+        v.visit(test)
+        for line, name in v.hits:
+            self.hits.append((
+                line,
+                f"Python {kind} on {name!r}, which derives from a traced "
+                f"argument of {self.fn_name!r}"))
+
+    def _expr(self, expr: ast.AST, tainted: set[str]) -> None:
+        """Conditional expressions can hide anywhere in an expression."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.IfExp):
+                self._check(node.test, tainted, "conditional expression")
+
+    def scan(self, body: list[ast.stmt], tainted: set[str]) -> None:
+        tainted = set(tainted)
+        for stmt in body:
+            if isinstance(stmt, ast.If):
+                self._check(stmt.test, tainted, "`if` branch")
+                self.scan(stmt.body, tainted)
+                self.scan(stmt.orelse, tainted)
+            elif isinstance(stmt, ast.While):
+                self._check(stmt.test, tainted, "`while` loop")
+                self.scan(stmt.body, tainted)
+                self.scan(stmt.orelse, tainted)
+            elif isinstance(stmt, ast.Assert):
+                self._check(stmt.test, tainted, "`assert`")
+            elif isinstance(stmt, ast.Assign):
+                self._expr(stmt.value, tainted)
+                if _names_in(stmt.value) & tainted:
+                    for t in stmt.targets:
+                        _bind_targets(t, tainted)
+            elif isinstance(stmt, ast.AugAssign):
+                self._expr(stmt.value, tainted)
+                if isinstance(stmt.target, ast.Name) and (
+                        _names_in(stmt.value) & tainted
+                        or stmt.target.id in tainted):
+                    tainted.add(stmt.target.id)
+            elif isinstance(stmt, ast.For):
+                self._expr(stmt.iter, tainted)
+                if _names_in(stmt.iter) & tainted:
+                    _bind_targets(stmt.target, tainted)
+                self.scan(stmt.body, tainted)
+                self.scan(stmt.orelse, tainted)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._expr(item.context_expr, tainted)
+                self.scan(stmt.body, tainted)
+            elif isinstance(stmt, ast.Try):
+                self.scan(stmt.body, tainted)
+                for h in stmt.handlers:
+                    self.scan(h.body, tainted)
+                self.scan(stmt.orelse, tainted)
+                self.scan(stmt.finalbody, tainted)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def traces with the outer function; its params
+                # are tracers too (the vmap/scan body idiom)
+                self.scan(stmt.body, tainted | set(_params_of(stmt)))
+            elif isinstance(stmt, (ast.Return, ast.Expr)) and stmt.value is not None:
+                self._expr(stmt.value, tainted)
+
+
+class TracerBranchRule(Rule):
+    id = "GL006"
+    title = "Python-side conditional on a traced value inside jit/scan"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        module_defs = _local_defs(mod.tree.body)
+        seen: set[tuple[str, int, str]] = set()
+
+        def resolve(candidate: ast.AST, scopes: list[dict]) -> Optional[ast.AST]:
+            if isinstance(candidate, ast.Lambda):
+                return candidate
+            if isinstance(candidate, ast.Name):
+                for defs in reversed(scopes):
+                    if candidate.id in defs:
+                        return defs[candidate.id]
+            return None
+
+        def scan_target(target: ast.AST, entry: str, entry_line: int,
+                        fn_name: str) -> None:
+            tainted = set(_params_of(target))
+            scanner = _TracedBodyScan(fn_name)
+            if isinstance(target, ast.Lambda):
+                scanner._expr(target.body, tainted)
+            else:
+                scanner.scan(target.body, tainted)
+            for line, what in scanner.hits:
+                key = (fn_name, line, what)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    self.id, mod.relpath, line,
+                    f"{what} — traced by {entry} at line {entry_line}; a "
+                    "tracer has no Python truth value (or silently bakes one "
+                    "branch in at trace time) — use jax.lax.cond/select or "
+                    "jnp.where",
+                    symbol=f"{fn_name}:L{line}"))
+
+        def shallow_walk(stmt: ast.stmt):
+            stack: list[ast.AST] = [stmt]
+            while stack:
+                node = stack.pop()
+                yield node
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        continue
+                    stack.append(child)
+
+        def walk_scope(body: list[ast.stmt], scopes: list[dict]) -> None:
+            defs = _local_defs(body)
+            scopes = scopes + [defs]
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in stmt.decorator_list:
+                        chain = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+                        inner = ""
+                        if isinstance(dec, ast.Call) and chain.endswith("partial") and dec.args:
+                            inner = dotted_name(dec.args[0])
+                        if _is_jit_entry(chain) or _is_jit_entry(inner):
+                            scan_target(stmt, chain or inner, stmt.lineno, stmt.name)
+                for node in shallow_walk(stmt):
+                    if isinstance(node, ast.Call) and _is_jit_entry(dotted_name(node.func)):
+                        if not node.args:
+                            continue
+                        target = resolve(node.args[0], scopes)
+                        if target is None:
+                            continue
+                        fn_name = (node.args[0].id if isinstance(node.args[0], ast.Name)
+                                   else "<lambda>")
+                        scan_target(target, dotted_name(node.func), node.lineno, fn_name)
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    walk_scope(stmt.body, scopes)
+
+        walk_scope(mod.tree.body, [module_defs])
+        return findings

@@ -91,6 +91,26 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "Conditional-shift synthetic partitioner: label cluster count."),
     FlagSpec("condshift_scale", "float", 0.9,
              "Conditional-shift synthetic partitioner: shift strength."),
+    # -- population-scale simulation (fedml_tpu/population/) -----------------
+    FlagSpec("population_store", "str", None,
+             "Root directory of the sharded client-state store; set -> the "
+             "MeshSimulator streams per-round cohorts from disk shards "
+             "instead of holding the full client stack in memory (unset = "
+             "the in-memory path, bit-identical to before the flag existed)."),
+    FlagSpec("population_size", "int", None,
+             "Simulated population client count; derived: dataset.n_clients. "
+             "Ids beyond the base dataset replicate its client shards "
+             "cyclically."),
+    FlagSpec("population_shard_size", "int", 4096,
+             "Clients per store shard (one npz file of contiguous ids)."),
+    FlagSpec("population_max_resident_shards", "int", 8,
+             "Bounded LRU of in-memory shards — the knob that caps host RSS."),
+    FlagSpec("population_shards_per_cohort", "int", None,
+             "Shards the hierarchical sampler prefers per cohort; derived: "
+             "ceil(2 * cohort / shard_size)."),
+    FlagSpec("population_prefetch", "bool", True,
+             "Double-buffered cohort prefetch: gather round k+1's data on a "
+             "worker thread while round k computes."),
     # -- communication / transports ------------------------------------------
     FlagSpec("comm_compression", "str", None,
              "Upload codec for cross-silo model replies: qsgd8 | topk "

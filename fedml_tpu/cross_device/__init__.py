@@ -157,7 +157,15 @@ class ServerMNN(FedMLServerManager):
         never uploaded get a missed-selection strike — devices the sampler
         didn't pick are untouched), then schedule over live devices; probe
         every excluded device (even when all are excluded) so a recovered
-        device's reply rejoins it."""
+        device's reply rejoins it.
+
+        Behind ``extra.health_aware_selection`` the liveness-filtered pool is
+        further narrowed by the :class:`~fedml_tpu.obs.health.ClientHealthLedger`
+        scores the manager already maintains: degraded devices (slow EWMA
+        round trips, deadline breaches, send failures) are admitted only
+        when the healthy pool cannot fill the round — liveness says a phone
+        ANSWERS, health says it answers IN TIME.  Without the flag the
+        candidate set is reference-exact (liveness only)."""
         for cid in self.selected:
             if cid not in self._uploaded_this_round:
                 self.registry.note_missed_selection(cid)
@@ -165,7 +173,16 @@ class ServerMNN(FedMLServerManager):
         live = [c for c in self.client_ids if self.registry.is_live(c)]
         excluded = [c for c in self.client_ids if c not in live]
         self._probe_async(excluded)
-        return live or self.client_ids
+        pool = live or self.client_ids
+        if self.health_aware and len(pool) > self.per_round:
+            healthy, degraded = self.health.partition(pool)
+            if len(healthy) >= self.per_round:
+                pool = healthy
+            else:
+                # fill the round from the least-degraded devices
+                # (partition() returns degraded best-score-first)
+                pool = healthy + degraded[: self.per_round - len(healthy)]
+        return pool
 
     def _broadcast_model(self, msg_type: int) -> None:
         self._write_model_artifact()

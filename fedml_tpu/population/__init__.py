@@ -1,0 +1,83 @@
+"""Population-scale client subsystem (million-client cross-device simulation).
+
+Three parts, composed by the ``MeshSimulator`` behind the registered
+``extra.population_store`` flag (and usable standalone — the async/FedBuff
+server item on the ROADMAP streams from the same store):
+
+- :mod:`.store` — sharded on-disk client data + mutable per-client state
+  with a bounded resident LRU (host RSS scales with the COHORT, not the
+  population);
+- :mod:`.sampler` — deterministic two-level (shard, then within-shard)
+  cohort sampling honoring DeviceRegistry liveness and, behind
+  ``extra.health_aware_selection``, ClientHealthLedger scores;
+- :mod:`.cohorts` — the double-buffered prefetch pipeline that gathers
+  cohort k+1 while cohort k runs through the vmapped round step.
+
+``build_population_components`` is the config-driven assembly used by the
+simulator: the (small) base dataset's stacked client rows seed a
+``population_size``-client store via cyclic replication, so a 64-client
+synthetic recipe can stand in for a 1M-id population without materializing
+a million distinct shards up front.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.flags import cfg_extra
+from .cohorts import CohortPipeline
+from .sampler import HierarchicalCohortSampler
+from .store import CohortBatch, ShardedClientStore, StoreSpec, cyclic_builder
+
+__all__ = [
+    "CohortBatch", "CohortPipeline", "HierarchicalCohortSampler",
+    "ShardedClientStore", "StoreSpec", "cyclic_builder",
+    "build_population_components",
+]
+
+
+def build_population_components(
+    cfg, root: str, base_x, base_y, base_counts, capacity: int,
+    state_template=None, registry=None, health=None,
+):
+    """(store, sampler, pipeline) for a config + base client stack.
+
+    ``base_*`` are the REAL clients' padded rows from ``stack_clients``
+    (no mesh pad rows); population ids beyond the base replicate them
+    cyclically.  ``registry``/``health`` flow into the sampler's masks —
+    the simulator passes None (no live fleet), fleet-facing callers pass
+    their DeviceRegistry / ClientHealthLedger.
+    """
+    n_base = int(base_x.shape[0])
+    n_pop = int(cfg_extra(cfg, "population_size", n_base) or n_base)
+    if n_pop < n_base:
+        raise ValueError(
+            f"population_size ({n_pop}) smaller than the base dataset's "
+            f"client count ({n_base}) — shrink the dataset instead")
+    shard_size = int(cfg_extra(cfg, "population_shard_size"))
+    spec = StoreSpec(
+        n_clients=n_pop,
+        capacity=int(capacity),
+        x_shape=tuple(base_x.shape[2:]),
+        x_dtype=str(base_x.dtype),
+        y_shape=tuple(base_y.shape[2:]),
+        y_dtype=str(base_y.dtype),
+        shard_size=shard_size,
+    )
+    store = ShardedClientStore(
+        root, spec,
+        builder=cyclic_builder(base_x, base_y, base_counts),
+        state_template=state_template,
+        max_resident=int(cfg_extra(cfg, "population_max_resident_shards")),
+    )
+    m = min(int(cfg.client_num_per_round), n_pop)
+    spc = cfg_extra(cfg, "population_shards_per_cohort")
+    sampler = HierarchicalCohortSampler(
+        n_pop, m, shard_size, seed=int(cfg.random_seed),
+        shards_per_cohort=int(spc) if spc else None,
+        registry=registry, health=health,
+        health_aware=bool(cfg_extra(cfg, "health_aware_selection")),
+    )
+    pipeline = CohortPipeline(
+        store, sampler, prefetch=bool(cfg_extra(cfg, "population_prefetch")))
+    return store, sampler, pipeline

@@ -1,0 +1,118 @@
+"""Streamed cohort execution — double-buffered gather around the vmapped round.
+
+The population fit loop per round r:
+
+    ids      = sampler.sample(r)                      (host, deterministic)
+    batch    = store.gather_cohort(ids)               (host, disk/LRU)
+    state    = store.gather_state(ids)                (host; mutable rows)
+    outputs  = jit(cohort_round)(global, state, batch) (device, vmapped)
+    store.scatter_state(ids, outputs.state)           (host)
+
+The data gather is the host-side cost that would otherwise serialize with
+device compute, so a ONE-DEEP prefetch pipeline overlaps it: while round r
+runs on device, a worker thread gathers round r+1's cohort DATA.  Only the
+immutable data rows are prefetched — per-client STATE is gathered on the
+critical path, after round r's scatter, so a client sampled in consecutive
+cohorts always trains from its freshest state (prefetching state would race
+the scatter and silently fork a client's optimizer history).
+
+``fedml_pop_prefetch_overlap_fraction`` records, per round, how much of the
+gather wall time was hidden behind compute (1 = fully hidden, 0 = the round
+blocked for the entire gather — e.g. round 0, which has nothing to overlap
+with).  Gather/scatter timings land in the store's histograms; everything is
+scrapable from the global registry next to the simulator's round timings.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from ..obs import registry as obsreg
+from .sampler import HierarchicalCohortSampler
+from .store import ShardedClientStore
+
+__all__ = ["CohortPipeline"]
+
+PREFETCH_OVERLAP = obsreg.REGISTRY.gauge(
+    "fedml_pop_prefetch_overlap_fraction",
+    "Fraction of the last cohort gather hidden behind device compute "
+    "(1 = fully prefetched, 0 = the round blocked for the whole gather).",
+)
+COHORT_ROUNDS = obsreg.REGISTRY.counter(
+    "fedml_pop_cohort_rounds_total",
+    "Rounds executed through the population cohort pipeline.",
+)
+
+
+class CohortPipeline:
+    """Owns the sampler+store pair and the one-deep data prefetch."""
+
+    def __init__(self, store: ShardedClientStore,
+                 sampler: HierarchicalCohortSampler, prefetch: bool = True):
+        self.store = store
+        self.sampler = sampler
+        self.prefetch = bool(prefetch)
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="fedml-pop-prefetch"
+        ) if self.prefetch else None
+        self._pending: dict[int, Future] = {}
+        self._overlap_sum = 0.0
+        self._overlap_n = 0
+
+    # -- gather side ----------------------------------------------------------
+    def _gather_job(self, round_idx: int):
+        t0 = time.perf_counter()
+        ids = self.sampler.sample(round_idx)
+        batch = self.store.gather_cohort(ids)
+        return ids, batch, time.perf_counter() - t0
+
+    def prefetch_round(self, round_idx: int) -> None:
+        """Queue the data gather for ``round_idx`` on the worker thread
+        (no-op when already pending or prefetch is disabled)."""
+        if self._pool is not None and round_idx not in self._pending:
+            self._pending[round_idx] = self._pool.submit(self._gather_job, round_idx)
+
+    def obtain(self, round_idx: int):
+        """The round's (ids, CohortBatch); blocks only for whatever part of
+        the gather the prefetch did not hide, and records that fraction."""
+        fut = self._pending.pop(round_idx, None)
+        t0 = time.perf_counter()
+        if fut is None:
+            ids, batch, gather_s = self._gather_job(round_idx)
+        else:
+            ids, batch, gather_s = fut.result()
+        waited = time.perf_counter() - t0
+        overlap = 1.0 - min(1.0, waited / gather_s) if gather_s > 0 else 1.0
+        PREFETCH_OVERLAP.set(overlap)
+        self._overlap_sum += overlap
+        self._overlap_n += 1
+        COHORT_ROUNDS.inc()
+        return ids, batch
+
+    # -- bookkeeping ----------------------------------------------------------
+    def overlap_mean(self) -> Optional[float]:
+        return self._overlap_sum / self._overlap_n if self._overlap_n else None
+
+    def close(self) -> None:
+        self.store.flush()
+        if self._pool is not None:
+            # drop gathers that will never be consumed, then join the worker
+            for fut in self._pending.values():
+                fut.cancel()
+            self._pending.clear()
+            self._pool.shutdown(wait=True)
+
+    @staticmethod
+    def pad_ids(ids: np.ndarray, m_pad: int) -> np.ndarray:
+        """Extend the cohort id vector to the mesh lane multiple by repeating
+        the first id — pad lanes are sliced away before aggregation and
+        never scattered, so their values are irrelevant; repeating an id the
+        cohort already holds avoids touching an extra shard."""
+        m = len(ids)
+        if m_pad == m:
+            return ids
+        return np.concatenate([ids, np.full(m_pad - m, ids[0], np.int32)])

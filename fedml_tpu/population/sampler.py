@@ -1,0 +1,141 @@
+"""Hierarchical cohort sampling over a sharded population.
+
+Flat sampling over 1M ids would touch O(population) shards per cohort and
+defeat the store's bounded residency.  The sampler is therefore TWO-LEVEL,
+mirroring how production cross-device systems pick check-in cohorts:
+
+1. **shard level** — a deterministic per-round permutation orders the
+   shards; the cohort is drawn from the first ``shards_per_cohort`` of them
+   (falling through to later shards only when the preferred ones cannot
+   fill their quota), so a cohort touches a BOUNDED number of contiguous-id
+   shards and the store's LRU stays small;
+2. **client level** — within each visited shard, ids are drawn uniformly
+   without replacement from the shard's eligible candidates.
+
+Eligibility composes the same signals the live cross-device server uses:
+
+- the :class:`~fedml_tpu.cross_device.DeviceRegistry` liveness mask — ids
+  the registry has STRUCK OUT (missed too many consecutive selections) are
+  excluded; ids the registry has never seen are assumed live, because a
+  1M-simulated population never fully registers;
+- behind ``extra.health_aware_selection``, the
+  :class:`~fedml_tpu.obs.health.ClientHealthLedger` — degraded ids are
+  deprioritized (sampled only when a shard's healthy pool cannot fill its
+  quota), never permanently evicted — the same semantics as the cross-silo
+  ``client_selection``.
+
+Everything is driven by ``np.random.default_rng([seed, round_idx])``, so a
+round's cohort is a pure function of (seed, round, masks): reproducible
+across processes and immune to sampling-order drift.  When the cohort
+covers the whole eligible population the sampler degenerates to "everyone,
+in id order" — exactly the in-memory engine's behavior, which is what the
+population-vs-in-memory parity test pins.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["HierarchicalCohortSampler"]
+
+
+class HierarchicalCohortSampler:
+    def __init__(self, n_clients: int, cohort_size: int, shard_size: int,
+                 seed: int = 0, shards_per_cohort: Optional[int] = None,
+                 registry=None, health=None, health_aware: bool = False):
+        self.n_clients = int(n_clients)
+        self.cohort_size = min(int(cohort_size), self.n_clients)
+        self.shard_size = int(shard_size)
+        self.seed = int(seed)
+        self.n_shards = -(-self.n_clients // self.shard_size)
+        if shards_per_cohort is None:
+            # enough preferred shards that per-shard draws stay under half a
+            # shard — keeps within-shard sampling meaningfully random while
+            # bounding the store's working set
+            shards_per_cohort = max(1, -(-2 * self.cohort_size // self.shard_size))
+        self.shards_per_cohort = min(self.n_shards, max(1, int(shards_per_cohort)))
+        self.registry = registry
+        self.health = health
+        self.health_aware = bool(health_aware)
+
+    # -- masks ---------------------------------------------------------------
+    def _live_mask(self, ids: np.ndarray) -> np.ndarray:
+        """Registry liveness over a shard's id range; unknown ids are live
+        (a simulated population never fully registers — only ids the
+        registry explicitly struck out are excluded)."""
+        if self.registry is None:
+            return np.ones(len(ids), bool)
+        devices = self.registry.devices
+        mask = np.ones(len(ids), bool)
+        for i, cid in enumerate(ids):
+            if int(cid) in devices and not self.registry.is_live(int(cid)):
+                mask[i] = False
+        return mask
+
+    def _split_by_health(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(healthy, degraded-best-first) — ledger semantics, id-stable."""
+        if not (self.health_aware and self.health is not None):
+            return ids, np.empty(0, ids.dtype)
+        healthy, degraded = self.health.partition(int(i) for i in ids)
+        return (np.asarray(healthy, ids.dtype),
+                np.asarray(degraded, ids.dtype) if degraded else np.empty(0, ids.dtype))
+
+    # -- sampling ------------------------------------------------------------
+    def sample(self, round_idx: int) -> np.ndarray:
+        """The round's cohort: ``(cohort_size,)`` int32 ids, ascending.
+
+        Deterministic in (seed, round_idx) and the current liveness/health
+        masks.  If the eligible population cannot fill the cohort, excluded
+        ids backfill (same "live or everyone" fallback as the cross-device
+        candidate pass) so the jitted round always sees a full static lane
+        count.
+        """
+        rng = np.random.default_rng([self.seed, int(round_idx)])
+        shard_order = rng.permutation(self.n_shards)
+        need = self.cohort_size
+        quota = -(-self.cohort_size // self.shards_per_cohort)
+        chosen: list[np.ndarray] = []
+        leftover: list[np.ndarray] = []  # eligible but over-quota this pass
+        deferred: list[np.ndarray] = []  # degraded/dead, kept as backfill
+        for sidx in shard_order:
+            if need <= 0:
+                break
+            lo = int(sidx) * self.shard_size
+            hi = min(lo + self.shard_size, self.n_clients)
+            ids = np.arange(lo, hi, dtype=np.int32)
+            live = self._live_mask(ids)
+            deferred.append(ids[~live])
+            healthy, degraded = self._split_by_health(ids[live])
+            deferred.append(degraded)
+            take = min(quota, need, len(healthy))
+            if take > 0:
+                picked = rng.choice(healthy, size=take, replace=False)
+                chosen.append(picked)
+                need -= take
+                leftover.append(np.setdiff1d(healthy, picked))
+            else:
+                leftover.append(healthy)
+        if need > 0 and leftover:
+            # every visited shard hit its quota and the cohort is still
+            # short (uneven shard sizes): draw the remainder uniformly from
+            # the eligible ids the quota pass left behind
+            pool = np.concatenate(leftover)
+            take = min(need, len(pool))
+            if take > 0:
+                chosen.append(rng.choice(pool, size=take, replace=False))
+                need -= take
+        if need > 0:
+            # eligible pool exhausted at quota — backfill from the deferred
+            # ids in deferral order (degraded best-first per shard, then
+            # struck-out ids), deduped against the chosen set
+            pool = np.concatenate(deferred) if deferred else np.empty(0, np.int32)
+            taken = set(np.concatenate(chosen).tolist()) if chosen else set()
+            fill = [i for i in pool.tolist() if i not in taken][:need]
+            if fill:
+                chosen.append(np.asarray(fill, np.int32))
+                need -= len(fill)
+        cohort = np.concatenate(chosen) if chosen else np.empty(0, np.int32)
+        cohort.sort()
+        return cohort.astype(np.int32)

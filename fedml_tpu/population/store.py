@@ -1,0 +1,314 @@
+"""Sharded client-state store — per-client data/state on disk, cohorts in RAM.
+
+The in-memory simulator stacks every client's padded shard into one
+``(n_clients, capacity, ...)`` device array, so host (and HBM) footprint
+scales with the POPULATION.  A million-client cross-device population does
+not fit that way and never needs to: per round only the active cohort's rows
+are touched.  FedJAX (PAPERS.md, 2108.02117) streams client data from host
+storage for exactly this reason; this module is that layer for fedml_tpu.
+
+Layout: the population of ``n_clients`` ids is cut into shards of
+``shard_size`` CONTIGUOUS ids (shard ``s`` holds ``[s*shard_size,
+min((s+1)*shard_size, n))``).  Each shard is one ``.npz`` file holding the
+stacked padded data rows (``x``, ``y``), true sample counts, and — when the
+algorithm carries per-client state (SCAFFOLD controls, personalization
+vectors) — one stacked array per state leaf.  A bounded LRU keeps at most
+``max_resident`` shards in host memory, so RSS scales with the number of
+shards a cohort touches (the hierarchical sampler bounds that), never with
+the population.
+
+Shards materialize LAZILY: a shard file is written the first time the shard
+is touched, from the ``builder`` callback (deterministic in the id range).
+A 1M-client population therefore costs disk/CPU proportional to the ids
+actually sampled — the property the bench's RSS floor asserts.
+
+Client state is mutable: ``gather_state`` pulls cohort rows, the executor
+runs the vmapped round, ``scatter_state`` writes the refreshed rows back
+into the resident shard (dirty shards are rewritten on eviction and
+``flush``).  Data rows are immutable, which is what lets the prefetch
+thread gather cohort k+1's DATA while round k is still mutating state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..obs import registry as obsreg
+
+__all__ = ["StoreSpec", "CohortBatch", "ShardedClientStore", "cyclic_builder"]
+
+SHARD_LOADS = obsreg.REGISTRY.counter(
+    "fedml_pop_shard_loads_total",
+    "Shard lookups by the population store; result=hit served from the "
+    "resident LRU, miss loaded from disk (or materialized by the builder).",
+    labels=("result",),
+)
+RESIDENT_SHARDS = obsreg.REGISTRY.gauge(
+    "fedml_pop_resident_shards",
+    "Shards currently resident in the population store's LRU.",
+)
+GATHER_TIME = obsreg.REGISTRY.histogram(
+    "fedml_pop_gather_seconds",
+    "Wall time of one cohort gather (data or state) from the sharded store.",
+)
+SCATTER_TIME = obsreg.REGISTRY.histogram(
+    "fedml_pop_scatter_seconds",
+    "Wall time of one cohort state scatter back into the sharded store.",
+)
+
+
+@dataclass(frozen=True)
+class StoreSpec:
+    """Static shape of the population: how many clients, how their padded
+    data rows look, and how the id space is cut into shards."""
+
+    n_clients: int
+    capacity: int           # padded samples per client (stack_clients semantics)
+    x_shape: tuple          # per-SAMPLE feature shape
+    x_dtype: str
+    y_shape: tuple          # per-sample label shape (() for class ids)
+    y_dtype: str
+    shard_size: int
+
+    @property
+    def n_shards(self) -> int:
+        return -(-self.n_clients // self.shard_size)
+
+    def shard_range(self, sidx: int) -> tuple[int, int]:
+        lo = sidx * self.shard_size
+        return lo, min(lo + self.shard_size, self.n_clients)
+
+
+@dataclass
+class CohortBatch:
+    """Stacked, vmap-ready cohort arrays in sampled-id order."""
+
+    ids: np.ndarray      # (m,) int32
+    x: np.ndarray        # (m, capacity, *x_shape)
+    y: np.ndarray        # (m, capacity, *y_shape)
+    counts: np.ndarray   # (m,) int32 true sample counts
+
+
+def cyclic_builder(base_x: np.ndarray, base_y: np.ndarray, base_counts: np.ndarray
+                   ) -> Callable[[int, int], tuple]:
+    """Population builder that replicates a small base client stack
+    cyclically: population client ``i`` carries base client ``i % n_base``'s
+    rows.  The standard way to scale a real (small) federated dataset to a
+    simulated 1M-id population without materializing 1M distinct shards of
+    data up front."""
+    n_base = base_x.shape[0]
+
+    def build(lo: int, hi: int):
+        rows = np.arange(lo, hi) % n_base
+        return base_x[rows], base_y[rows], base_counts[rows]
+
+    return build
+
+
+class _Shard:
+    """One resident shard: stacked arrays + a dirty bit for state writes."""
+
+    __slots__ = ("arrays", "dirty")
+
+    def __init__(self, arrays: dict):
+        self.arrays = arrays
+        self.dirty = False
+
+
+class ShardedClientStore:
+    """Disk-backed, LRU-cached per-client data + state.
+
+    ``builder(lo, hi) -> (x, y, counts)`` materializes the data rows of a
+    shard the first time it is touched; ``state_template`` (a per-client
+    pytree of numpy arrays, or None) seeds every client's mutable state.
+    All shard-map mutation happens under one lock — the prefetch thread
+    gathers while the executor scatters.
+    """
+
+    _STATE_PREFIX = "state_"
+
+    def __init__(self, root: str | Path, spec: StoreSpec,
+                 builder: Optional[Callable[[int, int], tuple]] = None,
+                 state_template=None, max_resident: int = 8):
+        import jax
+
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.spec = spec
+        self.builder = builder
+        self.max_resident = max(1, int(max_resident))
+        self._lock = threading.Lock()
+        self._resident: OrderedDict[int, _Shard] = OrderedDict()
+        # state skeleton: leaf list + treedef from the template, so shard
+        # files only need positionally-keyed stacked leaf arrays
+        if state_template is not None:
+            leaves, treedef = jax.tree_util.tree_flatten(
+                jax.tree_util.tree_map(np.asarray, state_template))
+            self._state_leaves = [np.asarray(l) for l in leaves]
+            self._state_treedef = treedef
+        else:
+            self._state_leaves = None
+            self._state_treedef = None
+
+    # -- shard residency ------------------------------------------------------
+    def _shard_path(self, sidx: int) -> Path:
+        return self.root / f"shard_{sidx:06d}.npz"
+
+    def _materialize(self, sidx: int) -> dict:
+        lo, hi = self.spec.shard_range(sidx)
+        if self.builder is None:
+            raise FileNotFoundError(
+                f"shard {sidx} ({self._shard_path(sidx)}) missing and the "
+                "store has no builder to materialize it")
+        x, y, counts = self.builder(lo, hi)
+        arrays = {
+            "x": np.ascontiguousarray(x),
+            "y": np.ascontiguousarray(y),
+            "counts": np.asarray(counts, np.int32),
+        }
+        if self._state_leaves is not None:
+            n = hi - lo
+            for i, leaf in enumerate(self._state_leaves):
+                arrays[f"{self._STATE_PREFIX}{i}"] = np.broadcast_to(
+                    leaf[None], (n,) + leaf.shape).copy()
+        return arrays
+
+    def _get_shard_locked(self, sidx: int) -> _Shard:  # graftlint: disable=GL004(caller holds _lock)
+        shard = self._resident.get(sidx)
+        if shard is not None:
+            self._resident.move_to_end(sidx)
+            SHARD_LOADS.inc(result="hit")
+            return shard
+        SHARD_LOADS.inc(result="miss")
+        path = self._shard_path(sidx)
+        if path.exists():
+            with np.load(path) as z:
+                arrays = {k: z[k] for k in z.files}
+        else:
+            arrays = self._materialize(sidx)
+            self._write_shard(sidx, arrays)
+        shard = _Shard(arrays)
+        self._resident[sidx] = shard
+        while len(self._resident) > self.max_resident:
+            old_idx, old = self._resident.popitem(last=False)
+            if old.dirty:
+                self._write_shard(old_idx, old.arrays)
+        RESIDENT_SHARDS.set(float(len(self._resident)))
+        return shard
+
+    def _write_shard(self, sidx: int, arrays: dict) -> None:
+        # atomic replace: a crash mid-save must not leave a truncated npz
+        # behind that poisons every later run
+        path = self._shard_path(sidx)
+        tmp = path.with_suffix(".npz.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        tmp.replace(path)
+
+    @staticmethod
+    def _group_by_shard(ids: np.ndarray, shard_size: int):
+        """[(shard_idx, positions-into-ids, rows-within-shard)] — one disk /
+        LRU touch per distinct shard, whatever the cohort order."""
+        ids = np.asarray(ids, np.int64)
+        if ids.size == 0:
+            return []
+        sidx = ids // shard_size
+        order = np.argsort(sidx, kind="stable")
+        cuts = np.flatnonzero(np.diff(sidx[order])) + 1
+        out = []
+        for pos in np.split(order, cuts):
+            s = int(sidx[pos[0]])
+            out.append((s, pos, ids[pos] - s * shard_size))
+        return out
+
+    # -- cohort API -----------------------------------------------------------
+    @property
+    def has_state(self) -> bool:
+        return self._state_leaves is not None
+
+    def gather_cohort(self, ids) -> CohortBatch:
+        """Stacked (m, capacity, ...) data arrays for ``ids``, in id order."""
+        t0 = time.perf_counter()
+        ids = np.asarray(ids, np.int32)
+        m = len(ids)
+        spec = self.spec
+        x = np.empty((m, spec.capacity) + tuple(spec.x_shape), spec.x_dtype)
+        y = np.empty((m, spec.capacity) + tuple(spec.y_shape), spec.y_dtype)
+        counts = np.empty((m,), np.int32)
+        with self._lock:
+            for sidx, pos, rows in self._group_by_shard(ids, spec.shard_size):
+                arrays = self._get_shard_locked(sidx).arrays
+                x[pos] = arrays["x"][rows]
+                y[pos] = arrays["y"][rows]
+                counts[pos] = arrays["counts"][rows]
+        GATHER_TIME.observe(time.perf_counter() - t0)
+        return CohortBatch(ids=ids, x=x, y=y, counts=counts)
+
+    def gather_state(self, ids):
+        """Stacked per-client state pytree for ``ids`` (None when the
+        algorithm is stateless).  Kept separate from :meth:`gather_cohort` so
+        the prefetch thread can overlap the IMMUTABLE data gather while the
+        current round is still scattering state."""
+        if self._state_leaves is None:
+            return None
+        import jax
+
+        t0 = time.perf_counter()
+        ids = np.asarray(ids, np.int32)
+        m = len(ids)
+        stacked = [np.empty((m,) + leaf.shape, leaf.dtype)
+                   for leaf in self._state_leaves]
+        with self._lock:
+            for sidx, pos, rows in self._group_by_shard(ids, self.spec.shard_size):
+                arrays = self._get_shard_locked(sidx).arrays
+                for i in range(len(stacked)):
+                    stacked[i][pos] = arrays[f"{self._STATE_PREFIX}{i}"][rows]
+        GATHER_TIME.observe(time.perf_counter() - t0)
+        return jax.tree_util.tree_unflatten(self._state_treedef, stacked)
+
+    def scatter_state(self, ids, state) -> None:
+        """Write refreshed per-client state rows back into their shards
+        (resident arrays are updated in place; shards are marked dirty and
+        rewritten on eviction / flush)."""
+        if self._state_leaves is None:
+            return
+        import jax
+
+        t0 = time.perf_counter()
+        ids = np.asarray(ids, np.int32)
+        leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+            jax.device_get(state))]
+        with self._lock:
+            for sidx, pos, rows in self._group_by_shard(ids, self.spec.shard_size):
+                shard = self._get_shard_locked(sidx)
+                for i, leaf in enumerate(leaves):
+                    arr = shard.arrays[f"{self._STATE_PREFIX}{i}"]
+                    if not arr.flags.writeable:  # fresh np.load gives RO views
+                        arr = arr.copy()
+                        shard.arrays[f"{self._STATE_PREFIX}{i}"] = arr
+                    arr[rows] = leaf[pos]
+                shard.dirty = True
+        SCATTER_TIME.observe(time.perf_counter() - t0)
+
+    def flush(self) -> None:
+        """Persist every dirty resident shard (checkpoint boundary / close)."""
+        with self._lock:
+            for sidx, shard in self._resident.items():
+                if shard.dirty:
+                    self._write_shard(sidx, shard.arrays)
+                    shard.dirty = False
+
+    def drop_resident(self) -> None:
+        """Flush then empty the LRU — used by tests to prove the on-disk
+        shards are the source of truth."""
+        self.flush()
+        with self._lock:
+            self._resident.clear()
+        RESIDENT_SHARDS.set(0.0)

@@ -41,6 +41,7 @@ from .. import constants as C
 from ..algorithms import create as create_algorithm, hparams_from_config
 from ..arguments import Config
 from ..core import pytree as pt, rng
+from ..core.flags import cfg_extra
 from ..data.dataset import FederatedDataset, StackedClientData, pad_eval_set, stack_clients
 from ..fl.local_sgd import make_eval_fn
 from ..parallel import mesh as meshlib
@@ -187,6 +188,20 @@ class MeshSimulator(RoundCheckpointMixin):
         # distinct length); see run_rounds
         self._multi_round_fns: dict[int, Callable] = {}
 
+        # -- population mode (extra.population_store): stream per-round
+        # cohorts from the sharded on-disk store instead of sampling the
+        # device-resident stack.  Everything above stays as-is — the base
+        # dataset is small by construction (the store replicates it across
+        # the population) and the default path is untouched when unset.
+        self._population = None
+        pop_root = cfg_extra(cfg, "population_store")
+        if pop_root:
+            if self.backend == C.SIMULATION_BACKEND_SP:
+                raise ValueError(
+                    "population_store streams cohorts into the vmapped MESH "
+                    "round; it has no meaning on the SP host loop")
+            self._init_population(str(pop_root), stacked)
+
     # ------------------------------------------------------------------
     def _client_axis_info(self) -> tuple[str, int]:
         """(axis name, axis size) the stacked-client dim shards over; size 1
@@ -329,6 +344,141 @@ class MeshSimulator(RoundCheckpointMixin):
         out = self.algorithm.client_update(global_vars, cstate, server_state, x, y, cnt, key)
         return out.contribution, out.client_state, out.metrics
 
+    # -- population mode (extra.population_store) ----------------------------
+    def _init_population(self, root: str, stacked) -> None:
+        """Assemble the sharded store + hierarchical sampler + prefetch
+        pipeline (fedml_tpu/population/) and the jitted cohort round.  The
+        store — not a device stack — is the authority for per-client state
+        in this mode, so the device-stacked ``client_states`` is dropped."""
+        from types import SimpleNamespace
+
+        from ..population import build_population_components
+
+        cs_template = self.algorithm.init_client_state(self.global_vars)
+        state_template = (
+            jax.device_get(cs_template) if cs_template is not None else None
+        )
+        n_real = self._n_real
+        store, sampler, pipeline = build_population_components(
+            self.cfg, root,
+            stacked.x[:n_real], stacked.y[:n_real], stacked.counts[:n_real],
+            self.capacity, state_template=state_template,
+        )
+        m = sampler.cohort_size
+        m_pad = meshlib.round_up(m, self._lane_multiple)
+        self._population = SimpleNamespace(
+            store=store, sampler=sampler, pipeline=pipeline,
+            m=m, m_pad=m_pad,
+            round_fn=jax.jit(self._make_population_round_fn(m)),
+        )
+        self.client_states = None  # per-client state lives in the store
+
+    def _make_population_round_fn(self, m: int):
+        """The cohort round: same client math, trust hooks, and server path
+        as :meth:`_make_round_fn`, but the cohort's data/state arrive as
+        stacked arguments (host-gathered from the store) instead of being
+        jnp.take'd out of a device-resident population stack, and the
+        sampled ids ride in as ``lane_ids`` so per-client RNG keys fold the
+        same streams the in-memory path folds."""
+        algo = self.algorithm
+
+        def round_fn(global_vars, server_state, cs, cnts, xs, ys, lane_ids,
+                     round_idx, key, prev_delta):
+            xs = self._constrain_lanes(xs)
+            ys = self._constrain_lanes(ys)
+            cs = self._constrain_lanes(cs)
+            rkey = rng.round_key(key, round_idx)
+            keys = jax.vmap(lambda i: rng.client_key(rkey, i))(lane_ids)
+
+            def one_client(cstate, x, y, cnt, k):
+                out = algo.client_update(global_vars, cstate, server_state, x, y, cnt, k)
+                return out.contribution, out.client_state, out.metrics
+
+            if cs is not None:
+                contribs, new_cs, metrics = jax.vmap(one_client, in_axes=(0, 0, 0, 0, 0))(cs, xs, ys, cnts, keys)
+            else:
+                contribs, new_cs, metrics = jax.vmap(
+                    lambda x, y, cnt, k: one_client(None, x, y, cnt, k)
+                )(xs, ys, cnts, keys)
+            contribs = self._slice_lanes(contribs, m)
+            new_cs = self._slice_lanes(new_cs, m) if new_cs is not None else None
+            metrics = self._slice_lanes(metrics, m)
+            weights = cnts[:m].astype(jnp.float32)
+            new_global, new_server, new_delta = self._server_path(
+                contribs, weights, lane_ids[:m], global_vars, server_state,
+                rkey, round_idx, prev_delta,
+            )
+            round_metrics = {k: jnp.mean(v) for k, v in metrics.items()}
+            return new_global, new_server, new_cs, new_delta, round_metrics
+
+        return round_fn
+
+    @staticmethod
+    def _pad_cohort_rows(tree, m_pad: int):
+        """Row-repeat lane padding on host arrays: pad lanes replay row 0
+        (the same client the padded ID vector repeats); they are sliced away
+        on device before aggregation and never scattered back."""
+        def pad(a):
+            a = np.asarray(a)
+            if a.shape[0] >= m_pad:
+                return a
+            reps = np.concatenate([
+                np.arange(a.shape[0]), np.zeros(m_pad - a.shape[0], np.int64)])
+            return a[reps]
+
+        return jax.tree_util.tree_map(pad, tree)
+
+    def _run_population_rounds(self, n: int) -> list[dict]:
+        """Streamed cohort execution: gather cohort r+1's data on the
+        prefetch thread while cohort r runs through the vmapped round, then
+        scatter refreshed per-client state back to its shards.  State is
+        gathered on the critical path AFTER the previous round's scatter —
+        a client sampled in consecutive cohorts must see its fresh state."""
+        from ..population.cohorts import CohortPipeline
+
+        pop = self._population
+        out = []
+        for _ in range(n):
+            r = self.round_idx
+            t0 = time.perf_counter()
+            pop.pipeline.prefetch_round(r)
+            ids, batch = pop.pipeline.obtain(r)
+            if r + 1 < self.cfg.comm_round:
+                pop.pipeline.prefetch_round(r + 1)
+            lanes = CohortPipeline.pad_ids(ids, pop.m_pad)
+            xs = self._pad_cohort_rows(batch.x, pop.m_pad)
+            if self.hp.compute_dtype == "bfloat16" and np.issubdtype(xs.dtype, np.floating):
+                import ml_dtypes
+
+                xs = xs.astype(ml_dtypes.bfloat16)
+            ys = self._pad_cohort_rows(batch.y, pop.m_pad)
+            cs = pop.store.gather_state(ids)
+            if cs is not None:
+                cs = meshlib.shard_leading_axis(
+                    self._pad_cohort_rows(cs, pop.m_pad), self.mesh)
+            xs, ys = meshlib.shard_leading_axis((xs, ys), self.mesh)
+            cnts = jnp.asarray(self._pad_cohort_rows(batch.counts, pop.m_pad))
+            with traced("sim.population_round", round_idx=r, cohort=pop.m,
+                        sink=self._otlp_sink):
+                gv, ss, new_cs, nd, metrics = pop.round_fn(
+                    self.global_vars, self.server_state, cs, cnts, xs, ys,
+                    jnp.asarray(lanes, jnp.int32), jnp.int32(r), self.root_key,
+                    self.defense_history,
+                )
+                host = {k: float(v) for k, v in metrics.items()}  # syncs
+            if new_cs is not None:
+                pop.store.scatter_state(ids, new_cs)
+            self.global_vars, self.server_state = gv, ss
+            if nd is not None:
+                self.defense_history = nd
+            self.round_idx += 1
+            ROUND_TIME.observe(time.perf_counter() - t0)
+            out.append(host)
+        # host boundary: the on-disk shards are this mode's checkpointable
+        # client state — keep them consistent before eval/checkpoint runs
+        pop.store.flush()
+        return out
+
     # ------------------------------------------------------------------
     def _get_multi_round_fn(self, n: int, example_args: Optional[tuple] = None):
         """jit(scan(round)) over ``n`` rounds — ONE dispatch and ONE host
@@ -394,6 +544,8 @@ class MeshSimulator(RoundCheckpointMixin):
         checkpoint, not by retrying in-process."""
         if n <= 0:
             return []
+        if self._population is not None:
+            return self._run_population_rounds(n)
         if self.backend == C.SIMULATION_BACKEND_SP:
             out = []
             for _ in range(n):
@@ -431,6 +583,8 @@ class MeshSimulator(RoundCheckpointMixin):
 
     # ------------------------------------------------------------------
     def run_round(self) -> dict:
+        if self._population is not None:
+            return self._run_population_rounds(1)[0]
         r = self.round_idx
         if self.backend == C.SIMULATION_BACKEND_SP:
             metrics = self._run_round_sp(r)

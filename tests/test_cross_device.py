@@ -200,3 +200,60 @@ def test_cross_device_server_excludes_dead_and_probes_for_rejoin(eight_devices):
     server._candidate_ids()
     assert server.registry.devices[2]["missed"] == 1
     assert server.registry.devices[1]["missed"] == 0
+
+
+def test_cross_device_health_aware_candidate_narrowing(eight_devices):
+    """Behind extra.health_aware_selection the LIVE candidate pool is further
+    narrowed by health-ledger scores: degraded devices (deadline breaches)
+    are admitted only when the healthy pool cannot fill the round; without
+    the flag the candidate set is liveness-only (reference-exact)."""
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_aggregator
+    from fedml_tpu.cross_device import ServerMNN
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    from .conftest import tiny_config
+
+    def make_server(extra):
+        cfg = tiny_config(
+            training_type="cross_device", client_num_in_total=3,
+            client_num_per_round=2, comm_round=2, run_id="cd-health",
+            frequency_of_the_test=0, extra=extra,
+        )
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        model = model_hub.create(cfg, ds.class_num)
+        InProcRouter.reset("cd-health")
+        server = ServerMNN(cfg, build_aggregator(cfg, ds, model), backend="INPROC")
+        for d in (1, 2, 3):
+            server.registry.register(d, "android")
+        # device 2 repeatedly blows the straggler deadline; the others are
+        # proven healthy by completed round trips
+        for _ in range(6):
+            server.health.record_deadline_breach(2)
+        for d in (1, 3):
+            server.health.observe_rtt(d, 0.05)
+        return server
+
+    flagged = make_server({"health_aware_selection": True})
+    assert flagged.health_aware
+    assert flagged._candidate_ids() == [1, 3]  # healthy pool fills the round
+    # a recovered device re-enters: successful round trips decay the breaches
+    for _ in range(40):
+        flagged.health.observe_rtt(2, 0.05)
+    assert 2 in flagged._candidate_ids()
+
+    # degraded devices still fill the round when health narrowing would
+    # starve it (healthy pool smaller than per_round)
+    for _ in range(6):
+        flagged.health.record_deadline_breach(2)
+        flagged.health.record_deadline_breach(3)
+    cand = flagged._candidate_ids()
+    assert len(cand) == flagged.per_round and 1 in cand
+
+    # without the flag: liveness-only, all live devices stay candidates
+    plain = make_server({})
+    assert not plain.health_aware
+    assert plain._candidate_ids() == [1, 2, 3]

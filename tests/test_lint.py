@@ -240,6 +240,137 @@ def test_gl003_suppression(tmp_path):
     assert not r.findings and len(r.suppressed) == 1
 
 
+def test_gl003_donate_argnames_taints_keyword_and_positional(tmp_path):
+    """donate_argnames: a keyword arg matching a donated name is tainted, and
+    when the jitted callable is an inline lambda the names also map to
+    positions, so the positional call form is caught too."""
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+
+        def kw_form(state, x):
+            step = jax.jit(lambda state, v: state, donate_argnames=("state",))
+            out = step(state=state, v=x)
+            return state  # read after donation via argname
+
+        def pos_form(state, x):
+            step = jax.jit(lambda state, v: state, donate_argnames=("state",))
+            out = step(state, x)
+            return state  # same donation, positional call
+
+        def rebind_ok(state, x):
+            step = jax.jit(lambda state, v: state, donate_argnames=("state",))
+            state = step(state, x)
+            return state
+    """})
+    assert [f.rule for f in r.findings] == ["GL003", "GL003"]
+    assert all("state" in f.message for f in r.findings)
+
+
+def test_gl003_splat_covering_donated_position_taints_sequence(tmp_path):
+    """``step(x, *rest)`` with a donated position inside the splat taints
+    ``rest`` itself; a splat past every donated position stays clean."""
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+
+        def bad(rest, x):
+            step = jax.jit(lambda a, b, c: a, donate_argnums=(1, 2))
+            out = step(x, *rest)
+            return rest  # elements were donated through the splat
+
+        def ok(rest, x):
+            step = jax.jit(lambda a, b, c: a, donate_argnums=(0,))
+            out = step(x, *rest)
+            return rest  # donated position 0 was the explicit arg
+    """})
+    assert [f.rule for f in r.findings] == ["GL003"]
+    assert "rest" in r.findings[0].message
+
+
+# -- GL006: tracer branches ---------------------------------------------------
+
+def test_gl006_branch_on_param_and_derived_value_fires(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+
+        def step(x):
+            y = x + 1
+            if y > 0:
+                return x
+            while x > 2:
+                x = x - 1
+            return y
+
+        jitted = jax.jit(step)
+    """})
+    gl006 = [f for f in r.findings if f.rule == "GL006"]
+    assert len(gl006) == 2  # the if AND the while, both on traced values
+    assert {"`if` branch" in f.message or "`while` loop" in f.message
+            for f in gl006} == {True}
+
+
+def test_gl006_scan_body_and_decorator_forms(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+
+        @jax.jit
+        def decorated(x):
+            return x if x else -x
+
+        def run(xs):
+            def body(carry, x):
+                if carry:
+                    carry = carry + x
+                return carry, x
+            return jax.lax.scan(body, 0, xs)
+    """})
+    gl006 = [f for f in r.findings if f.rule == "GL006"]
+    assert len(gl006) == 2  # the decorated IfExp AND the scan body's if
+
+
+def test_gl006_static_predicates_stay_clean(tmp_path):
+    """Structure tests on tracers are trace-time-static by design: identity
+    vs None, isinstance, len(), and the static array attributes."""
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+
+        def step(x, cs):
+            if cs is not None:
+                x = x + 1
+            if isinstance(x, tuple):
+                return x[0]
+            if x.ndim == 2:
+                x = x.sum(-1)
+            if len(x) > 3:
+                x = x[:3]
+            if x.shape[0] % 2 == 0:
+                x = x * 2
+            return x
+
+        jitted = jax.jit(step)
+    """})
+    assert not [f for f in r.findings if f.rule == "GL006"], r.render()
+
+
+def test_gl006_untraced_function_and_suppression(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import jax
+
+        def host_helper(x):
+            if x:  # never traced: plain python is fine
+                return 1
+            return 0
+
+        def step(x):
+            if x:  # graftlint: disable=GL006(fixture: concrete at trace time)
+                return x
+            return -x
+
+        jitted = jax.jit(step)
+    """})
+    assert not r.findings
+    assert len(r.suppressed) == 1
+
+
 # -- GL004: lock discipline ---------------------------------------------------
 
 GL004_SRC = """
