@@ -23,6 +23,11 @@ Three benches, one JSON line:
    through the vmapped round step — samples/s/chip, gather/scatter seconds,
    prefetch overlap, and a cohort-bounded host-RSS ceiling (platform
    independent, floor-guarded).
+5. **AOT cold start** (ISSUE 7): the same tiny recipe run in two fresh
+   processes sharing one program store + compilation cache — cold populates,
+   warm must deserialize (``fedml_aot_misses_total == 0``) and reach the
+   first round in <= 0.5x the cold wall time (platform independent,
+   floor-guarded).
 
 The reference publishes no numeric baselines (BASELINE.md) and has no MFU
 accounting at all; the 0.35 target comes from BASELINE.json's north star.
@@ -309,6 +314,63 @@ def bench_population():
     }
 
 
+def bench_aot_cold_start():
+    """One phase of the cold-vs-warm start bench (ISSUE 7): run a small FL
+    recipe with ``extra.aot_programs`` on, timing construction through the
+    first scanned chunk.  The parent runs this TWICE in fresh processes
+    against ONE shared ``BENCH_AOT_ROOT`` (program store + XLA persistent
+    cache): the cold phase traces + exports + compiles everything, the warm
+    phase must deserialize every program (misses == 0) and start in half the
+    time.  Platform independent — startup cost is a CPU problem too."""
+    root = os.environ["BENCH_AOT_ROOT"]
+    # re-point the XLA persistent cache INTO the shared phase root: the cold
+    # phase must not borrow the repo-root cache the test suite keeps warm
+    # (nothing has compiled yet in this child, so the re-point is complete)
+    from fedml_tpu.core.cache import setup_persistent_cache
+
+    setup_persistent_cache(root)
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.core.aot import (
+        AOT_BUILD_TIME, AOT_EXPORTS, AOT_HITS, AOT_LOAD_TIME, AOT_MISSES,
+    )
+    from fedml_tpu.runner import FedMLRunner
+
+    # Recipe shape matters: the measured quantity is (fixed + load) /
+    # (fixed + build), where fixed = eager model.init + dataset gen + the
+    # round's execution — costs the store cannot remove.  ResNet-20 at 2
+    # clients x 1 local step keeps execution ~1.5 s while its scanned-round
+    # trace+compile is ~11 s, so the ratio isolates what the store saves;
+    # wider/shallower recipes (mlp) are fixed-cost-dominated and read ~1.
+    rounds = int(os.environ.get("BENCH_AOT_ROUNDS", "1"))
+    t0 = time.perf_counter()
+    cfg = Config(
+        dataset="cifar10", model="resnet20",
+        client_num_in_total=2, client_num_per_round=2, comm_round=rounds,
+        epochs=1, batch_size=8, learning_rate=0.1, partition_method="homo",
+        synthetic_train_size=2 * 8, synthetic_test_size=32,
+        frequency_of_the_test=0, compute_dtype="float32",
+        metrics_jsonl_path="",
+        extra={"aot_programs": True,
+               "aot_programs_dir": os.path.join(root, "aot_programs")},
+    )
+    fedml_tpu.init(cfg)
+    sim = FedMLRunner(cfg).runner
+    sim.warm_start()        # the store's warm() path: every chunk program
+    sim.run_rounds(rounds)  # resolved before round 0
+    start_s = time.perf_counter() - t0
+    return {
+        "start_to_first_round_s": round(start_s, 3),
+        "rounds": rounds,
+        "hits": int(AOT_HITS.value()),
+        "misses": int(AOT_MISSES.value()),
+        "exports": int(AOT_EXPORTS.value()),
+        "build_seconds": round(AOT_BUILD_TIME.sum(), 3),
+        "load_seconds": round(AOT_LOAD_TIME.sum(), 4),
+    }
+
+
 def bench_llm(peak):
     import jax
     import jax.numpy as jnp
@@ -381,6 +443,8 @@ def _run_one(mode):
         result = bench_crosssilo()
     elif mode == "population":
         result = bench_population()
+    elif mode == "aot_cold_start":
+        result = bench_aot_cold_start()
     else:
         result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
@@ -399,14 +463,16 @@ def _run_one(mode):
     print("BENCH_RESULT " + json.dumps(result))
 
 
-def _subprocess_bench(mode):
+def _subprocess_bench(mode, extra_env=None):
     """Each bench in a fresh process: the LLM bench's ~7 GB of device state
-    can't be reliably freed in-process and would starve the FedAvg bench."""
+    can't be reliably freed in-process and would starve the FedAvg bench.
+    (The AOT cold-start bench NEEDS the fresh process — warm means a new
+    process finding the programs on disk, not a warm in-process jit cache.)"""
     import subprocess
 
     res = subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
-        env={**os.environ, "BENCH_MODE": mode},
+        env={**os.environ, "BENCH_MODE": mode, **(extra_env or {})},
         capture_output=True,
         text=True,
         timeout=1500,
@@ -435,6 +501,12 @@ CROSSSILO_QSGD8_RATIO_FLOOR = 3.5
 #: Budget: 8 resident shards of 4096 clients ≈ 3.3x a 10k cohort, plus the
 #: double-buffered in-flight cohorts and npz materialization transients.
 POPULATION_RSS_MULTIPLE_FLOOR = 16.0
+#: Warm start-to-first-round as a fraction of cold (ISSUE 7) — platform
+#: independent (the AOT store removes re-tracing everywhere; on CPU the
+#: deserialized program's compile additionally rides the persistent
+#: compilation cache).  A warm process must reach round 1 in at most half
+#: the cold wall clock, with every program served from the store.
+AOT_WARM_RATIO_CEILING = 0.5
 
 
 def main():
@@ -473,6 +545,38 @@ def main():
     # samples/s/chip at a 10k cohort, gather/scatter seconds, prefetch
     # overlap, and the cohort-bounded host-RSS multiple (floor-guarded)
     population = _subprocess_bench("population")
+    # ISSUE-7 cold_start: two fresh processes share one AOT program store +
+    # compilation cache root; the first populates it, the second must
+    # deserialize every program (misses == 0) and start in <= 0.5x the time
+    import shutil
+    import tempfile
+
+    def _aot_pair():
+        aot_root = tempfile.mkdtemp(prefix="bench_aot_")
+        try:
+            cold = _subprocess_bench("aot_cold_start", {"BENCH_AOT_ROOT": aot_root})
+            warm = _subprocess_bench("aot_cold_start", {"BENCH_AOT_ROOT": aot_root})
+        finally:
+            shutil.rmtree(aot_root, ignore_errors=True)
+        ratio = round(warm["start_to_first_round_s"]
+                      / max(cold["start_to_first_round_s"], 1e-9), 3)
+        return cold, warm, ratio
+
+    aot_cold, aot_warm, aot_ratio = _aot_pair()
+    if aot_ratio > AOT_WARM_RATIO_CEILING:
+        # same one-retry policy as the MFU floors: wall-clock pairs on a
+        # loaded box have real variance; a single noisy pair must not fail
+        # the round
+        aot_cold, aot_warm, aot_ratio = _aot_pair()
+    aot = {
+        "cold_start_s": aot_cold["start_to_first_round_s"],
+        "warm_start_s": aot_warm["start_to_first_round_s"],
+        "ratio": aot_ratio,
+        "hits": {"cold": aot_cold["hits"], "warm": aot_warm["hits"]},
+        "misses": {"cold": aot_cold["misses"], "warm": aot_warm["misses"]},
+        "cold": aot_cold,
+        "warm": aot_warm,
+    }
 
     on_tpu = "TPU" in str(llm.get("device", ""))
     # one retry per bench before declaring a floor violation: a tunneled chip
@@ -495,6 +599,14 @@ def main():
         violations.append(
             f"population rss multiple {pop_rss} > ceiling "
             f"{POPULATION_RSS_MULTIPLE_FLOOR} (host memory not cohort-bounded)")
+    if aot_ratio > AOT_WARM_RATIO_CEILING:
+        violations.append(
+            f"aot warm/cold start ratio {aot_ratio} > ceiling "
+            f"{AOT_WARM_RATIO_CEILING} (warm start not program-store bound)")
+    if aot_warm["misses"] != 0 or aot_warm["hits"] <= 0:
+        violations.append(
+            f"aot warm run hits={aot_warm['hits']} misses={aot_warm['misses']} "
+            "(expected every program served from the store)")
 
     mfu = llm["mfu"]
     target = 0.35  # BASELINE.md MFU floor
@@ -518,6 +630,7 @@ def main():
             "fedavg_fused_speedup": fused_speedup,
             "crosssilo_comm": crosssilo,
             "population": population,
+            "aot": aot,
             "lint": lint_section,
         },
     }))

@@ -378,6 +378,19 @@ def cmd_lint(args) -> int:
     if not target.exists():
         print(f"error: no such path {target}", file=sys.stderr)
         return 2
+    if args.fix:
+        # --fix first rewrites the mechanical legacy idioms in place, then
+        # falls through to the normal lint pass so what remains (manual
+        # sites, other rules) is reported against the FIXED sources
+        from fedml_tpu.analysis.fix import fix_tree
+
+        summary = fix_tree(target)
+        if args.format == "json":
+            print(json.dumps({"files_changed": summary.files_changed,
+                              "rewrites": summary.rewrites,
+                              "manual": summary.skipped}))
+        else:
+            print(summary.render())
     baseline = Path(args.baseline) if args.baseline else pkg_dir / "analysis" / "baseline.json"
     result = lint_engine.run_lint(target, baseline=baseline if baseline.exists() else None)
     if args.write_baseline:
@@ -557,6 +570,9 @@ def main(argv=None) -> int:
                    help="suppression baseline JSON (default: fedml_tpu/analysis/baseline.json)")
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings into the baseline instead of failing")
+    p.add_argument("--fix", action="store_true",
+                   help="mechanically rewrite legacy extra.get(...) reads to "
+                        "cfg_extra(cfg, name, default) before linting")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.set_defaults(fn=cmd_lint)
 

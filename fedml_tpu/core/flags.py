@@ -111,6 +111,16 @@ FLAGS: dict[str, FlagSpec] = _specs(
     FlagSpec("population_prefetch", "bool", True,
              "Double-buffered cohort prefetch: gather round k+1's data on a "
              "worker thread while round k computes."),
+    # -- ahead-of-time program store (fedml_tpu/core/aot.py) -----------------
+    FlagSpec("aot_programs", "bool", False,
+             "Persist jax.export-serialized round/eval programs in the "
+             "on-disk program store so warm restarts skip re-tracing (the "
+             "remaining XLA compile rides the persistent compilation cache); "
+             "unset = the plain jit path, bit-identical to before the flag "
+             "existed."),
+    FlagSpec("aot_programs_dir", "str", None,
+             "Program-store directory; derived: "
+             "<repo>/.jax_cache-<host>/aot_programs (core/cache.py's dir)."),
     # -- communication / transports ------------------------------------------
     FlagSpec("comm_compression", "str", None,
              "Upload codec for cross-silo model replies: qsgd8 | topk "

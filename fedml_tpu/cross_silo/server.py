@@ -33,7 +33,7 @@ from ..algorithms import create as create_algorithm, hparams_from_config
 from ..comm import codecs, wire
 from ..comm.comm_manager import FedMLCommManager
 from ..comm.message import Message
-from ..core import pytree as pt, rng
+from ..core import aot as aotlib, pytree as pt, rng
 from ..core.flags import cfg_extra
 from ..data.dataset import pad_eval_set
 from ..fl.algorithm import FedAlgorithm
@@ -121,7 +121,22 @@ class FedMLAggregator:
         self.flag_client_model_uploaded: dict[int, bool] = {}
         tx, ty, n_valid = test_arrays
         self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
-        self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=min(256, max(32, cfg.test_batch_size))))
+        # server eval step through the AOT program store (extra.aot_programs):
+        # a redeployed/preempted server deserializes the exported eval program
+        # instead of re-tracing it; flag unset -> the exact old jit
+        eval_fn = make_eval_fn(model, self.hp, batch_size=min(256, max(32, cfg.test_batch_size)))
+        self._aot = aotlib.store_from_config(cfg)
+        if self._aot is not None:
+            self._eval_fn = self._aot.cached_jit(
+                eval_fn, (self.global_vars, *self._test),
+                key=aotlib.program_key(
+                    "cross_silo.eval",
+                    trees={"args": (self.global_vars, *self._test)},
+                    hparams=self.hp,
+                    config=aotlib.config_signature(cfg)),
+            )
+        else:
+            self._eval_fn = jax.jit(eval_fn)
         # streaming aggregation: fold each arriving update into a running
         # weighted sum as it lands (overlapping aggregation with the network
         # tail; peak host memory ~2x model instead of N x model).  Engaged

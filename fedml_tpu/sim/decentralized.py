@@ -27,7 +27,7 @@ import numpy as np
 
 from ..algorithms import hparams_from_config
 from ..arguments import Config
-from ..core import pytree as pt, rng
+from ..core import aot as aotlib, pytree as pt, rng
 from ..core.flags import cfg_extra
 from ..data.dataset import pad_eval_set, stack_clients
 from ..fl.local_sgd import make_eval_fn, make_local_train_fn
@@ -100,7 +100,25 @@ class DecentralizedSimulator:
         self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
         self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
         self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
-        self._round_fn = jax.jit(self._make_round_fn())
+        # AOT program store (extra.aot_programs): ring gossip was 587 s of
+        # recurring dryrun compile — warm restarts deserialize the exported
+        # shard_map/ppermute program instead of re-tracing it.  Unset -> the
+        # exact old jit path.
+        self._aot = aotlib.store_from_config(cfg, trail=self.logger.log)
+        round_fn = self._make_round_fn()
+        if self._aot is not None:
+            example = (self.client_vars, self.push_weights, self._data[0],
+                       self._data[1], self.counts, jnp.int32(0), self.root_key)
+            self._round_fn = self._aot.cached_jit(
+                round_fn, example,
+                key=aotlib.program_key(
+                    "sim.gossip_round", mesh=self.mesh,
+                    trees={"args": example}, hparams=self.hp,
+                    config=aotlib.config_signature(cfg),
+                    extra={"mode": self.mode, "neighbors": neighbor_num}),
+            )
+        else:
+            self._round_fn = jax.jit(round_fn)
 
     def _gossip_axis(self) -> str:
         """The mesh axis the stacked-clients dim shards over (the same

@@ -40,7 +40,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import constants as C
 from ..algorithms import create as create_algorithm, hparams_from_config
 from ..arguments import Config
-from ..core import pytree as pt, rng
+from ..core import aot as aotlib, pytree as pt, rng
 from ..core.flags import cfg_extra
 from ..data.dataset import FederatedDataset, StackedClientData, pad_eval_set, stack_clients
 from ..fl.local_sgd import make_eval_fn
@@ -107,6 +107,12 @@ class MeshSimulator(RoundCheckpointMixin):
             dataset = trust.attacker.poison_data(dataset)
             self.dataset = dataset
         self.logger = logger or MetricsLogger(cfg.metrics_jsonl_path or None)
+        # ahead-of-time program store (extra.aot_programs, ISSUE 7): the
+        # scanned-chunk / population-round / eval programs are
+        # jax.export-serialized under a tracing fingerprint so a restarted
+        # server deserializes instead of re-tracing.  Flag unset -> None and
+        # every jit below runs the exact pre-store path (bit-identical).
+        self._aot = aotlib.store_from_config(cfg, trail=self.logger.log)
 
         # ---- data: pad + stack, shard over the clients axis ----
         stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
@@ -163,7 +169,15 @@ class MeshSimulator(RoundCheckpointMixin):
         tx, ty, n_test = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
         self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_test))
         self._eval_bs = eval_bs  # the padding multiple of self._test
-        self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
+        eval_fn = make_eval_fn(model, self.hp, batch_size=eval_bs)
+        if self._aot is not None:
+            self._eval_fn = self._aot.cached_jit(
+                eval_fn, (self.global_vars, *self._test),
+                key=self._aot_key("sim.eval", trees={
+                    "global_vars": self.global_vars, "test": self._test}),
+            )
+        else:
+            self._eval_fn = jax.jit(eval_fn)
 
         # OTLP egress (gated on extra.otlp_endpoint; None -> spans keep
         # their no-sink default and no exporter thread exists): the
@@ -366,10 +380,14 @@ class MeshSimulator(RoundCheckpointMixin):
         )
         m = sampler.cohort_size
         m_pad = meshlib.round_up(m, self._lane_multiple)
+        # with the AOT store the cohort round binds lazily at round 0 (the
+        # export fingerprint wants the real stacked example args); without it
+        # the program is jitted here exactly as before
         self._population = SimpleNamespace(
             store=store, sampler=sampler, pipeline=pipeline,
             m=m, m_pad=m_pad,
-            round_fn=jax.jit(self._make_population_round_fn(m)),
+            round_fn=(jax.jit(self._make_population_round_fn(m))
+                      if self._aot is None else None),
         )
         self.client_states = None  # per-client state lives in the store
 
@@ -458,13 +476,24 @@ class MeshSimulator(RoundCheckpointMixin):
                     self._pad_cohort_rows(cs, pop.m_pad), self.mesh)
             xs, ys = meshlib.shard_leading_axis((xs, ys), self.mesh)
             cnts = jnp.asarray(self._pad_cohort_rows(batch.counts, pop.m_pad))
+            args = (
+                self.global_vars, self.server_state, cs, cnts, xs, ys,
+                jnp.asarray(lanes, jnp.int32), jnp.int32(r), self.root_key,
+                self.defense_history,
+            )
+            if pop.round_fn is None:
+                # first cohort with the AOT store: load (or export) the
+                # round program — a restarted server skips the re-trace
+                raw = self._make_population_round_fn(pop.m)
+                pop.round_fn = self._aot.cached_jit(
+                    raw, args,
+                    key=self._aot_key("sim.population_round",
+                                      trees={"args": args},
+                                      extra={"cohort": pop.m}),
+                )
             with traced("sim.population_round", round_idx=r, cohort=pop.m,
                         sink=self._otlp_sink):
-                gv, ss, new_cs, nd, metrics = pop.round_fn(
-                    self.global_vars, self.server_state, cs, cnts, xs, ys,
-                    jnp.asarray(lanes, jnp.int32), jnp.int32(r), self.root_key,
-                    self.defense_history,
-                )
+                gv, ss, new_cs, nd, metrics = pop.round_fn(*args)
                 host = {k: float(v) for k, v in metrics.items()}  # syncs
             if new_cs is not None:
                 pop.store.scatter_state(ids, new_cs)
@@ -480,6 +509,44 @@ class MeshSimulator(RoundCheckpointMixin):
         return out
 
     # ------------------------------------------------------------------
+    def _aot_key(self, site: str, trees: Optional[dict] = None,
+                 extra: Optional[dict] = None) -> str:
+        """Program-store fingerprint for one of this simulator's traced
+        programs: mesh + argument tree signatures + hparams + the full
+        (volatile-stripped) config, so any knob that changes tracing — chunk
+        size, fused_blocks, codec/trust flags, donation gating — changes the
+        key (see core/aot.py)."""
+        return aotlib.program_key(
+            site,
+            mesh=None if self.backend == C.SIMULATION_BACKEND_SP else self.mesh,
+            trees=trees,
+            hparams=self.hp,
+            config=aotlib.config_signature(self.cfg),
+            extra=dict(extra or {}, backend_sim=self.backend),
+        )
+
+    def warm_start(self) -> dict:
+        """The AOT store's ``warm()`` path: pre-load (or pre-build) every
+        scanned-chunk program :meth:`run` will need before round 0, so a
+        restarted server's first round pays dispatch, not tracing.  No-op
+        without ``extra.aot_programs`` / off the mesh chunk path."""
+        if (self._aot is None or self._population is not None
+                or self.backend == C.SIMULATION_BACKEND_SP):
+            return {"warmed": 0}
+        lengths, r = set(), self.round_idx
+        while r < self.cfg.comm_round:
+            end = self._next_boundary(r)
+            lengths.add(end - r)
+            r = end
+        args = (
+            self.global_vars, self.server_state, self.client_states,
+            self.counts, self._data[0], self._data[1],
+            jnp.int32(self.round_idx), self.root_key, self.defense_history,
+        )
+        for n in sorted(lengths):
+            self._get_multi_round_fn(n, example_args=args)
+        return {"warmed": len(lengths)}
+
     def _get_multi_round_fn(self, n: int, example_args: Optional[tuple] = None):
         """jit(scan(round)) over ``n`` rounds — ONE dispatch and ONE host
         sync per chunk.  On TPU every host<->device round trip is latency
@@ -494,7 +561,18 @@ class MeshSimulator(RoundCheckpointMixin):
         died with wandering segfaults/aborts (device_get, tracing, GC, and
         most reliably when the serialized donated executable was reloaded
         from the persistent compilation cache) until CPU donation was
-        dropped."""
+        dropped.
+
+        With ``extra.aot_programs`` the chunk program comes out of the AOT
+        program store (core/aot.py): a warm process deserializes the exported
+        StableHLO instead of re-tracing, and the wrapper's compile goes back
+        through the persistent compilation cache — safe to re-enable for
+        chunk programs because the stored artifact is donation-free (the heap
+        corruption above only ever reproduced when a *donated* chunk
+        executable was reloaded on XLA:CPU; donation stays CPU-gated on the
+        wrapper).  RE-PROBE on a jax upgrade past 0.4.37: lift the CPU
+        donation gate under tier-1 — if the wandering segfaults stay gone,
+        donate on CPU too and drop this note."""
         fn = self._multi_round_fns.get(n)
         if fn is not None:
             CHUNK_CACHE.inc(result="hit")
@@ -523,9 +601,22 @@ class MeshSimulator(RoundCheckpointMixin):
         fn = jitted
         if example_args is not None:
             t0 = time.perf_counter()
+            prog = None
+            if self._aot is not None:
+                # store the donation-free export; donation is re-applied on
+                # the wrapper below so one artifact serves CPU and TPU
+                prog = self._aot.get_or_build(
+                    self._aot_key("sim.multi_round",
+                                  trees={"args": example_args},
+                                  extra={"chunk": n, "donate": list(donate)}),
+                    lambda: aotlib.export_program(jax.jit(multi), example_args),
+                )
             try:
                 with traced("sim.chunk_compile", rounds=n, sink=self._otlp_sink):
-                    fn = jitted.lower(*example_args).compile()
+                    if prog is not None:
+                        fn = prog.bind(example_args, donate_argnums=donate)
+                    else:
+                        fn = jitted.lower(*example_args).compile()
             except Exception:
                 # AOT unsupported for these inputs — the lazy jit still works
                 fn = jitted
@@ -705,6 +796,8 @@ class MeshSimulator(RoundCheckpointMixin):
         history = []
         cfg = self.cfg
         self.try_resume()
+        if self._aot is not None:
+            self.warm_start()  # resolve every chunk program before round 0
         while self.round_idx < cfg.comm_round:
             r0 = self.round_idx
             if getattr(cfg, "enable_contribution", False) and r0 == cfg.comm_round - 1:

@@ -30,7 +30,7 @@ import numpy as np
 
 from ..algorithms import hparams_from_config
 from ..arguments import Config
-from ..core import pytree as pt, rng
+from ..core import aot as aotlib, pytree as pt, rng
 from ..core.flags import cfg_extra
 from ..data.dataset import pad_eval_set, stack_clients
 from ..fl.local_sgd import make_eval_fn, make_local_train_fn
@@ -87,7 +87,26 @@ class HierarchicalSimulator:
         self._test = (jnp.asarray(tx), jnp.asarray(ty), jnp.int32(n_valid))
         self._eval_fn = jax.jit(make_eval_fn(model, self.hp, batch_size=eval_bs))
         self.logger = MetricsLogger(cfg.metrics_jsonl_path or None)
-        self._round_fn = jax.jit(self._make_round_fn())
+        # AOT program store (extra.aot_programs): this round program was the
+        # single biggest recurring compile in the multichip dryrun (1236 s on
+        # a 1-core box) — a warm process deserializes the export instead of
+        # re-tracing the scan-of-sub-rounds.  Unset -> the exact old jit.
+        self._aot = aotlib.store_from_config(cfg, trail=self.logger.log)
+        round_fn = self._make_round_fn()
+        if self._aot is not None:
+            example = (self.global_vars, self._data[0], self._data[1],
+                       self.counts, jnp.int32(0), self.root_key)
+            self._round_fn = self._aot.cached_jit(
+                round_fn, example,
+                key=aotlib.program_key(
+                    "sim.hierarchical_round", mesh=self.mesh,
+                    trees={"args": example}, hparams=self.hp,
+                    config=aotlib.config_signature(cfg),
+                    extra={"groups": self.group_num,
+                           "sub_rounds": self.group_comm_round}),
+            )
+        else:
+            self._round_fn = jax.jit(round_fn)
 
     def _make_round_fn(self):
         G = self.group_num

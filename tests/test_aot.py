@@ -1,0 +1,375 @@
+"""AOT program store (ISSUE 7, ``fedml_tpu/core/aot.py``).
+
+The contract under test:
+
+- export/import roundtrip parity: a program loaded from the store produces
+  BITWISE the same outputs as the freshly built jit on CPU;
+- fingerprints are stable across processes and sensitive to every key
+  component (site, tree structure/shape/dtype, mesh, hparams, extras);
+- corrupt / truncated / version-mismatched entries fall back to a rebuild,
+  never a crash;
+- two processes racing on one key produce ONE export (advisory flock);
+- flag unset is a strict no-op (``store_from_config`` returns None and the
+  simulators run their pre-store jit paths) and the flagged path is
+  bit-identical to the default path — cold AND warm;
+- every wired site (mesh chunk, population round, sim eval, hierarchical
+  round, ring gossip, cross-silo server eval) hits the store on a second
+  construction with zero rebuilds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core import aot
+from fedml_tpu.core.aot import (
+    AOT_EXPORTS, AOT_HITS, AOT_MISSES, ProgramStore, export_program,
+    program_key, store_from_config,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def counters():
+    return AOT_HITS.value(), AOT_MISSES.value(), AOT_EXPORTS.value()
+
+
+def _toy_fn():
+    def fn(w, x, key):
+        for _ in range(3):
+            w = jnp.tanh(x @ w) + 0.5 * w
+        noise = jax.random.normal(key, w.shape) * 1e-3
+        return w + noise, (w * x[:, : w.shape[1]]).sum()
+
+    args = (
+        jnp.linspace(0.0, 1.0, 32, dtype=jnp.float32).reshape(8, 4),
+        jnp.ones((8, 8), jnp.float32),
+        jax.random.PRNGKey(7),
+    )
+    return fn, args
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+# -- roundtrip parity ---------------------------------------------------------
+
+def test_roundtrip_parity_bitwise(tmp_path):
+    fn, args = _toy_fn()
+    key = program_key("test.roundtrip", trees={"args": args})
+    store = ProgramStore(str(tmp_path))
+    h0, m0, e0 = counters()
+    built = store.get_or_build(key, lambda: export_program(jax.jit(fn), args))
+    assert built is not None and not built.from_cache
+    assert counters() == (h0, m0 + 1, e0 + 1)
+
+    # a FRESH store object (new process stand-in) must load from disk
+    loaded = ProgramStore(str(tmp_path)).get_or_build(
+        key, lambda: pytest.fail("warm lookup must not rebuild"))
+    assert loaded.from_cache
+    assert counters() == (h0 + 1, m0 + 1, e0 + 1)
+
+    fresh = jax.device_get(jax.jit(fn)(*args))
+    stored = jax.device_get(loaded.bind()(*args))
+    assert _leaves_equal(fresh, stored)  # bitwise, not allclose
+
+
+# -- fingerprints -------------------------------------------------------------
+
+def test_fingerprint_stable_across_processes():
+    tree = {"w": jnp.zeros((4, 8), jnp.float32), "b": jnp.zeros((8,), jnp.bfloat16)}
+    key = program_key("test.stable", trees={"a": tree},
+                      hparams={"lr": 0.1, "epochs": 2},
+                      config={"model": "lr"}, extra={"chunk": 3})
+    code = textwrap.dedent(f"""
+        import sys; sys.path.insert(0, {REPO_ROOT!r})
+        import jax, jax.numpy as jnp
+        from fedml_tpu.core.aot import program_key
+        tree = {{"w": jnp.zeros((4, 8), jnp.float32),
+                 "b": jnp.zeros((8,), jnp.bfloat16)}}
+        print(program_key("test.stable", trees={{"a": tree}},
+                          hparams={{"lr": 0.1, "epochs": 2}},
+                          config={{"model": "lr"}}, extra={{"chunk": 3}}))
+    """)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=120, env=dict(os.environ))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().splitlines()[-1] == key
+
+
+def test_fingerprint_sensitive_to_each_component():
+    from jax.sharding import Mesh
+
+    tree = {"w": jnp.zeros((4, 8), jnp.float32)}
+    base = dict(trees={"a": tree}, hparams={"lr": 0.1},
+                config={"model": "lr"}, extra={"chunk": 2})
+    keys = {
+        "base": program_key("s", **base),
+        "site": program_key("s2", **base),
+        "tree_shape": program_key("s", **{**base, "trees": {"a": {"w": jnp.zeros((4, 9), jnp.float32)}}}),
+        "tree_dtype": program_key("s", **{**base, "trees": {"a": {"w": jnp.zeros((4, 8), jnp.bfloat16)}}}),
+        "tree_structure": program_key("s", **{**base, "trees": {"a": {"v": jnp.zeros((4, 8), jnp.float32)}}}),
+        "hparams": program_key("s", **{**base, "hparams": {"lr": 0.2}}),
+        "config": program_key("s", **{**base, "config": {"model": "mlp"}}),
+        "extra_chunk": program_key("s", **{**base, "extra": {"chunk": 4}}),
+        "mesh": program_key("s", mesh=Mesh(np.array(jax.devices()), ("clients",)), **base),
+    }
+    assert len(set(keys.values())) == len(keys), keys
+
+
+# -- corruption / version fallback -------------------------------------------
+
+def _entry_path(store, key):
+    return store._path(key)
+
+
+def test_truncated_entry_rebuilds(tmp_path):
+    fn, args = _toy_fn()
+    key = program_key("test.trunc", trees={"args": args})
+    store = ProgramStore(str(tmp_path))
+    build = lambda: export_program(jax.jit(fn), args)
+    store.get_or_build(key, build)
+    path = _entry_path(store, key)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) // 2])  # torn write stand-in
+
+    h0, m0, e0 = counters()
+    prog = ProgramStore(str(tmp_path)).get_or_build(key, build)
+    assert prog is not None and not prog.from_cache  # rebuilt, no crash
+    assert counters() == (h0, m0 + 1, e0 + 1)
+    # the rebuilt entry is valid again
+    again = ProgramStore(str(tmp_path)).get_or_build(
+        key, lambda: pytest.fail("rebuilt entry must load"))
+    assert again.from_cache
+
+
+def test_garbage_and_version_mismatch_rebuild(tmp_path):
+    fn, args = _toy_fn()
+    key = program_key("test.vers", trees={"args": args})
+    store = ProgramStore(str(tmp_path))
+    build = lambda: export_program(jax.jit(fn), args)
+    store.get_or_build(key, build)
+    path = _entry_path(store, key)
+
+    # garbage magic
+    open(path, "wb").write(b"not a program store entry")
+    assert not ProgramStore(str(tmp_path)).get_or_build(key, build).from_cache
+
+    # valid envelope, wrong toolchain version
+    blob = open(path, "rb").read()
+    magic = b"FMLAOT1\n"
+    header, payload = blob[len(magic):].split(b"\n", 1)
+    meta = json.loads(header)
+    meta["jax"] = "0.0.0"
+    open(path, "wb").write(magic + json.dumps(meta, sort_keys=True).encode() + b"\n" + payload)
+    h0, m0, _ = counters()
+    prog = ProgramStore(str(tmp_path)).get_or_build(key, build)
+    assert prog is not None and not prog.from_cache
+    assert counters()[0] == h0  # the mismatched entry never counts as a hit
+
+
+def test_failing_build_falls_back_to_none(tmp_path):
+    store = ProgramStore(str(tmp_path))
+
+    def bad_build():
+        raise RuntimeError("unexportable program")
+
+    assert store.get_or_build("test.bad.000", bad_build) is None  # no crash
+    assert store.entries() == []
+
+
+# -- cross-process concurrency ------------------------------------------------
+
+def test_concurrent_two_process_single_export(tmp_path):
+    """Two processes race get_or_build on one key: the flock serializes them
+    into exactly ONE export; the loser loads the winner's entry and both
+    programs produce identical outputs."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import json, sys, time
+        sys.path.insert(0, {REPO_ROOT!r})
+        import jax, jax.numpy as jnp
+        import numpy as np
+        from fedml_tpu.core.aot import (AOT_EXPORTS, AOT_HITS, AOT_MISSES,
+                                        ProgramStore, export_program, program_key)
+
+        def fn(w):
+            for _ in range(3):
+                w = jnp.tanh(w @ w.T) @ w
+            return w
+
+        args = (jnp.linspace(0.0, 1.0, 64, dtype=jnp.float32).reshape(8, 8),)
+        key = program_key("test.race", trees={{"args": args}})
+        store = ProgramStore({str(tmp_path)!r})
+
+        def build():
+            time.sleep(1.0)  # hold the flock long enough to overlap the peer
+            return export_program(jax.jit(fn), args)
+
+        prog = store.get_or_build(key, build)
+        out = np.asarray(jax.device_get(prog.bind()(*args)))
+        print(json.dumps({{"misses": AOT_MISSES.value(), "hits": AOT_HITS.value(),
+                           "exports": AOT_EXPORTS.value(),
+                           "checksum": float(out.sum()),
+                           "from_cache": prog.from_cache}}))
+    """))
+    procs = [subprocess.Popen([sys.executable, str(script)], stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=dict(os.environ))
+             for _ in range(2)]
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-2000:]
+        results.append(json.loads(out.strip().splitlines()[-1]))
+    assert sum(r["misses"] for r in results) == 1, results  # ONE build total
+    assert sum(r["exports"] for r in results) == 1, results
+    assert results[0]["checksum"] == results[1]["checksum"]
+    assert len([f for f in os.listdir(tmp_path) if f.endswith(".jaxprog")]) == 1
+
+
+# -- flag gating + end-to-end parity ------------------------------------------
+
+def test_flag_unset_is_noop(make_tiny_config):
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.sim.engine import MeshSimulator
+
+    import fedml_tpu
+
+    cfg = make_tiny_config()
+    assert store_from_config(cfg) is None
+    assert store_from_config(None) is None
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    sim = MeshSimulator(cfg, ds, model_hub.create(cfg, ds.class_num))
+    assert sim._aot is None  # every jit below runs the pre-store path
+
+
+def _run_mesh(make_tiny_config, extra):
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.sim.engine import MeshSimulator
+
+    cfg = make_tiny_config(metrics_jsonl_path="", extra=dict(extra))
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    sim = MeshSimulator(cfg, ds, model_hub.create(cfg, ds.class_num))
+    hist = sim.run()
+    return sim, hist
+
+
+def test_mesh_parity_flag_off_cold_warm(tmp_path, make_tiny_config):
+    """The acceptance pin: default path vs store-cold vs store-warm are all
+    BITWISE identical, and the warm run reports hits with zero misses."""
+    sim_off, hist_off = _run_mesh(make_tiny_config, {})
+    flags = {"aot_programs": True, "aot_programs_dir": str(tmp_path)}
+    sim_cold, hist_cold = _run_mesh(make_tiny_config, flags)
+    h0, m0, _ = counters()
+    sim_warm, hist_warm = _run_mesh(make_tiny_config, flags)
+    assert AOT_MISSES.value() - m0 == 0  # warm run rebuilt nothing
+    assert AOT_HITS.value() - h0 > 0
+
+    off = jax.device_get(sim_off.global_vars)
+    assert _leaves_equal(off, jax.device_get(sim_cold.global_vars))
+    assert _leaves_equal(off, jax.device_get(sim_warm.global_vars))
+    for h in (hist_cold, hist_warm):
+        assert h[-1]["test_acc"] == hist_off[-1]["test_acc"]
+        assert h[-1]["test_loss"] == hist_off[-1]["test_loss"]
+
+
+def test_population_round_program_cached(tmp_path, make_tiny_config):
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.sim.engine import MeshSimulator
+
+    losses = []
+    for i in range(2):
+        cfg = make_tiny_config(
+            client_num_in_total=16, client_num_per_round=8, batch_size=8,
+            synthetic_train_size=256, frequency_of_the_test=0,
+            metrics_jsonl_path="",
+            extra={"aot_programs": True, "aot_programs_dir": str(tmp_path / "aot"),
+                   "population_store": str(tmp_path / f"pop{i}"),
+                   "population_size": 64})
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        h0, m0, _ = counters()
+        sim = MeshSimulator(cfg, ds, model_hub.create(cfg, ds.class_num))
+        out = sim.run_rounds(2)
+        losses.append(out[-1]["train_loss"])
+        if i == 1:  # second process stand-in: eval + population round both hit
+            assert AOT_MISSES.value() - m0 == 0
+            assert AOT_HITS.value() - h0 >= 2
+    assert losses[0] == losses[1]
+
+
+def test_hierarchical_and_gossip_and_crosssilo_eval_hit(tmp_path, make_tiny_config):
+    import dataclasses
+
+    import fedml_tpu
+    from fedml_tpu.cross_silo.server import FedMLAggregator
+    from fedml_tpu.data import loader
+    from fedml_tpu.data.dataset import pad_eval_set
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.sim.decentralized import DecentralizedSimulator
+    from fedml_tpu.sim.hierarchical import HierarchicalSimulator
+
+    flags = {"aot_programs": True, "aot_programs_dir": str(tmp_path)}
+
+    def hier():
+        cfg = make_tiny_config(
+            federated_optimizer="HierarchicalFL", group_num=2,
+            group_comm_round=2, client_num_per_round=8,
+            frequency_of_the_test=0, metrics_jsonl_path="", extra=dict(flags))
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        sim = HierarchicalSimulator(cfg, ds, model_hub.create(cfg, ds.class_num))
+        return sim.run_round()["train_loss"]
+
+    def ring():
+        cfg = make_tiny_config(
+            federated_optimizer="decentralized_fl", client_num_per_round=8,
+            frequency_of_the_test=0, metrics_jsonl_path="", extra=dict(flags))
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        sim = DecentralizedSimulator(
+            cfg, ds, model_hub.create(cfg, ds.class_num), mode="ring")
+        return sim.run_round()["train_loss"]
+
+    def cs_eval(extra):
+        cfg = make_tiny_config(
+            training_type="cross_silo", client_num_in_total=2,
+            client_num_per_round=2, metrics_jsonl_path="", extra=dict(extra))
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        model = model_hub.create(cfg, ds.class_num)
+        test = pad_eval_set(ds.test_x, ds.test_y, min(256, max(32, cfg.test_batch_size)))
+        agg = FedMLAggregator(cfg, model, ds.test_x[:1], test)
+        return {k: float(v) for k, v in
+                agg._eval_fn(agg.global_vars, *agg._test).items()}
+
+    for build in (hier, ring):
+        first = build()
+        h0, m0, _ = counters()
+        second = build()
+        assert second == first  # loaded program, identical numerics
+        assert AOT_MISSES.value() - m0 == 0
+        assert AOT_HITS.value() - h0 > 0
+
+    ev_cold = cs_eval(flags)
+    h0, m0, _ = counters()
+    ev_warm = cs_eval(flags)
+    assert AOT_MISSES.value() - m0 == 0 and AOT_HITS.value() - h0 > 0
+    assert cs_eval({}) == ev_cold == ev_warm  # flag-off parity too
