@@ -18,7 +18,8 @@ import time
 from abc import ABC, abstractmethod
 
 from ..obs import registry as obsreg
-from .message import Message
+from . import wire
+from .message import ChunkAssembler, Message
 
 log = logging.getLogger(__name__)
 
@@ -57,16 +58,27 @@ SEND_LATENCY = obsreg.REGISTRY.histogram(
     "Transport send() wall time, by protocol message type.",
     labels=("type",),
 )
+CHUNK_FRAMES = obsreg.REGISTRY.counter(
+    "fedml_comm_chunk_frames_received_total",
+    "Transport chunk frames fed to the per-peer stream assembler.",
+)
 
 #: transient decode failures are retried this many times with linear backoff
 DECODE_RETRY_LIMIT = 3
 DECODE_RETRY_BACKOFF_S = 0.2
 
+#: a chunked upload whose sender dies mid-stream is evicted (and metered as
+#: a drop attributed to that sender) after this long without a new chunk
+CHUNK_STREAM_TIMEOUT_S = 120.0
+
 #: process-wide comm event sinks ``fn(event, **info)`` for the drop/retry
 #: signals the counters above aggregate — the client health ledger
 #: (obs/health.py) subscribes so transport pressure folds into health
-#: scores.  Sink failures are swallowed: telemetry must never take down
-#: the receive loop.
+#: scores.  Events carry ``client=<sender>`` whenever the failing payload
+#: is attributable (chunk subheaders name their sender), so per-client
+#: pressure accrues for async arrivals the same way the synchronous
+#: broadcast-failure path attributes it.  Sink failures are swallowed:
+#: telemetry must never take down the receive loop.
 _event_sinks: list = []
 
 
@@ -110,6 +122,9 @@ class ObserverLoopMixin:
         self._observers = []
         self._inbox = inbox if inbox is not None else queue.Queue()
         self._running = False
+        # per-peer reassembly of transport chunk frames (lazily built: the
+        # unchunked protocol never pays for it)
+        self._chunk_assembler = None
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -140,11 +155,30 @@ class ObserverLoopMixin:
                 try:
                     raw = self._inbox.get(timeout=0.05)
                 except queue.Empty:
+                    self._sweep_chunk_streams()
                     continue
                 # pre-redesign requeues carried (data, attempts) tuples;
                 # accept both shapes so a mid-upgrade inbox still drains
                 item = raw if isinstance(raw, tuple) else (raw, 0)
             data, attempts = item
+            if isinstance(data, (bytes, bytearray, memoryview)) and wire.is_chunk_frame(data):
+                # chunked upload: feed the per-peer assembler; leaves decode
+                # incrementally, and only the FINAL chunk yields a Message
+                CHUNK_FRAMES.inc()
+                if self._chunk_assembler is None:
+                    self._chunk_assembler = ChunkAssembler(CHUNK_STREAM_TIMEOUT_S)
+                msg, err, sender = self._chunk_assembler.feed(data)
+                if err is not None:
+                    MSG_DROPPED.inc(reason=err)
+                    _emit_comm_event("dropped", reason=err, client=sender)
+                    log.error("dropping chunk stream from sender %s: %s", sender, err)
+                    continue
+                if msg is None:
+                    continue  # stream still in flight
+                MSG_RECEIVED.inc(type=str(msg.get_type()))
+                BYTES_RECEIVED.inc(msg.wire_nbytes)
+                self._dispatch(msg)
+                continue
             try:
                 msg = self._decode_bytes(data)
             except (KeyError, ValueError):
@@ -182,17 +216,33 @@ class ObserverLoopMixin:
             MSG_RECEIVED.inc(type=str(msg.get_type()))
             if isinstance(data, (bytes, bytearray, memoryview)):
                 BYTES_RECEIVED.inc(len(data))
-            for obs in list(self._observers):
-                try:
-                    obs.receive_message(msg.get_type(), msg)
-                except Exception:
-                    # a handler crash must not kill the loop either — same
-                    # invariant as the decode guard above
-                    HANDLER_ERRORS.inc()
-                    log.exception(
-                        "observer %r failed on message type %s",
-                        obs, msg.get_type(),
-                    )
+            self._dispatch(msg)
+
+    def _dispatch(self, msg: Message) -> None:
+        if msg.recv_monotonic is None:
+            msg.recv_monotonic = time.monotonic()
+        for obs in list(self._observers):
+            try:
+                obs.receive_message(msg.get_type(), msg)
+            except Exception:
+                # a handler crash must not kill the loop — same invariant as
+                # the decode guard: one poisoned message, not a dead endpoint
+                HANDLER_ERRORS.inc()
+                log.exception(
+                    "observer %r failed on message type %s",
+                    obs, msg.get_type(),
+                )
+
+    def _sweep_chunk_streams(self) -> None:
+        """Evict chunk streams whose sender went dark mid-upload; each
+        eviction is a metered, sender-attributed drop."""
+        if self._chunk_assembler is None:
+            return
+        for sender, stream_id in self._chunk_assembler.sweep():
+            MSG_DROPPED.inc(reason="chunk_stream_timeout")
+            _emit_comm_event("dropped", reason="chunk_stream_timeout", client=sender)
+            log.warning("evicting stale chunk stream %s from sender %s",
+                        stream_id, sender)
 
     def stop_receive_message(self) -> None:
         self._running = False

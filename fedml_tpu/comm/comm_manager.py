@@ -89,6 +89,7 @@ class FedMLCommManager(Observer):
             return GRPCCommManager(
                 "0.0.0.0", base_port + self.rank, self.rank,
                 ip_config=ip_config, base_port=base_port,
+                chunk_bytes=int(cfg_extra(self.cfg, "comm_chunk_bytes") or 0),
             )
         if b == C.COMM_BACKEND_MQTT_S3:
             from .mqtt_s3 import MqttS3CommManager
@@ -138,6 +139,7 @@ class FedMLCommManager(Observer):
             return TCPCommManager(
                 "0.0.0.0", base_port + self.rank, self.rank,
                 ip_config=ip_config, base_port=base_port,
+                chunk_bytes=int(cfg_extra(self.cfg, "comm_chunk_bytes") or 0),
             )
         raise ValueError(
             f"unknown comm backend {b!r}; known: "

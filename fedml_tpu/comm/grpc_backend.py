@@ -14,12 +14,14 @@ just "unary bytes in, empty bytes out" at
 
 from __future__ import annotations
 
+import itertools
 import queue
 from concurrent import futures
 from typing import Optional
 
 import grpc
 
+from . import wire
 from .base import BaseCommunicationManager, ObserverLoopMixin
 from .message import Message
 
@@ -61,11 +63,17 @@ class GRPCCommManager(ObserverLoopMixin, BaseCommunicationManager):
     """
 
     def __init__(self, host: str, port: int, rank: int,
-                 ip_config: Optional[dict] = None, base_port: int = 8890):
+                 ip_config: Optional[dict] = None, base_port: int = 8890,
+                 chunk_bytes: int = 0):
         self.rank = rank
         # YAML/JSON mapping keys arrive as strings; normalize so lookups hit
         self.ip_config = {int(k): v for k, v in (ip_config or {}).items()}
         self.base_port = base_port
+        # extra.comm_chunk_bytes: large messages ship as bounded chunk-frame
+        # RPCs (each its own unary call, so N uploads interleave through the
+        # server's thread pool); 0 = one RPC per message, the legacy bytes
+        self.chunk_bytes = int(chunk_bytes or 0)
+        self._stream_seq = itertools.count()
         self._init_observer_loop()
         self._channels: dict[int, grpc.Channel] = {}
         self._server = grpc.server(
@@ -91,7 +99,15 @@ class GRPCCommManager(ObserverLoopMixin, BaseCommunicationManager):
         stub = self._channels[rid].unary_unary(
             SERVICE_METHOD, request_serializer=_identity, response_deserializer=_identity
         )
-        stub(msg.encode(), timeout=60.0)
+        payload = msg.encode()
+        if self.chunk_bytes and len(payload) > self.chunk_bytes:
+            stream_id = f"{self.rank}.{next(self._stream_seq)}"
+            for frame in wire.encode_chunk_frames(
+                    payload, stream_id=stream_id, sender=self.rank,
+                    chunk_bytes=self.chunk_bytes):
+                stub(frame, timeout=60.0)
+        else:
+            stub(payload, timeout=60.0)
 
     def stop_receive_message(self) -> None:
         super().stop_receive_message()

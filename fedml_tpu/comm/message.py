@@ -9,7 +9,8 @@ arbitrary params.  Tensor payloads ride the pytree wire format
 from __future__ import annotations
 
 import json
-from typing import Any
+import time
+from typing import Any, Optional
 
 from . import wire
 
@@ -40,8 +41,15 @@ class Message:
         # until first tensor access (lazy decode keeps the receive loop off
         # the dequantize path and lets a streaming consumer fold leaf-by-leaf)
         self._tensor_stream = None
+        # tensor section already decoded leaf-by-leaf during chunked arrival:
+        # (wire_header, [leaf arrays in wire order]) — the chunk assembler's
+        # output form; restored into msg_params on first tensor access
+        self._tensor_leaves = None
         #: wire size of the frame this message was decoded from (0 if local)
         self.wire_nbytes: int = 0
+        #: time.monotonic() of the first received byte (chunked) or of the
+        #: receive-loop dequeue (whole frame); None for locally built messages
+        self.recv_monotonic: Optional[float] = None
 
     # reference API shape
     def add_params(self, key: str, value: Any) -> None:
@@ -50,14 +58,21 @@ class Message:
     add = add_params
 
     def get(self, key: str, default=None) -> Any:
-        if key not in self.msg_params and self._tensor_stream is not None:
+        if key not in self.msg_params and self._has_lazy_tensors():
             self._materialize_tensors()
+        return self.msg_params.get(key, default)
+
+    def get_control(self, key: str, default=None) -> Any:
+        """``get`` restricted to the JSON control section: NEVER triggers
+        tensor materialization, so a streaming consumer can read optional
+        control keys (delta flag, version) that may be absent without
+        collapsing the lazy frame it is about to fold."""
         return self.msg_params.get(key, default)
 
     def all_params(self) -> dict:
         """The full params dict; forces tensor materialization on a received
         message (use :meth:`get` for single keys — control keys stay lazy)."""
-        if self._tensor_stream is not None:
+        if self._has_lazy_tensors():
             self._materialize_tensors()
         return self.msg_params
 
@@ -110,27 +125,174 @@ class Message:
         msg.wire_nbytes = len(data)
         return msg
 
+    @classmethod
+    def from_stream(cls, control: dict, header: dict, leaves: list,
+                    wire_nbytes: int = 0) -> "Message":
+        """A message whose tensor section was already decoded incrementally
+        (chunked arrival): control params + per-leaf arrays in wire order.
+        Restoration into the params dict stays lazy, exactly like
+        :meth:`decode`, and :meth:`tensor_frame` serves streaming folds."""
+        msg = cls()
+        msg.msg_params = dict(control)
+        msg._tensor_leaves = (header, list(leaves))
+        msg.wire_nbytes = int(wire_nbytes)
+        return msg
+
     def tensor_stream(self):
         """``(wire_header, payload_offset, blob)`` while the tensor section
         is still undecoded (for chunk-by-chunk streaming consumers), else
         None.  Control params (JSON section) never trigger materialization."""
         return self._tensor_stream
 
+    def tensor_frame(self):
+        """``(wire_header, iterator of (index, spec, array))`` over the
+        still-unmaterialized tensor section — the one streaming-fold surface
+        covering both received forms (lazy blob and chunk-decoded leaves);
+        None once the tensors have been restored into the params dict."""
+        if self._tensor_stream is not None:
+            header, offset, blob = self._tensor_stream
+            return header, wire.iter_leaf_arrays(blob, header=header, offset=offset)
+        if self._tensor_leaves is not None:
+            header, leaves = self._tensor_leaves
+            specs = header["leaves"]
+            return header, ((i, specs[i], leaf) for i, leaf in enumerate(leaves))
+        return None
+
+    def _has_lazy_tensors(self) -> bool:
+        return self._tensor_stream is not None or self._tensor_leaves is not None
+
     def _materialize_tensors(self) -> None:
-        header, offset, blob = self._tensor_stream
-        self._tensor_stream = None
-        tensors = wire.decode_pytree(blob, header=header, offset=offset)
+        if self._tensor_stream is not None:
+            header, offset, blob = self._tensor_stream
+            self._tensor_stream = None
+            tensors = wire.decode_pytree(blob, header=header, offset=offset)
+        else:
+            header, leaves = self._tensor_leaves
+            self._tensor_leaves = None
+            tensors = wire.restore_skeleton(header["treedef"], leaves)
         if isinstance(tensors, dict):
             self.msg_params.update(tensors)
 
     def __repr__(self) -> str:
-        if self._tensor_stream is not None:
+        if self._has_lazy_tensors():
             self._materialize_tensors()
         keys = [k for k in self.msg_params if k not in (MSG_ARG_KEY_TYPE, MSG_ARG_KEY_SENDER, MSG_ARG_KEY_RECEIVER)]
         return (
             f"Message(type={self.get_type()}, {self.get_sender_id()}->"
             f"{self.get_receiver_id()}, params={keys})"
         )
+
+
+class MessageStreamDecoder:
+    """Incremental ``Message`` decoder for chunked arrival: feed bounded
+    byte chunks of one encoded message as they land; the control JSON is
+    parsed as soon as its bytes are in, then tensor leaves decode through a
+    :class:`~fedml_tpu.comm.wire.PytreeStreamDecoder` (consumed chunk bytes
+    released, so peak buffered memory is ~(largest leaf + chunk)).  Returns
+    the completed :class:`Message` from the final ``feed``."""
+
+    def __init__(self):
+        self._buf: Optional[bytearray] = bytearray()
+        self._control: Optional[dict] = None
+        self._decoder = wire.PytreeStreamDecoder(retain_leaves=True)
+        self._nbytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def feed(self, chunk) -> Optional["Message"]:
+        data = bytes(chunk) if isinstance(chunk, memoryview) else chunk
+        self._nbytes += len(data)
+        if self._control is None:
+            self._buf += data
+            if len(self._buf) < 4:
+                return None
+            clen = int.from_bytes(self._buf[:4], "little")
+            if len(self._buf) < 4 + clen:
+                return None
+            self._control = json.loads(bytes(self._buf[4: 4 + clen]).decode("utf-8"))
+            rest = bytes(self._buf[4 + clen:])
+            self._buf = None  # released: the wire decoder owns buffering now
+            if rest:
+                self._decoder.feed(rest)
+        else:
+            self._decoder.feed(data)
+        if not self._decoder.complete:
+            return None
+        return Message.from_stream(
+            self._control, self._decoder.header, self._decoder.leaves(),
+            wire_nbytes=self._nbytes,
+        )
+
+
+class ChunkAssembler:
+    """Per-peer reassembly of transport chunk frames into ``Message``s.
+
+    Streams are keyed ``(sender, stream_id)`` so chunks from N concurrent
+    uploads interleave freely; within a stream, out-of-order chunks wait in
+    a small reorder buffer and in-order chunks feed the stream's
+    :class:`MessageStreamDecoder` immediately — tensor leaves decode while
+    the rest of the upload is still in flight.  Streams idle longer than
+    ``stream_timeout_s`` are evicted (``sweep``) so a sender that dies
+    mid-upload cannot leak buffered chunks forever."""
+
+    def __init__(self, stream_timeout_s: float = 120.0):
+        self.stream_timeout_s = float(stream_timeout_s)
+        self._streams: dict[tuple, dict] = {}
+
+    def pending_streams(self) -> int:
+        return len(self._streams)
+
+    def feed(self, data) -> tuple:
+        """One chunk frame in; ``(message_or_None, error_reason_or_None,
+        sender_or_None)`` out.  A completed stream returns its Message with
+        ``recv_monotonic`` stamped at the stream's FIRST chunk (so fold-lag
+        measures first-byte-to-folded, the head-of-line quantity)."""
+        try:
+            sub, payload = wire.parse_chunk_frame(data)
+        except (ValueError, KeyError, TypeError):
+            return None, "chunk_corrupt", None
+        sender = int(sub["sender"])
+        key = (sender, str(sub["stream"]))
+        now = time.monotonic()
+        st = self._streams.get(key)
+        if st is None:
+            st = self._streams[key] = {
+                "dec": MessageStreamDecoder(), "next": 0, "pending": {},
+                "last": now, "first": now,
+            }
+        st["last"] = now
+        st["pending"][int(sub["seq"])] = bytes(payload)
+        try:
+            while st["next"] in st["pending"]:
+                msg = st["dec"].feed(st["pending"].pop(st["next"]))
+                st["next"] += 1
+                if msg is not None:
+                    del self._streams[key]
+                    msg.recv_monotonic = st["first"]
+                    return msg, None, sender
+        except (ValueError, KeyError):
+            # corrupt mid-stream: drop the whole stream, attribute the loss
+            del self._streams[key]
+            return None, "chunk_decode", sender
+        if st["next"] >= int(sub["chunks"]) and not st["pending"]:
+            # every declared chunk consumed yet the message never completed:
+            # bytes went missing in flight — fail NOW, not at the idle sweep
+            del self._streams[key]
+            return None, "chunk_incomplete", sender
+        return None, None, sender
+
+    def sweep(self) -> list:
+        """Evict streams idle past the timeout; returns ``[(sender,
+        stream_id), ...]`` so the receive loop can meter the drops."""
+        now = time.monotonic()
+        evicted = []
+        for key, st in list(self._streams.items()):
+            if now - st["last"] > self.stream_timeout_s:
+                del self._streams[key]
+                evicted.append(key)
+        return evicted
 
 
 def _is_arraylike(v) -> bool:

@@ -18,6 +18,7 @@ a JSON parser (``native/`` holds the C++ implementation).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import socket
@@ -25,6 +26,7 @@ import struct
 import threading
 from typing import Optional
 
+from . import wire
 from .base import BaseCommunicationManager, ObserverLoopMixin
 from .message import Message
 
@@ -61,11 +63,18 @@ class TCPCommManager(ObserverLoopMixin, BaseCommunicationManager):
     loopback)."""
 
     def __init__(self, host: str, port: int, rank: int,
-                 ip_config: Optional[dict] = None, base_port: int = 9690):
+                 ip_config: Optional[dict] = None, base_port: int = 9690,
+                 chunk_bytes: int = 0):
         self._init_observer_loop()
         self.rank = rank
         self.base_port = base_port
         self.ip_config = {int(k): v for k, v in (ip_config or {}).items()}
+        # extra.comm_chunk_bytes: messages above this bound ship as bounded
+        # chunk frames (wire.encode_chunk_frames) so N concurrent uploads
+        # interleave at the socket level; 0 = one frame per message,
+        # byte-identical to the legacy protocol
+        self.chunk_bytes = int(chunk_bytes or 0)
+        self._stream_seq = itertools.count()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -99,7 +108,14 @@ class TCPCommManager(ObserverLoopMixin, BaseCommunicationManager):
         host = self.ip_config.get(rid, "127.0.0.1")
         payload = msg.encode()
         with socket.create_connection((host, self.base_port + rid), timeout=30.0) as s:
-            send_frame(s, payload)
+            if self.chunk_bytes and len(payload) > self.chunk_bytes:
+                stream_id = f"{self.rank}.{next(self._stream_seq)}"
+                for frame in wire.encode_chunk_frames(
+                        payload, stream_id=stream_id, sender=self.rank,
+                        chunk_bytes=self.chunk_bytes):
+                    send_frame(s, frame)
+            else:
+                send_frame(s, payload)
 
     def stop_receive_message(self) -> None:
         super().stop_receive_message()

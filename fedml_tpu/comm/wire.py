@@ -48,6 +48,16 @@ WIRE_VERSION_V2 = 2
 #: large model streams as many bounded chunks instead of one giant blob
 CHUNK_BYTES_DEFAULT = 1 << 20
 
+#: transport chunk-frame magic (``extra.comm_chunk_bytes`` framing): a
+#: message larger than the configured bound ships as N bounded frames of
+#: ``MAGIC + <4-byte LE subheader len> + subheader JSON + chunk bytes`` so
+#: concurrent uploads interleave at the socket level instead of one slow
+#: 100MB frame head-of-line-blocking the receiver.  The magic can never
+#: collide with a legacy frame: a legacy payload starts with a 4-byte
+#: control-JSON length, and these 4 bytes decode to ~1.2 GB — far beyond
+#: any real control section.
+CHUNK_MAGIC = b"FMLCHNK1"
+
 #: elements per qsgd8 block (matches the (8, 128) f32 tile of
 #: ``ops/pallas/quantize.py``)
 QSGD8_BLOCK = 1024
@@ -279,6 +289,55 @@ def decode_pytree(data, header: Optional[dict] = None,
     return _restore_skeleton(header["treedef"], leaves)
 
 
+# ---------------------------------------------------------------------------
+# transport chunk frames (socket-level interleaving of concurrent uploads)
+# ---------------------------------------------------------------------------
+
+def is_chunk_frame(data) -> bool:
+    """True when ``data`` is a transport chunk frame (vs a legacy whole-
+    message payload)."""
+    mv = _as_bytes_view(data)
+    return len(mv) >= len(CHUNK_MAGIC) and bytes(mv[: len(CHUNK_MAGIC)]) == CHUNK_MAGIC
+
+
+def encode_chunk_frames(payload, *, stream_id: str, sender: int,
+                        chunk_bytes: int) -> Iterator[bytes]:
+    """Split one encoded message into bounded, self-describing chunk frames.
+
+    Each frame carries ``{"stream", "sender", "seq", "chunks", "total"}`` so
+    the receiver can reassemble N interleaved streams per peer (out-of-order
+    delivery tolerated — gRPC unary chunks are separate RPCs)."""
+    mv = _as_bytes_view(payload)
+    chunk_bytes = max(1, int(chunk_bytes))
+    total = len(mv)
+    n_chunks = max(1, -(-total // chunk_bytes))
+    for seq in range(n_chunks):
+        sub = json.dumps(
+            {"stream": str(stream_id), "sender": int(sender), "seq": seq,
+             "chunks": n_chunks, "total": total},
+            separators=(",", ":")).encode("utf-8")
+        chunk = mv[seq * chunk_bytes: (seq + 1) * chunk_bytes]
+        yield CHUNK_MAGIC + struct.pack("<I", len(sub)) + sub + bytes(chunk)
+
+
+def parse_chunk_frame(data) -> tuple:
+    """One chunk frame -> ``(subheader_dict, chunk_payload_view)``."""
+    mv = _as_bytes_view(data)
+    if not is_chunk_frame(mv):
+        raise ValueError("not a chunk frame (bad magic)")
+    off = len(CHUNK_MAGIC)
+    if len(mv) < off + 4:
+        raise ValueError("chunk frame truncated before subheader length")
+    (slen,) = struct.unpack_from("<I", mv, off)
+    if len(mv) < off + 4 + slen:
+        raise ValueError("chunk frame subheader truncated")
+    sub = json.loads(bytes(mv[off + 4: off + 4 + slen]).decode("utf-8"))
+    for field in ("stream", "sender", "seq", "chunks", "total"):
+        if field not in sub:
+            raise ValueError(f"chunk subheader missing {field!r}")
+    return sub, mv[off + 4 + slen:]
+
+
 class PytreeStreamDecoder:
     """Incremental frame decoder: ``feed()`` bounded chunks as they arrive;
     each call returns the leaves completed by that chunk as
@@ -336,6 +395,14 @@ class PytreeStreamDecoder:
         if self.complete and self._buf:
             raise ValueError(f"{len(self._buf)} trailing bytes after final leaf")
         return out
+
+    def leaves(self) -> list:
+        """The decoded leaves in wire order (requires ``retain_leaves``);
+        with ``header`` this is the zero-recompute input to
+        :meth:`~fedml_tpu.comm.message.Message.from_stream`."""
+        if not self._retain:
+            raise ValueError("decoder built with retain_leaves=False")
+        return self._leaves
 
     def result(self) -> Any:
         if not self.complete:

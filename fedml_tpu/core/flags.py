@@ -133,6 +133,11 @@ FLAGS: dict[str, FlagSpec] = _specs(
     FlagSpec("streaming_aggregation", "bool", False,
              "Fold arriving client updates into a running weighted sum even "
              "without a codec (peak buffered updates <= 2)."),
+    FlagSpec("comm_chunk_bytes", "int", 0,
+             "Split gRPC/TCP sends larger than this into bounded chunk "
+             "frames that interleave at the socket level (receivers "
+             "reassemble + decode incrementally per peer); 0 = one frame "
+             "per message, byte-identical to the unchunked protocol."),
     FlagSpec("grpc_base_port", "int", 8890, "gRPC backend rank-0 port."),
     FlagSpec("grpc_ip_config", "dict", None,
              "gRPC backend rank -> host mapping (unset = localhost)."),
@@ -146,6 +151,26 @@ FLAGS: dict[str, FlagSpec] = _specs(
     FlagSpec("object_store_url", "str", None,
              "HTTP object store for >8KB MQTT payloads (required with mqtt_host)."),
     # -- cross-silo / cross-device server ------------------------------------
+    FlagSpec("async_aggregation", "bool", False,
+             "Buffered-async (FedBuff-style) cross-silo server: clients "
+             "upload whenever local training finishes, arrivals fold into "
+             "the streaming accumulator with staleness-decayed weights, and "
+             "a virtual round closes every async_buffer_k arrivals (unset = "
+             "the synchronous round server, bit-identical to before the "
+             "flag existed)."),
+    FlagSpec("async_buffer_k", "int", 8,
+             "Arrivals folded per virtual round on the buffered-async "
+             "server (FedBuff's K)."),
+    FlagSpec("async_staleness_exponent", "float", 0.5,
+             "Polynomial staleness decay s(tau) = (1 + tau)^-alpha applied "
+             "to each async arrival's weight; 0 disables the decay."),
+    FlagSpec("async_concurrency", "int", None,
+             "Clients kept training concurrently by the async server; "
+             "derived: client_num_per_round."),
+    FlagSpec("async_redispatch_timeout_s", "float", 30.0,
+             "Async dispatch deadline: an upload not back within this many "
+             "seconds counts a health breach and the work is re-issued to "
+             "another client; 0 disables the watchdog."),
     FlagSpec("straggler_timeout_s", "float", 0.0,
              "Bounded-wait straggler deadline per round; 0 = wait forever."),
     FlagSpec("straggler_quorum_frac", "float", 0.5,

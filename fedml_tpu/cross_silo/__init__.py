@@ -42,6 +42,14 @@ def build_aggregator(cfg, dataset, model, trust=None) -> FedMLAggregator:
 
 def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None) -> FedMLServerManager:
     aggregator = build_aggregator(cfg, dataset, model, trust=trust)
+    if cfg_extra(cfg, "async_aggregation"):
+        # buffered-async (FedBuff-style) server: clients upload whenever
+        # ready, arrivals fold with staleness-decayed weights, a virtual
+        # round closes every async_buffer_k arrivals.  Flag unset -> the
+        # synchronous manager, bit-identical to before the flag existed.
+        from .async_server import AsyncFedMLServerManager
+
+        return AsyncFedMLServerManager(cfg, aggregator, backend=backend)
     return FedMLServerManager(cfg, aggregator, backend=backend)
 
 
@@ -169,6 +177,12 @@ def run_in_process_group(cfg, dataset, model, backend: str = "INPROC", timeout: 
     server = build_server(cfg, dataset, model, backend=backend)
     try:
         history = server.run_until_done(timeout=timeout)
+        # graceful drain: a buffered-async client may still be mid-train on
+        # its daemon thread when the server finishes (sync clients are idle
+        # here and their done is already set) — give each a bounded window
+        # to process FINISH, so interpreter exit never lands mid-XLA-call
+        for c in clients:
+            c.done.wait(5.0)
     finally:
         for c in clients:
             c.finish()

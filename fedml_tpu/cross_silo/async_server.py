@@ -1,0 +1,378 @@
+"""Buffered-async cross-silo server — FedBuff-style staleness-decayed folds.
+
+The synchronous server (``cross_silo/server.py``) closes a round when every
+selected client has replied, so round wall time is the SLOWEST cohort
+member's wall time; the PR-4 streaming accumulator overlapped aggregation
+with the network tail but kept the barrier.  Production FL traffic is not
+round-synchronous (ROADMAP north star; the communication-perspective survey
+2405.20431 and the cross-silo backend study 2604.10859 both name
+buffered-async aggregation as the straggler-bound -> throughput-bound
+lever), so this manager removes the barrier:
+
+- **Clients train continuously.**  Every upload is answered with a fresh
+  dispatch of the current global model; a client never waits for a round
+  boundary.  ``async_concurrency`` clients (default ``client_num_per_round``)
+  are kept in flight; a deterministic round-robin cursor rotates work
+  through the rest of the fleet.
+- **Every arrival folds immediately** into the streaming accumulator
+  (``FedMLAggregator.fold``, the associative-fold protocol) with a
+  staleness-decayed weight ``w * s(tau)`` where ``tau = server_version -
+  client_version`` (the version the dispatch carried, echoed back in the
+  reply's round index) and ``s(tau) = (1 + tau) ** -async_staleness_exponent``
+  — FedBuff/FedAsync's polynomial decay.  ``s(0)`` is exactly ``1.0``, so an
+  all-fresh run folds bitwise like the synchronous streaming path.
+- **A virtual round closes every ``async_buffer_k`` arrivals** (FedBuff's
+  K): finalize the accumulator, run the algorithm's server step, bump
+  ``server_version``, eval on the configured cadence.
+- **The health ledger gates admission.**  Behind
+  ``extra.health_aware_selection`` a degraded sender's upload is still
+  folded — throttled, never dropped — but its next assignment waits for the
+  virtual-round boundary, so a flapping silo cannot monopolize dispatch
+  slots while healthy clients starve.
+- **A redispatch watchdog bounds lost work**: a dispatch not answered
+  within ``async_redispatch_timeout_s`` records a deadline breach against
+  that client and re-issues the slot to another one, so injected drops cost
+  one timeout, not a stalled buffer.
+
+Gated entirely on ``extra.async_aggregation``: unset, ``build_server``
+returns the synchronous manager and this module is never imported — wire
+bytes and aggregation results stay bit-identical to the flag-free build.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..comm.message import Message
+from ..core.flags import cfg_extra
+from ..obs import registry as obsreg, trace as obstrace
+from ..obs.metrics import MetricsLogger
+from . import message_define as md
+from .server import (
+    AGGREGATE_TIME, BUFFERED_PEAK, CLIENT_ROUND_TRIP, FedMLAggregator,
+    FedMLServerManager,
+)
+
+log = logging.getLogger("fedml_tpu.cross_silo.async_server")
+
+ARRIVALS = obsreg.REGISTRY.counter(
+    "fedml_async_arrivals_total",
+    "Uploads received by the buffered-async server, by admission path "
+    "(folded = streaming accumulator, buffered = exact-mode dense buffer).",
+    labels=("path",),
+)
+STALENESS = obsreg.REGISTRY.histogram(
+    "fedml_async_staleness_versions",
+    "Version lag tau of each arrival (server_version minus the version the "
+    "client trained against).",
+    buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
+)
+FOLD_LAG = obsreg.REGISTRY.histogram(
+    "fedml_async_fold_lag_seconds",
+    "First received byte of an upload to its fold into the accumulator — "
+    "the head-of-line-blocking quantity chunked transport bounds.",
+)
+VIRTUAL_ROUNDS = obsreg.REGISTRY.counter(
+    "fedml_async_virtual_rounds_total",
+    "Virtual rounds closed (one per async_buffer_k folded arrivals).",
+)
+REDISPATCHES = obsreg.REGISTRY.counter(
+    "fedml_async_redispatches_total",
+    "Work dispatched after round 0, by trigger (upload = fold-and-refill, "
+    "timeout = dispatch deadline expired, round = throttled client released "
+    "at the virtual-round boundary).",
+    labels=("reason",),
+)
+THROTTLED = obsreg.REGISTRY.counter(
+    "fedml_async_throttled_total",
+    "Uploads whose sender was health-throttled: folded, but the next "
+    "dispatch deferred to the virtual-round boundary.",
+)
+
+
+def staleness_scale(staleness: int, exponent: float) -> float:
+    """Polynomial staleness decay ``s(tau) = (1 + tau) ** -exponent``
+    (FedBuff).  ``s(0)`` returns the literal ``1.0`` so a fresh update's
+    fold is bitwise identical to the synchronous streaming fold; a zero
+    exponent disables the decay entirely."""
+    if staleness <= 0 or exponent == 0.0:
+        return 1.0
+    return float((1.0 + float(staleness)) ** (-float(exponent)))
+
+
+class AsyncFedMLServerManager(FedMLServerManager):
+    """Buffered-async server manager (see module docstring).
+
+    Thread model: the receive loop (folds + re-dispatch), the watchdog
+    timer (deadline redispatch), and the caller's thread all touch the fold
+    buffer and dispatch ledger — every access runs under ``_agg_lock``.
+    """
+
+    def __init__(self, cfg, aggregator: FedMLAggregator, backend: Optional[str] = None,
+                 logger: Optional[MetricsLogger] = None):
+        super().__init__(cfg, aggregator, backend=backend, logger=logger)
+        # re-bound (construction-time, before any receive/timer thread
+        # exists) so this class's own body declares the guarded state for
+        # the GL004 lock-discipline scan
+        self._agg_lock = threading.Lock()
+        self.server_version = 0
+        self.buffer_k = max(1, int(cfg_extra(cfg, "async_buffer_k")))
+        self.staleness_exponent = float(cfg_extra(cfg, "async_staleness_exponent"))
+        self.concurrency = max(1, int(
+            cfg_extra(cfg, "async_concurrency", None) or self.per_round))
+        self.redispatch_timeout = float(cfg_extra(cfg, "async_redispatch_timeout_s"))
+        #: cid -> (dispatched_version, monotonic send time) for every
+        #: in-flight assignment — the watchdog's scan set
+        self._outstanding: dict[int, tuple[int, float]] = {}
+        #: health-throttled senders awaiting the next virtual-round boundary
+        self._throttled: set[int] = set()
+        self._ever_dispatched: set[int] = set()
+        self._rr_cursor = 0
+        self._arrivals_in_round = 0
+        self._round_staleness: list[int] = []
+        self._finished = False
+        self._watchdog: Optional[threading.Timer] = None
+        # soak/bench accounting (all guarded by _agg_lock)
+        self.total_arrivals = 0
+        self.timeout_redispatches = 0
+        self.staleness_sum = 0
+        self.staleness_max = 0
+        self.first_dispatch_monotonic: Optional[float] = None
+        self.finished_monotonic: Optional[float] = None
+
+    # -- protocol ------------------------------------------------------------
+    def send_init_msg(self) -> None:
+        """All clients online: warm the program store, open the version-0
+        span, dispatch the initial concurrency wave, arm the watchdog."""
+        with self._agg_lock:
+            if self._init_sent:
+                return
+            self._init_sent = True
+            warm = self.aggregator.warm_programs()
+            if warm is not None:
+                log.info("async server: program store warm %s", warm)
+            self._round_span = obstrace.Span(
+                "round", round_idx=0, async_mode=True)
+            self.first_dispatch_monotonic = time.monotonic()
+            self._refill()
+            self._arm_watchdog()
+
+    def handle_message_receive_model(self, msg: Message) -> None:
+        now = time.monotonic()
+        with self._agg_lock:
+            if self._finished:
+                return  # post-finish stragglers: the run is already closed
+            sender = int(msg.get_sender_id())
+            # control-only reads: a plain get() of a missing key would
+            # materialize the tensor section and defeat the streaming fold
+            client_version = int(msg.get_control(md.MSG_ARG_KEY_ROUND_INDEX,
+                                                 self.server_version))
+            staleness = max(0, self.server_version - client_version)
+            sent_at = self._sent_at.pop(sender, None)
+            if sent_at is not None:
+                rtt = time.perf_counter() - sent_at
+                CLIENT_ROUND_TRIP.observe(rtt, client=str(sender))
+                self.health.observe_rtt(sender, rtt)
+                self._round_rtts[sender] = rtt
+            self._outstanding.pop(sender, None)
+            n_samples = float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES))
+            is_delta = bool(msg.get_control(md.MSG_ARG_KEY_MODEL_IS_DELTA, False))
+            self._round_payload_bytes += int(getattr(msg, "wire_nbytes", 0) or 0)
+            scale = staleness_scale(staleness, self.staleness_exponent)
+            if self.aggregator.fold(sender, msg, n_samples, is_delta, scale=scale):
+                ARRIVALS.inc(path="folded")
+            else:
+                # exact-mode fallback (custom aggregate / LoRA / trust): the
+                # decay rides the weight, so a weight-sensitive aggregate
+                # still sees the staleness-discounted contribution
+                params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
+                self.aggregator.add_local_trained_result(
+                    sender, params, n_samples * scale, is_delta=is_delta)
+                ARRIVALS.inc(path="buffered")
+            self.total_arrivals += 1
+            self._arrivals_in_round += 1
+            self._round_staleness.append(int(staleness))
+            self.staleness_sum += int(staleness)
+            self.staleness_max = max(self.staleness_max, int(staleness))
+            STALENESS.observe(float(staleness))
+            if msg.recv_monotonic is not None:
+                FOLD_LAG.observe(max(0.0, now - msg.recv_monotonic))
+            # admission gate: a degraded sender's update was folded, but its
+            # next assignment waits for the virtual-round boundary
+            throttled = (self.health_aware
+                         and self.health.score(sender) < self.health.degraded_threshold)
+            if throttled:
+                self._throttled.add(sender)
+                THROTTLED.inc()
+            if self._arrivals_in_round >= self.buffer_k:
+                self._close_virtual_round()
+            if not throttled and not self._finished:
+                self._dispatch(self._next_client(fallback=sender))
+                REDISPATCHES.inc(reason="upload")
+
+    def _close_virtual_round(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: receive handler at the K-arrival boundary)
+        """Finalize the accumulator, step the server, bump the version."""
+        arrivals = self._arrivals_in_round
+        with obstrace.traced("aggregate", parent=self._round_span,
+                             round_idx=self.server_version,
+                             arrivals=arrivals) as agg_span:
+            self.aggregator.aggregate(self.server_version)
+        AGGREGATE_TIME.observe(agg_span.duration_s)
+        BUFFERED_PEAK.set(self.aggregator.peak_buffered_updates)
+        VIRTUAL_ROUNDS.inc()
+        stal = self._round_staleness
+        metrics = {
+            "round": self.server_version,
+            "arrivals": arrivals,
+            "staleness_mean": round(float(np.mean(stal)), 4) if stal else 0.0,
+            "staleness_max": int(max(stal)) if stal else 0,
+        }
+        eval_span = None
+        if self.cfg.frequency_of_the_test and (
+            (self.server_version + 1) % self.cfg.frequency_of_the_test == 0
+            or self.server_version == self.comm_round - 1
+        ):
+            with obstrace.traced("eval", parent=self._round_span,
+                                 round_idx=self.server_version) as eval_span:
+                metrics.update(self.aggregator.test_on_server())
+        self._close_round_trace(agg_span, eval_span)
+        self.logger.log(metrics)
+        self.history.append(metrics)
+        self.server_version += 1
+        self.round_idx = self.server_version  # keep base-class reporting honest
+        self._arrivals_in_round = 0
+        self._round_staleness = []
+        if self.server_version >= self.comm_round:
+            self._finished = True
+            self.finished_monotonic = time.monotonic()
+            self.send_finish()
+            return
+        self._round_span = obstrace.Span(
+            "round", round_idx=self.server_version, async_mode=True)
+        # throttled clients re-enter on the fresh version (deprioritized,
+        # never dropped)
+        for cid in sorted(self._throttled):
+            self._dispatch(cid)
+            REDISPATCHES.inc(reason="round")
+        self._throttled.clear()
+        self._refill()
+
+    # -- dispatch ------------------------------------------------------------
+    def _dispatch(self, cid: int) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: every dispatch site is a lock-held handler/timer body)
+        """Send the current global (stamped with ``server_version``) to one
+        client and track the in-flight assignment."""
+        first = cid not in self._ever_dispatched
+        self._ever_dispatched.add(cid)
+        msg = Message(
+            md.MSG_TYPE_S2C_INIT_CONFIG if first else md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+            0, cid)
+        msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, self.aggregator._host_global())
+        msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
+        msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.server_version)
+        obstrace.inject(msg, self._round_span)
+        try:
+            self._sent_at[cid] = time.perf_counter()
+            self._outstanding[cid] = (self.server_version, time.monotonic())
+            self.send_message(msg)
+        except Exception:
+            # one unreachable peer must not kill the receive/timer thread;
+            # the watchdog refills the slot
+            self._outstanding.pop(cid, None)
+            self._sent_at.pop(cid, None)
+            self.health.record_comm_failure(cid)
+            log.warning("async dispatch to client %d failed; slot refills", cid,
+                        exc_info=True)
+
+    def _next_client(self, fallback: int) -> int:  # graftlint: disable=GL004(caller holds _agg_lock)
+        """Deterministic round-robin over the candidate pool, skipping
+        in-flight and throttled clients; degraded ranks (behind
+        health_aware_selection) are used only when nothing healthy is idle."""
+        pool = self._candidate_ids()
+        n = len(pool)
+        backup = None
+        for _ in range(n):
+            cid = pool[self._rr_cursor % n]
+            self._rr_cursor += 1
+            if cid in self._outstanding or cid in self._throttled:
+                continue
+            if (self.health_aware
+                    and self.health.score(cid) < self.health.degraded_threshold):
+                backup = cid if backup is None else backup
+                continue
+            return cid
+        return backup if backup is not None else fallback
+
+    def _refill(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock)
+        """Top the in-flight set back up to ``concurrency``."""
+        need = self.concurrency - len(self._outstanding)
+        for _ in range(max(0, need)):
+            cid = self._next_client(fallback=-1)
+            if cid < 0 or cid in self._outstanding:
+                return  # pool exhausted (everyone in flight or throttled)
+            self._dispatch(cid)
+
+    # -- watchdog ------------------------------------------------------------
+    def _arm_watchdog(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock)
+        if self.redispatch_timeout <= 0:
+            return
+        t = threading.Timer(max(0.05, min(1.0, self.redispatch_timeout / 4)),
+                            self._on_watchdog)
+        t.daemon = True
+        self._watchdog = t
+        t.start()
+
+    def _on_watchdog(self) -> None:
+        with self._agg_lock:
+            if self._finished or self.done.is_set():
+                return
+            now = time.monotonic()
+            overdue = [cid for cid, (_v, t0) in self._outstanding.items()
+                       if now - t0 > self.redispatch_timeout]
+            for cid in overdue:
+                self._outstanding.pop(cid, None)
+                self._sent_at.pop(cid, None)
+                # the breach is remembered: behind health_aware_selection the
+                # repeat offender is throttled out of the hot rotation
+                self.health.record_deadline_breach(cid)
+                self.timeout_redispatches += 1
+                REDISPATCHES.inc(reason="timeout")
+                self._dispatch(self._next_client(fallback=cid))
+            self._refill()
+            self._arm_watchdog()
+
+    # -- teardown ------------------------------------------------------------
+    def finish(self) -> None:  # graftlint: disable=GL004(single boolean latch + timer handle; runs under _agg_lock when reached via send_finish, bare on the timeout path — both orders are safe because _finished only ever flips False->True)
+        self._finished = True
+        w = self._watchdog
+        self._watchdog = None
+        if w is not None:
+            w.cancel()
+        super().finish()
+
+    # -- accounting (soak harness / bench) ------------------------------------
+    def async_summary(self) -> dict:
+        """Run-level accounting for the soak harness and BENCH json."""
+        with self._agg_lock:
+            wall = None
+            if self.first_dispatch_monotonic is not None:
+                end = self.finished_monotonic or time.monotonic()
+                wall = max(1e-9, end - self.first_dispatch_monotonic)
+            return {
+                "server_version": self.server_version,
+                "arrivals": self.total_arrivals,
+                "buffer_k": self.buffer_k,
+                "concurrency": self.concurrency,
+                "staleness_mean": round(self.staleness_sum / max(1, self.total_arrivals), 4),
+                "staleness_max": self.staleness_max,
+                "timeout_redispatches": self.timeout_redispatches,
+                "outstanding_at_end": len(self._outstanding),
+                "throttled_at_end": len(self._throttled),
+                "wall_s": round(wall, 4) if wall is not None else None,
+                "versions_per_sec": (round(self.server_version / wall, 4)
+                                     if wall else None),
+            }

@@ -1,0 +1,322 @@
+"""Buffered-async soak harness: ~10k simulated clients vs ONE real server.
+
+The thing under test is the :class:`~fedml_tpu.cross_silo.async_server.
+AsyncFedMLServerManager` — real aggregator, real fold/decay math, real
+dispatch ledger, real watchdog, real wire bytes (the in-proc router encodes
+every message).  The CLIENT side is simulated: 10k clients as scheduled
+events on a latency heap (lognormal skew — a long straggler tail), not 10k
+threads, so the harness scales to fleet-sized populations on one box.
+Injected upload drops give the redispatch watchdog real work; the summary
+accounts for every one (``unaccounted_drops`` must come back 0: a drop
+either timed out and was re-issued, or its slot is still tracked
+in-flight — nothing silently vanishes).
+
+Shared by ``scripts/soak_async.py`` (CLI), the ``bench.py`` ``async``
+section (floor-guarded versions/s), and the ``__graft_entry__``
+``async_soak`` dryrun stage (small population, same assertions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _percentile_from_hist(hist, q: float, base_counts: Optional[list] = None) -> Optional[float]:
+    """Approximate quantile from a registry histogram family (upper bucket
+    bound of the bucket where the cumulative count crosses ``q``), optionally
+    against a pre-run baseline so in-process reruns measure only themselves."""
+    snap = hist._snapshot()
+    if not snap["samples"]:
+        return None
+    counts = list(snap["samples"][0]["counts"])
+    if base_counts:
+        counts = [c - (base_counts[i] if i < len(base_counts) else 0)
+                  for i, c in enumerate(counts)]
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for bound, n in zip(snap["buckets"], counts):
+        cum += n
+        if cum >= target:
+            return float(bound)
+    return float(snap["buckets"][-1])
+
+
+def _hist_counts(hist) -> list:
+    snap = hist._snapshot()
+    return list(snap["samples"][0]["counts"]) if snap["samples"] else []
+
+
+class _TaggedQueue:
+    """Queue-shaped proxy: every ``put`` lands in the shared fan-in queue
+    tagged with the simulated client's rank."""
+
+    __slots__ = ("rid", "shared")
+
+    def __init__(self, rid: int, shared: "queue.Queue"):
+        self.rid = rid
+        self.shared = shared
+
+    def put(self, item) -> None:
+        self.shared.put((self.rid, item))
+
+
+class _FanInQueues(dict):
+    """``InProcRouter.queues`` replacement: rank 0 keeps the server's real
+    inbox; every other rank fans into one shared queue the simulated-client
+    workers drain — 10k clients without 10k queues or threads."""
+
+    def __init__(self, shared: "queue.Queue", server_inbox: "queue.Queue"):
+        super().__init__()
+        self[0] = server_inbox
+        self._shared = shared
+
+    def __missing__(self, rid: int):
+        proxy = _TaggedQueue(rid, self._shared)
+        self[rid] = proxy
+        return proxy
+
+
+class _SimulatedFleet:
+    """Event-scheduled client population.
+
+    Worker threads drain the fan-in queue: status checks are answered
+    immediately; model dispatches either get DROPPED (seeded per-event
+    coin — the injected failure) or scheduled on the latency heap.  One
+    scheduler thread pops due replies and routes them (the router encodes,
+    so replies pay the real wire cost)."""
+
+    def __init__(self, router, md, template_params, *, drop_prob: float,
+                 latency_mean_s: float, latency_sigma: float, seed: int,
+                 workers: int = 4):
+        self.router = router
+        self.md = md
+        self.template = template_params
+        self.drop_prob = float(drop_prob)
+        # lognormal(mu, sigma) with mean latency_mean_s: heavy right tail,
+        # the realistic straggler skew
+        self.mu = float(np.log(max(latency_mean_s, 1e-6)) - 0.5 * latency_sigma ** 2)
+        self.sigma = float(latency_sigma)
+        self.seed = int(seed)
+        self.drops_injected = 0
+        self.replies_sent = 0
+        self._nonce = 0
+        self._lock = threading.Lock()
+        self._heap: list = []
+        self._cond = threading.Condition(self._lock)
+        self._stop = False
+        self._threads: list[threading.Thread] = []
+        self._workers = workers
+
+    def start(self, shared: "queue.Queue") -> None:
+        for i in range(self._workers):
+            t = threading.Thread(target=self._worker, args=(shared,),
+                                 name=f"soak-client-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._scheduler, name="soak-sched", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self, shared: "queue.Queue") -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        for _ in range(self._workers):
+            shared.put(None)  # sentinel per worker
+        for t in self._threads:
+            t.join(timeout=10.0)
+
+    # -- event handling -------------------------------------------------------
+    def _worker(self, shared: "queue.Queue") -> None:
+        from ..comm.message import Message
+
+        md = self.md
+        while True:
+            item = shared.get()
+            if item is None:
+                return
+            rid, data = item
+            try:
+                msg = Message.decode(data)  # control only: tensors stay lazy
+            except Exception:
+                continue
+            mtype = msg.get_type()
+            if mtype == md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS:
+                reply = Message(md.MSG_TYPE_C2S_CLIENT_STATUS, rid, 0)
+                reply.add_params(md.MSG_ARG_KEY_CLIENT_STATUS, md.CLIENT_STATUS_ONLINE)
+                reply.add_params(md.MSG_ARG_KEY_CLIENT_OS, md.CLIENT_OS_PYTHON)
+                self.router.route(reply)
+            elif mtype in (md.MSG_TYPE_S2C_INIT_CONFIG,
+                           md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT):
+                version = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX, 0))
+                with self._lock:
+                    self._nonce += 1
+                    nonce = self._nonce
+                rng = np.random.default_rng([self.seed, rid, nonce])
+                if rng.random() < self.drop_prob:
+                    with self._lock:
+                        self.drops_injected += 1
+                    continue  # the upload is lost; the watchdog must recover
+                latency = float(rng.lognormal(self.mu, self.sigma))
+                with self._cond:
+                    heapq.heappush(self._heap,
+                                   (time.monotonic() + latency, nonce, rid, version))
+                    self._cond.notify()
+            # FINISH needs no ack in the soak
+
+    def _scheduler(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and (
+                        not self._heap or self._heap[0][0] > time.monotonic()):
+                    wait = (self._heap[0][0] - time.monotonic()) if self._heap else 0.2
+                    self._cond.wait(timeout=max(0.001, min(wait, 0.2)))
+                if self._stop:
+                    return
+                _due, nonce, rid, version = heapq.heappop(self._heap)
+            self._send_reply(rid, version, nonce)
+
+    def _send_reply(self, rid: int, version: int, nonce: int) -> None:
+        import jax
+
+        from ..comm.message import Message
+
+        md = self.md
+        # a cheap, deterministic "trained" model: the template scaled per
+        # (client, nonce) — non-degenerate folds without any jax compute
+        f = 1.0 + 1e-3 * ((rid * 31 + nonce) % 97) / 97.0
+        params = jax.tree_util.tree_map(
+            lambda a: (a * f).astype(a.dtype) if np.asarray(a).dtype.kind == "f" else a,
+            self.template)
+        reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, rid, 0)
+        reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+        reply.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, float(16 + (rid % 7) * 8))
+        reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, version)
+        try:
+            self.router.route(reply)
+        except Exception:
+            return
+        with self._lock:
+            self.replies_sent += 1
+
+
+def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64,
+             versions: int = 20, staleness_exponent: float = 0.5,
+             drop_prob: float = 0.02, latency_mean_s: float = 0.005,
+             latency_sigma: float = 1.0, redispatch_timeout_s: float = 2.0,
+             seed: int = 0, workers: int = 4, timeout_s: float = 600.0) -> dict:
+    """Drive one buffered-async server to ``versions`` virtual rounds under
+    ``n_clients`` simulated clients; returns the accounting dict (versions/s,
+    staleness stats, fold-lag p50/p95, peak buffered updates, drop/retry
+    accounting)."""
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+
+    from ..comm.inproc import InProcRouter
+    from ..data import loader
+    from ..models import model_hub
+    from . import build_server, message_define as md
+    from .async_server import FOLD_LAG, STALENESS
+
+    run_id = f"soak_async_{seed}_{n_clients}_{versions}"
+    cfg = Config(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=n_clients, client_num_per_round=concurrency,
+        comm_round=versions, epochs=1, batch_size=16, learning_rate=0.1,
+        partition_method="homo", synthetic_train_size=512,
+        synthetic_test_size=64, frequency_of_the_test=0,
+        compute_dtype="float32", metrics_jsonl_path="", run_id=run_id,
+        extra={
+            "async_aggregation": True,
+            "async_buffer_k": buffer_k,
+            "async_staleness_exponent": staleness_exponent,
+            "async_concurrency": concurrency,
+            "async_redispatch_timeout_s": redispatch_timeout_s,
+        },
+    )
+    fedml_tpu.init(cfg)
+    # the server only needs the dataset for its eval arrays + sample batch;
+    # load it with a small client count so the partitioner never has to
+    # split a tiny synthetic set 10000 ways
+    ds_cfg = dataclasses.replace(cfg, client_num_in_total=8, client_num_per_round=8)
+    ds = loader.load(ds_cfg)
+    model = model_hub.create(ds_cfg, ds.class_num)
+
+    InProcRouter.reset(run_id)
+    server = build_server(cfg, ds, model, backend="INPROC")
+    router = InProcRouter.get(run_id)
+    shared: queue.Queue = queue.Queue()
+    # swap in the fan-in fabric AFTER the server bound its rank-0 inbox
+    router.queues = _FanInQueues(shared, router.queues[0])
+
+    template = jax.device_get(server.aggregator.global_vars)
+    fleet = _SimulatedFleet(
+        router, md, template, drop_prob=drop_prob,
+        latency_mean_s=latency_mean_s, latency_sigma=latency_sigma,
+        seed=seed, workers=workers)
+
+    fold_lag_base = _hist_counts(FOLD_LAG)
+    stal_base = _hist_counts(STALENESS)
+    fleet.start(shared)
+    t0 = time.monotonic()
+    server.run_in_thread()
+    server.start()
+    completed = server.done.wait(timeout_s)
+    wall_total = time.monotonic() - t0
+    summary = server.async_summary()
+    peak = int(server.aggregator.peak_buffered_updates)
+    server.finish()
+    fleet.stop(shared)
+    InProcRouter.reset(run_id)
+    if not completed:
+        raise RuntimeError(
+            f"async soak did not reach {versions} versions in {timeout_s}s: "
+            f"{summary}, drops_injected={fleet.drops_injected}, "
+            f"replies_sent={fleet.replies_sent}")
+
+    drops = fleet.drops_injected
+    # every injected drop must be accounted: recovered by a watchdog
+    # redispatch, or its slot still tracked in-flight at finish — anything
+    # else means the dispatch ledger silently lost work
+    unaccounted = max(0, drops - summary["timeout_redispatches"]
+                      - summary["outstanding_at_end"])
+    stal_counts = [c - (stal_base[i] if i < len(stal_base) else 0)
+                   for i, c in enumerate(_hist_counts(STALENESS))]
+    return {
+        "clients": n_clients,
+        "concurrency": summary["concurrency"],
+        "buffer_k": summary["buffer_k"],
+        "versions": summary["server_version"],
+        "arrivals": summary["arrivals"],
+        "wall_s": summary["wall_s"],
+        "wall_total_s": round(wall_total, 4),
+        "versions_per_sec": summary["versions_per_sec"],
+        "arrivals_per_sec": (round(summary["arrivals"] / summary["wall_s"], 2)
+                             if summary["wall_s"] else None),
+        "staleness_mean": summary["staleness_mean"],
+        "staleness_max": summary["staleness_max"],
+        "staleness_hist_counts": stal_counts,
+        "fold_lag_p50_s": _percentile_from_hist(FOLD_LAG, 0.50, fold_lag_base),
+        "fold_lag_p95_s": _percentile_from_hist(FOLD_LAG, 0.95, fold_lag_base),
+        "peak_buffered_updates": peak,
+        "drops_injected": drops,
+        "replies_sent": fleet.replies_sent,
+        "timeout_redispatches": summary["timeout_redispatches"],
+        "outstanding_at_end": summary["outstanding_at_end"],
+        "throttled_at_end": summary["throttled_at_end"],
+        "unaccounted_drops": unaccounted,
+        "comm_pressure": {"drops": server.health.comm_drops,
+                          "retries": server.health.comm_retries},
+    }

@@ -97,9 +97,20 @@ class FedMLTrainer:
         spe = max(1, math.ceil(cap / cfg.batch_size))
         self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
         self.dp_active = False
-        self._train = jax.jit(make_local_train_fn(
+        self._train_fn = make_local_train_fn(
             model, self.hp, batch_constraint=self._batch_constraint(cfg)
-        ))
+        )
+        self._train = jax.jit(self._train_fn)
+        # client-side AOT export (extra.aot_programs): a restarted silo
+        # deserializes its local-train program instead of re-tracing the
+        # scanned local-SGD loop (the server side has been stored since PR 7;
+        # this closes the carried client-side follow-on).  Bound lazily at
+        # the first train() call, where the real argument shapes exist.
+        from ..core import aot as aotlib
+
+        self._aot = aotlib.store_from_config(cfg)
+        self._aot_cfg_sig = aotlib.config_signature(cfg) if self._aot is not None else None
+        self._aot_bound = False
 
     def _batch_constraint(self, cfg):
         """Minibatch sharding constraint for this silo's device set; the
@@ -128,6 +139,19 @@ class FedMLTrainer:
         # cross-silo and simulation runs share sampling/dropout streams
         key = rng.client_key(rng.round_key(seed_key, round_idx), client_idx)
         variables = jax.tree_util.tree_map(jnp.asarray, global_vars)
+        if self._aot is not None and not self._aot_bound:
+            self._aot_bound = True
+            from ..core import aot as aotlib
+
+            args = (variables, self.x, self.y, self.count, key, None)
+            self._train = self._aot.cached_jit(
+                self._train_fn, args,
+                key=aotlib.program_key(
+                    "cross_silo.client_train",
+                    trees={"args": args}, hparams=self.hp,
+                    config=self._aot_cfg_sig,
+                    extra={"dp_active": self.dp_active}),
+            )
         with _DP_TRAIN_LOCK if self.dp_active else contextlib.nullcontext():
             new_vars, metrics = self._train(variables, self.x, self.y, self.count, key, None)
             new_vars = jax.device_get(new_vars)

@@ -36,7 +36,6 @@ from ..comm.message import Message
 from ..core import aot as aotlib, pytree as pt, rng
 from ..core.flags import cfg_extra
 from ..data.dataset import pad_eval_set
-from ..fl.algorithm import FedAlgorithm
 from ..fl.local_sgd import make_eval_fn
 from ..obs import registry as obsreg, trace as obstrace
 from ..obs.metrics import MetricsLogger
@@ -126,28 +125,32 @@ class FedMLAggregator:
         # instead of re-tracing it; flag unset -> the exact old jit
         eval_fn = make_eval_fn(model, self.hp, batch_size=min(256, max(32, cfg.test_batch_size)))
         self._aot = aotlib.store_from_config(cfg)
+        self._program_items: list = []
         if self._aot is not None:
+            eval_key = aotlib.program_key(
+                "cross_silo.eval",
+                trees={"args": (self.global_vars, *self._test)},
+                hparams=self.hp,
+                config=aotlib.config_signature(cfg))
             self._eval_fn = self._aot.cached_jit(
-                eval_fn, (self.global_vars, *self._test),
-                key=aotlib.program_key(
-                    "cross_silo.eval",
-                    trees={"args": (self.global_vars, *self._test)},
-                    hparams=self.hp,
-                    config=aotlib.config_signature(cfg)),
-            )
+                eval_fn, (self.global_vars, *self._test), key=eval_key)
+            self._program_items.append((eval_key, lambda: aotlib.export_program(
+                jax.jit(eval_fn), (self.global_vars, *self._test))))
         else:
             self._eval_fn = jax.jit(eval_fn)
         # streaming aggregation: fold each arriving update into a running
         # weighted sum as it lands (overlapping aggregation with the network
         # tail; peak host memory ~2x model instead of N x model).  Engaged
-        # only when compression / extra.streaming_aggregation asks for it AND
-        # the algorithm uses the stock weighted-mean aggregate AND no trust
-        # pipeline needs the stacked client models — otherwise the exact
-        # buffer-all path below stays reference-bit-exact.
+        # only when compression / extra.streaming_aggregation / the
+        # buffered-async server asks for it AND the algorithm declares its
+        # aggregate a weight-associative fold AND no trust pipeline needs the
+        # stacked client models — otherwise the exact buffer-all path below
+        # stays reference-bit-exact.
         self.stream_mode = bool(
-            (codecs.codec_from_config(cfg) or cfg_extra(cfg, "streaming_aggregation"))
+            (codecs.codec_from_config(cfg) or cfg_extra(cfg, "streaming_aggregation")
+             or cfg_extra(cfg, "async_aggregation"))
             and trust is None
-            and type(self.algorithm).aggregate is FedAlgorithm.aggregate
+            and self.algorithm.supports_associative_fold()
         )
         self._np_global = None      # host copy of global_vars, per round
         self._stream_tmpl = None    # (template leaves, wire skeleton), per round
@@ -190,24 +193,27 @@ class FedMLAggregator:
         self.flag_client_model_uploaded[client_idx] = True
         self._note_buffered()
 
-    def ingest_streaming(self, client_idx: int, msg, sample_num: float,
-                         is_delta: bool) -> bool:
-        """Fold the model reply's still-undecoded wire frame straight into
-        the running weighted sum, leaf by leaf (dequantizing compressed
-        leaves as they stream).  Returns False when this update must take
-        the buffered path instead (stream mode off, tensors already
-        materialized, or a frame whose structure doesn't match the model)."""
+    def fold(self, client_idx: int, msg, sample_num: float, is_delta: bool,
+             scale: float = 1.0) -> bool:
+        """THE associative-fold entry point: fold one model reply's
+        still-unmaterialized tensor frame straight into the running weighted
+        sum with effective weight ``sample_num * scale``, leaf by leaf
+        (dequantizing compressed leaves, whether the frame arrived whole or
+        as chunk-decoded leaves).  ``scale`` carries the async server's
+        staleness decay; the synchronous path passes 1.0, whose multiply is
+        bitwise the unscaled fold.  Gated on the algorithm's
+        ``supports_associative_fold`` (via ``stream_mode``).  Returns False
+        when this update must take the dense-buffered path instead (stream
+        mode off, tensors already materialized, or a frame whose structure
+        doesn't match the model) — it performs NO duplicate filtering, since
+        a buffered-async client may legitimately contribute twice in one
+        virtual round (``ingest_streaming`` adds the sync-path dedup)."""
         if not self.stream_mode:
             return False
-        if client_idx in self.flag_client_model_uploaded:
-            # duplicate delivery (at-least-once transports redeliver): the
-            # dict-overwrite of the buffered path was naturally idempotent,
-            # a second fold would double-count — swallow it
-            return True
-        stream = msg.tensor_stream()
-        if stream is None:
+        frame = msg.tensor_frame() if hasattr(msg, "tensor_frame") else None
+        if frame is None:
             return False
-        header, offset, blob = stream
+        header, leaf_iter = frame
         tmpl, skel = self._stream_template()
         specs = header["leaves"]
         if header["treedef"] != skel or len(specs) != len(tmpl):
@@ -222,14 +228,30 @@ class FedMLAggregator:
         # buffered right now: the accumulator + this in-flight decode (+ any
         # dense fallbacks) — the quantity the <=2 acceptance bound tracks
         self._note_buffered(inflight=1)
-        w = float(sample_num)
-        for i, _spec, arr in wire.iter_leaf_arrays(blob, header=header, offset=offset):
+        w = float(sample_num) * float(scale)
+        for i, _spec, arr in leaf_iter:
             self._stream_sum[i] += w * np.asarray(arr, dtype=np.float32)
         self._stream_w += w
         if is_delta:
             self._stream_w_delta += w
         self._stream_folded += 1
         self.sample_num_dict[client_idx] = sample_num
+        return True
+
+    def ingest_streaming(self, client_idx: int, msg, sample_num: float,
+                         is_delta: bool) -> bool:
+        """Synchronous-round wrapper over :meth:`fold`: one contribution per
+        client per round.  Returns False when this update must take the
+        buffered path instead."""
+        if not self.stream_mode:
+            return False
+        if client_idx in self.flag_client_model_uploaded:
+            # duplicate delivery (at-least-once transports redeliver): the
+            # dict-overwrite of the buffered path was naturally idempotent,
+            # a second fold would double-count — swallow it
+            return True
+        if not self.fold(client_idx, msg, sample_num, is_delta):
+            return False
         self.flag_client_model_uploaded[client_idx] = True
         return True
 
@@ -340,6 +362,15 @@ class FedMLAggregator:
 
     def test_on_server(self) -> dict:
         return {k: float(v) for k, v in self._eval_fn(self.global_vars, *self._test).items()}
+
+    def warm_programs(self) -> Optional[dict]:
+        """Resolve every AOT-stored server program before round 0
+        (``ProgramStore.warm``): a redeployed/preempted async server pays
+        its deserialize/build cost at startup, never on the first virtual
+        round's eval.  None when ``extra.aot_programs`` is unset."""
+        if self._aot is None:
+            return None
+        return self._aot.warm(self._program_items)
 
     def client_selection(self, round_idx: int, client_ids: list[int], per_round: int,
                          health=None) -> list[int]:
@@ -500,7 +531,11 @@ class FedMLServerManager(FedMLCommManager):
                 self.health.observe_rtt(sender, rtt)
                 self._round_rtts[sender] = rtt
             n_samples = float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES))
-            is_delta = bool(msg.get(md.MSG_ARG_KEY_MODEL_IS_DELTA, False))
+            # control-only read: raw (non-delta) uploads carry no delta flag,
+            # and a plain get() of the missing key would materialize the
+            # tensor section — silently demoting the streaming fold to the
+            # dense buffer-all path
+            is_delta = bool(msg.get_control(md.MSG_ARG_KEY_MODEL_IS_DELTA, False))
             self._round_payload_bytes += int(getattr(msg, "wire_nbytes", 0) or 0)
             # streaming path first: fold the still-undecoded frame into the
             # running weighted sum so aggregation overlaps the network tail;
@@ -576,7 +611,7 @@ class FedMLServerManager(FedMLCommManager):
             return
         self._broadcast_model(md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
 
-    def _close_round_trace(self, *child_spans) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: only _finish_round calls this)
+    def _close_round_trace(self, *child_spans) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: _finish_round and the async server's _close_virtual_round call this)
         """End the round span, record its duration, and persist the server's
         half of the round trace (spans + per-client round trips) into the
         same collector trail the clients ship to."""

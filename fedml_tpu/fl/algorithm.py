@@ -72,6 +72,19 @@ class FedAlgorithm:
         return ClientOutput(contribution=new_vars, client_state=client_state, metrics=metrics)
 
     # -- server side -----------------------------------------------------------
+    def supports_associative_fold(self) -> bool:
+        """True when ``aggregate`` is a weight-associative fold: the result
+        of ``aggregate(stacked, weights)`` equals folding one ``(update,
+        weight)`` at a time into a running weighted sum and dividing at the
+        end, in any arrival order.  The stock sample-weighted mean is; this
+        is the capability gate for the cross-silo streaming accumulator and
+        the buffered-async server (``FedMLAggregator.fold``), which would
+        silently compute the wrong thing for an order- or set-sensitive
+        ``aggregate`` (trimmed means, coordinate medians, Krum...).  A
+        subclass that overrides ``aggregate`` with another associative form
+        may opt back in by overriding this to True."""
+        return type(self).aggregate is FedAlgorithm.aggregate
+
     def aggregate(self, stacked_contributions, weights: jax.Array):
         return pt.tree_weighted_mean(stacked_contributions, weights)
 

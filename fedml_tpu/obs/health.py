@@ -116,16 +116,28 @@ class ClientHealthLedger:
 
     def attach_comm(self) -> "ClientHealthLedger":
         """Subscribe to the comm layer's process-wide drop/retry events
-        (``comm.base.add_comm_event_sink``); idempotent."""
+        (``comm.base.add_comm_event_sink``); idempotent.
+
+        Events that name a sender (``client=``, e.g. an evicted chunk
+        stream or a corrupt async upload) additionally accrue per-client
+        failure pressure — the receive-loop counterpart of the send-side
+        ``record_comm_failure`` the broadcast path already feeds, so async
+        arrivals degrade a flaky client's score the same way synchronous
+        broadcasts do.  Unattributable events only move the process-wide
+        counters."""
         if self._comm_sink is None:
             from ..comm import base as comm_base
 
-            def sink(event: str, **_info):
+            def sink(event: str, client=None, **_info):
                 with self._lock:
                     if event == "dropped":
                         self.comm_drops += 1
                     elif event == "retried":
                         self.comm_retries += 1
+                if client is not None:
+                    # outside self._lock: record_comm_failure locks itself
+                    self.record_comm_failure(
+                        client, n=1.0 if event == "dropped" else 0.25)
 
             self._comm_sink = comm_base.add_comm_event_sink(sink)
         return self

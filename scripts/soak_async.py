@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""10k-client buffered-async soak (ISSUE 8) — CLI over
+``fedml_tpu.cross_silo.async_soak.run_soak``.
+
+Drives one real AsyncFedMLServerManager (in-proc backend, real wire bytes)
+with an event-scheduled simulated fleet: skewed lognormal latencies, injected
+upload drops, staleness-decayed folds, K-arrival virtual rounds.  Prints the
+accounting JSON (versions/s, staleness histogram, fold-lag p50/p95, peak
+buffered updates, drop/retry accounting) and exits non-zero if the soak
+stalls, leaks buffered updates (peak > 2), or loses a drop unaccounted.
+
+    JAX_PLATFORMS=cpu python scripts/soak_async.py --clients 10000 \
+        --concurrency 1024 --buffer-k 64 --versions 20
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--clients", type=int, default=10000)
+    p.add_argument("--concurrency", type=int, default=1024)
+    p.add_argument("--buffer-k", type=int, default=64)
+    p.add_argument("--versions", type=int, default=20)
+    p.add_argument("--staleness-exponent", type=float, default=0.5)
+    p.add_argument("--drop-prob", type=float, default=0.02)
+    p.add_argument("--latency-mean-s", type=float, default=0.005)
+    p.add_argument("--latency-sigma", type=float, default=1.0)
+    p.add_argument("--redispatch-timeout-s", type=float, default=2.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeout-s", type=float, default=600.0)
+    args = p.parse_args()
+
+    from fedml_tpu.cross_silo.async_soak import run_soak
+
+    res = run_soak(
+        n_clients=args.clients, concurrency=args.concurrency,
+        buffer_k=args.buffer_k, versions=args.versions,
+        staleness_exponent=args.staleness_exponent, drop_prob=args.drop_prob,
+        latency_mean_s=args.latency_mean_s, latency_sigma=args.latency_sigma,
+        redispatch_timeout_s=args.redispatch_timeout_s, seed=args.seed,
+        timeout_s=args.timeout_s,
+    )
+    print(json.dumps(res, indent=2))
+    failures = []
+    if res["versions"] < args.versions:
+        failures.append(f"only {res['versions']}/{args.versions} versions closed")
+    if res["peak_buffered_updates"] > 2:
+        failures.append(f"peak buffered updates {res['peak_buffered_updates']} > 2")
+    if res["unaccounted_drops"] != 0:
+        failures.append(f"{res['unaccounted_drops']} drops unaccounted")
+    if failures:
+        print("SOAK FAILED: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,704 @@
+"""Buffered-async aggregation (ISSUE 8): staleness-decayed folds, K-arrival
+virtual rounds, health-gated admission, chunked transport frames, and the
+associative-fold protocol — plus the flag-unset parity guarantees."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _load(cfg):
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    return ds, model
+
+
+def _upload_msg(rank, params, n_samples=16.0, version=0):
+    """A model reply as the server receives it: encoded + decoded, so the
+    tensor section is a real lazy wire frame."""
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+
+    msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, rank, 0)
+    msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+    msg.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, float(n_samples))
+    msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, int(version))
+    return Message.decode(msg.encode())
+
+
+def _perturbed(params, salt: int):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: (np.asarray(a) + 1e-3 * (salt + 1)).astype(np.asarray(a).dtype)
+        if np.asarray(a).dtype.kind == "f" else np.asarray(a),
+        params)
+
+
+# ---------------------------------------------------------------------------
+# staleness decay math
+# ---------------------------------------------------------------------------
+
+def test_staleness_scale_math():
+    from fedml_tpu.cross_silo.async_server import staleness_scale
+
+    assert staleness_scale(0, 0.5) == 1.0  # literal 1.0: bitwise-neutral fold
+    assert staleness_scale(0, 0.0) == 1.0
+    assert staleness_scale(7, 0.0) == 1.0  # exponent 0 disables the decay
+    assert staleness_scale(1, 0.5) == pytest.approx(2.0 ** -0.5)
+    assert staleness_scale(3, 1.0) == pytest.approx(0.25)
+    # monotonically decreasing in tau, and never negative
+    prev = 1.0
+    for tau in range(1, 50):
+        s = staleness_scale(tau, 0.5)
+        assert 0.0 < s < prev
+        prev = s
+
+
+def test_tau0_fold_bitwise_matches_sync_streaming(eight_devices):
+    """A fresh (tau=0) async fold must be BITWISE the synchronous streaming
+    fold: same accumulator math, scale multiplies by literal 1.0."""
+    import jax
+    from fedml_tpu.cross_silo import build_aggregator
+    from fedml_tpu.cross_silo.async_server import staleness_scale
+
+    cfg = tiny_config(extra={"streaming_aggregation": True})
+    ds, model = _load(cfg)
+    agg_sync = build_aggregator(cfg, ds, model)
+    agg_async = build_aggregator(cfg, ds, model)
+    assert agg_sync.stream_mode and agg_async.stream_mode
+
+    base = jax.device_get(agg_sync.global_vars)
+    for cid in (1, 2, 3):
+        params = _perturbed(base, cid)
+        assert agg_sync.ingest_streaming(
+            cid, _upload_msg(cid, params), 16.0 + cid, is_delta=False)
+        assert agg_async.fold(
+            cid, _upload_msg(cid, params), 16.0 + cid, is_delta=False,
+            scale=staleness_scale(0, 0.5))
+    a = jax.device_get(agg_sync.aggregate(0))
+    b = jax.device_get(agg_async.aggregate(0))
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_stale_fold_downweights(eight_devices):
+    """A stale update must pull the aggregate toward it LESS than the same
+    update folded fresh."""
+    import jax
+    from fedml_tpu.cross_silo import build_aggregator
+
+    cfg = tiny_config(extra={"streaming_aggregation": True})
+    ds, model = _load(cfg)
+
+    def run(scale_outlier):
+        agg = build_aggregator(cfg, ds, model)
+        base = jax.device_get(agg.global_vars)
+        outlier = jax.tree_util.tree_map(
+            lambda a: (np.asarray(a) + 1.0).astype(np.asarray(a).dtype)
+            if np.asarray(a).dtype.kind == "f" else np.asarray(a), base)
+        assert agg.fold(1, _upload_msg(1, base), 16.0, False, scale=1.0)
+        assert agg.fold(2, _upload_msg(2, outlier), 16.0, False, scale=scale_outlier)
+        return np.concatenate([np.asarray(l).ravel() for l in
+                               jax.tree_util.tree_leaves(jax.device_get(agg.aggregate(0)))])
+
+    fresh = run(1.0)
+    decayed = run(0.25)
+    base_agg = run(1e-9)  # outlier weight ~0: essentially only client 1
+    # decayed sits strictly between "full weight" and "no weight"
+    assert np.linalg.norm(decayed - base_agg) < np.linalg.norm(fresh - base_agg)
+    assert np.linalg.norm(decayed - base_agg) > 1e-6
+
+
+# ---------------------------------------------------------------------------
+# virtual rounds: K-boundary, determinism, health gating
+# ---------------------------------------------------------------------------
+
+def _async_server(cfg, ds, model, run_id):
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_server
+
+    InProcRouter.reset(run_id)
+    cfg.run_id = run_id
+    server = build_server(cfg, ds, model, backend="INPROC")
+    return server
+
+
+def _async_cfg(**overrides):
+    extra = {"async_aggregation": True, "async_buffer_k": 3,
+             "async_staleness_exponent": 0.5,
+             "async_redispatch_timeout_s": 0.0}  # no watchdog in direct-drive
+    extra.update(overrides.pop("extra", {}))
+    return tiny_config(training_type="cross_silo", client_num_in_total=6,
+                       client_num_per_round=4, comm_round=2,
+                       frequency_of_the_test=0, extra=extra, **overrides)
+
+
+def test_virtual_round_k_boundary(eight_devices):
+    """Exactly the Kth arrival closes the virtual round — not K-1, not K+1 —
+    and a client may legitimately contribute twice within one round."""
+    import jax
+
+    cfg = _async_cfg()
+    ds, model = _load(cfg)
+    server = _async_server(cfg, ds, model, "async_kb")
+    try:
+        server.send_init_msg()
+        base = jax.device_get(server.aggregator.global_vars)
+        # K-1 arrivals (client 1 twice: async allows repeat contributions)
+        for i, cid in enumerate((1, 1)):
+            server.handle_message_receive_model(
+                _upload_msg(cid, _perturbed(base, i), version=0))
+        assert server.server_version == 0 and not server.history
+        server.handle_message_receive_model(
+            _upload_msg(2, _perturbed(base, 7), version=0))
+        assert server.server_version == 1
+        assert len(server.history) == 1
+        assert server.history[0]["arrivals"] == 3
+        # next arrival starts the NEW round's buffer against version 1
+        server.handle_message_receive_model(
+            _upload_msg(3, _perturbed(base, 9), version=0))
+        assert server.server_version == 1
+        assert server.history[0]["staleness_max"] == 0
+        assert server.aggregator.peak_buffered_updates <= 2
+    finally:
+        server.finish()
+
+
+def test_virtual_round_deterministic_under_fixed_arrival_order(eight_devices):
+    """Same arrivals in the same order -> bitwise-identical global model."""
+    import jax
+
+    def run(run_id):
+        cfg = _async_cfg()
+        ds, model = _load(cfg)
+        server = _async_server(cfg, ds, model, run_id)
+        try:
+            server.send_init_msg()
+            base = jax.device_get(server.aggregator.global_vars)
+            arrivals = [(1, 0), (4, 0), (2, 0), (3, 1), (1, 1), (5, 0)]
+            for i, (cid, ver) in enumerate(arrivals):
+                server.handle_message_receive_model(
+                    _upload_msg(cid, _perturbed(base, i), 16.0 + cid, version=ver))
+            assert server.server_version == 2  # both virtual rounds closed
+            return jax.device_get(server.aggregator.global_vars)
+        finally:
+            server.finish()
+
+    a, b = run("async_det_a"), run("async_det_b")
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_health_gated_admission_throttles_not_drops(eight_devices):
+    """A degraded sender's upload is FOLDED, but its next dispatch waits for
+    the virtual-round boundary; healthy senders are re-dispatched at once."""
+    import jax
+
+    cfg = _async_cfg(extra={"health_aware_selection": True})
+    ds, model = _load(cfg)
+    server = _async_server(cfg, ds, model, "async_health")
+    try:
+        server.send_init_msg()
+        base = jax.device_get(server.aggregator.global_vars)
+        for _ in range(8):  # degrade rank 2 well below the 0.5 threshold
+            server.health.record_deadline_breach(2)
+        assert server.health.score(2) < server.health.degraded_threshold
+
+        folded_before = server.aggregator._stream_folded
+        server.handle_message_receive_model(_upload_msg(2, _perturbed(base, 0)))
+        assert server.aggregator._stream_folded == folded_before + 1  # folded...
+        assert 2 in server._throttled                  # ...but throttled
+        assert 2 not in server._outstanding            # no immediate re-dispatch
+
+        server.handle_message_receive_model(_upload_msg(1, _perturbed(base, 1)))
+        assert 1 not in server._throttled              # healthy: back in flight
+        # third arrival closes the round -> the throttled client re-enters
+        server.handle_message_receive_model(_upload_msg(3, _perturbed(base, 2)))
+        assert server.server_version == 1
+        assert not server._throttled
+        assert 2 in server._outstanding
+    finally:
+        server.finish()
+
+
+def test_async_e2e_inproc_real_clients(eight_devices):
+    """Full protocol with REAL training clients over the in-proc fabric:
+    virtual rounds close, eval runs, peak buffered stays <= 2."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo import run_in_process_group
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=4,
+        client_num_per_round=4, comm_round=2, frequency_of_the_test=1,
+        run_id="async_e2e",
+        extra={"async_aggregation": True, "async_buffer_k": 4,
+               "async_staleness_exponent": 0.5,
+               "async_redispatch_timeout_s": 5.0})
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    history = run_in_process_group(cfg, ds, model, timeout=120.0)
+    assert len(history) == 2
+    assert all(h["arrivals"] == 4 for h in history)
+    assert np.isfinite(history[-1]["test_acc"])
+
+
+def test_async_flag_unset_is_the_sync_server(eight_devices):
+    """Parity gate: without extra.async_aggregation, build_server returns
+    the synchronous manager (and the async module is never even needed)."""
+    from fedml_tpu.cross_silo import build_server
+    from fedml_tpu.cross_silo.async_server import AsyncFedMLServerManager
+    from fedml_tpu.cross_silo.server import FedMLServerManager
+
+    cfg = tiny_config(training_type="cross_silo", run_id="async_off")
+    ds, model = _load(cfg)
+    server = build_server(cfg, ds, model, backend="INPROC")
+    try:
+        assert type(server) is FedMLServerManager
+        assert not server.aggregator.stream_mode  # default path untouched
+    finally:
+        server.finish()
+    cfg_on = tiny_config(training_type="cross_silo", run_id="async_on",
+                         extra={"async_aggregation": True})
+    ds2, model2 = _load(cfg_on)
+    server_on = build_server(cfg_on, ds2, model2, backend="INPROC")
+    try:
+        assert isinstance(server_on, AsyncFedMLServerManager)
+        assert server_on.aggregator.stream_mode
+    finally:
+        server_on.finish()
+
+
+# ---------------------------------------------------------------------------
+# associative-fold protocol
+# ---------------------------------------------------------------------------
+
+def test_associative_fold_protocol(eight_devices):
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.fl.algorithm import FedAlgorithm
+    from fedml_tpu.fl.types import HParams
+
+    hp = HParams(learning_rate=0.1, epochs=1, batch_size=8, steps_per_epoch=1)
+    assert FedAlgorithm(hp).supports_associative_fold()
+
+    class Median(FedAlgorithm):
+        def aggregate(self, stacked, weights):  # order/set-sensitive
+            return jax.tree_util.tree_map(lambda s: jnp.median(s, 0), stacked)
+
+    assert not Median(hp).supports_associative_fold()
+
+
+def test_custom_aggregate_refuses_stream_mode(eight_devices):
+    """An algorithm overriding aggregate must keep the exact buffered path
+    even when the async/streaming flags ask for folding."""
+    from fedml_tpu.cross_silo import build_aggregator
+
+    cfg = tiny_config(federated_optimizer="FedDyn",
+                      extra={"streaming_aggregation": True})
+    ds, model = _load(cfg)
+    agg = build_aggregator(cfg, ds, model)
+    if agg.algorithm.supports_associative_fold():
+        pytest.skip("FedDyn aggregate became associative; pick another")
+    assert not agg.stream_mode
+    assert not agg.fold(1, _upload_msg(1, {}), 1.0, False)
+
+
+def test_lora_aggregator_keeps_exact_mode(eight_devices):
+    """LoRAAggregator (skips __init__) must stay on the exact buffered path:
+    class-level defaults keep stream_mode False and fold() refusing."""
+    from fedml_tpu.llm.unitedllm import LoRAAggregator
+
+    assert LoRAAggregator.stream_mode is False
+    # fold() consults stream_mode first, so an instance that never ran the
+    # base __init__ refuses the associative path outright
+    assert "fold" not in LoRAAggregator.__dict__  # inherits the one entry point
+
+
+# ---------------------------------------------------------------------------
+# chunked transport frames
+# ---------------------------------------------------------------------------
+
+def test_chunk_frames_roundtrip_and_reorder():
+    from fedml_tpu.comm import wire
+    from fedml_tpu.comm.message import ChunkAssembler, Message
+
+    msg = Message(3, 2, 0)
+    msg.add_params("model_params", {"w": np.arange(4096, dtype=np.float32).reshape(64, 64)})
+    msg.add_params("num_samples", 64.0)
+    payload = msg.encode()
+    frames = list(wire.encode_chunk_frames(payload, stream_id="s", sender=2,
+                                           chunk_bytes=900))
+    assert len(frames) > 3
+    assert all(wire.is_chunk_frame(f) for f in frames)
+    assert not wire.is_chunk_frame(payload)
+
+    def assemble(seq):
+        asm = ChunkAssembler()
+        out = None
+        for f in seq:
+            m, err, sender = asm.feed(f)
+            assert err is None and sender == 2
+            if m is not None:
+                out = m
+        assert asm.pending_streams() == 0
+        return out
+
+    for order in (frames, list(reversed(frames))):
+        out = assemble(order)
+        assert out is not None
+        assert out.wire_nbytes == len(payload)
+        assert out.get("num_samples") == 64.0
+        assert out.recv_monotonic is not None
+        np.testing.assert_array_equal(
+            out.get("model_params")["w"], msg.msg_params["model_params"]["w"])
+
+
+def test_chunk_streams_interleave_per_peer():
+    """Chunks from two concurrent uploads interleave freely — the anti-
+    head-of-line property the framing exists for."""
+    import itertools
+
+    from fedml_tpu.comm import wire
+    from fedml_tpu.comm.message import ChunkAssembler, Message
+
+    def upload(rank, scale):
+        m = Message(3, rank, 0)
+        m.add_params("model_params", {"w": np.full((100, 100), scale, np.float32)})
+        return m.encode()
+
+    f1 = list(wire.encode_chunk_frames(upload(1, 1.0), stream_id="a", sender=1, chunk_bytes=512))
+    f2 = list(wire.encode_chunk_frames(upload(5, 5.0), stream_id="b", sender=5, chunk_bytes=2048))
+    asm = ChunkAssembler()
+    done = {}
+    for f in (x for pair in itertools.zip_longest(f1, f2) for x in pair if x is not None):
+        m, err, _ = asm.feed(f)
+        assert err is None
+        if m is not None:
+            done[m.get_sender_id()] = m
+    assert set(done) == {1, 5}
+    assert float(done[5].get("model_params")["w"][0, 0]) == 5.0
+    assert asm.pending_streams() == 0
+
+
+def test_chunk_corrupt_and_timeout_are_attributed_drops():
+    from fedml_tpu.comm import wire
+    from fedml_tpu.comm.message import ChunkAssembler, Message
+
+    m = Message(3, 7, 0)
+    m.add_params("model_params", {"w": np.ones((64, 64), np.float32)})
+    frames = list(wire.encode_chunk_frames(m.encode(), stream_id="x", sender=7,
+                                           chunk_bytes=1024))
+    # corrupt a mid-stream chunk's payload length -> stream dropped, sender named
+    asm = ChunkAssembler()
+    asm.feed(frames[0])
+    bad = frames[1][:-10]  # truncated tensor bytes corrupt the leaf framing
+    res = [asm.feed(f) for f in [bad] + frames[2:]]
+    # either the corrupt chunk kills the stream now or the total-length
+    # mismatch kills it at completion; both must attribute sender 7
+    errs = [(err, sender) for _m, err, sender in res if err is not None]
+    assert errs and all(s == 7 for _e, s in errs)
+    assert asm.pending_streams() == 0
+
+    # a sender that dies mid-upload: the idle stream is swept
+    asm2 = ChunkAssembler(stream_timeout_s=0.01)
+    asm2.feed(frames[0])
+    time.sleep(0.05)
+    evicted = asm2.sweep()
+    assert evicted == [(7, "x")]
+    assert asm2.pending_streams() == 0
+
+
+def test_dropped_event_with_client_feeds_health_ledger():
+    """Satellite: receive-loop drop/retry pressure now attributes to the
+    named client, same as the synchronous broadcast-failure path."""
+    from fedml_tpu.comm import base as comm_base
+    from fedml_tpu.obs.health import ClientHealthLedger
+
+    ledger = ClientHealthLedger().attach_comm()
+    try:
+        assert ledger.score(9) == 1.0
+        comm_base._emit_comm_event("dropped", reason="chunk_stream_timeout", client=9)
+        assert ledger.comm_drops == 1
+        assert ledger.score(9) < 1.0  # per-client pressure accrued
+        before = ledger.score(9)
+        comm_base._emit_comm_event("retried", client=9)
+        assert ledger.comm_retries == 1
+        assert ledger.score(9) < before
+        # unattributed events move only the process-wide counters
+        comm_base._emit_comm_event("dropped", reason="undecodable")
+        assert ledger.comm_drops == 2
+    finally:
+        ledger.detach_comm()
+
+
+def test_tcp_chunked_end_to_end(eight_devices):
+    """A chunked TCP send must arrive as one Message with identical tensors
+    (and the receive loop must meter the chunk frames)."""
+    from fedml_tpu.comm.base import CHUNK_FRAMES
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.tcp_backend import TCPCommManager
+
+    base = 19450
+    a = TCPCommManager("127.0.0.1", base + 0, 0, base_port=base, chunk_bytes=4096)
+    b = TCPCommManager("127.0.0.1", base + 1, 1, base_port=base, chunk_bytes=4096)
+    received = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            received.append(m)
+
+    b.add_observer(Obs())
+    t = threading.Thread(target=b.handle_receive_message, daemon=True)
+    t.start()
+    frames0 = CHUNK_FRAMES.value()
+    try:
+        big = Message(3, 0, 1)
+        big.add_params("model_params", {"w": np.random.default_rng(0)
+                                        .normal(size=(128, 128)).astype(np.float32)})
+        big.add_params("num_samples", 7.0)
+        a.send_message(big)
+        deadline = time.time() + 10
+        while not received and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        b.stop_receive_message()
+        a.stop_receive_message()
+    assert received, "chunked message never delivered"
+    out = received[0]
+    assert CHUNK_FRAMES.value() - frames0 >= 2, "send was not actually chunked"
+    assert out.get("num_samples") == 7.0
+    np.testing.assert_array_equal(out.get("model_params")["w"],
+                                  big.msg_params["model_params"]["w"])
+
+
+def test_tcp_unchunked_default_is_legacy_single_frame(eight_devices):
+    """chunk_bytes=0 (the default / flag unset) must keep the legacy one-
+    frame-per-message bytes: no chunk frames on the wire at all."""
+    from fedml_tpu.comm.base import CHUNK_FRAMES
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.tcp_backend import TCPCommManager
+
+    base = 19470
+    a = TCPCommManager("127.0.0.1", base + 0, 0, base_port=base)
+    b = TCPCommManager("127.0.0.1", base + 1, 1, base_port=base)
+    assert a.chunk_bytes == 0
+    received = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            received.append(m)
+
+    b.add_observer(Obs())
+    t = threading.Thread(target=b.handle_receive_message, daemon=True)
+    t.start()
+    frames0 = CHUNK_FRAMES.value()
+    try:
+        big = Message(3, 0, 1)
+        big.add_params("model_params", {"w": np.ones((256, 256), np.float32)})
+        a.send_message(big)
+        deadline = time.time() + 10
+        while not received and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        b.stop_receive_message()
+        a.stop_receive_message()
+    assert received
+    assert CHUNK_FRAMES.value() == frames0, "flag-unset send produced chunk frames"
+    assert received[0].wire_nbytes == len(big.encode())  # byte-identical frame
+
+
+def test_grpc_chunked_end_to_end(eight_devices):
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+    from fedml_tpu.comm.message import Message
+
+    base = 19500
+    a = GRPCCommManager("127.0.0.1", base + 0, 0, base_port=base, chunk_bytes=8192)
+    b = GRPCCommManager("127.0.0.1", base + 1, 1, base_port=base, chunk_bytes=8192)
+    received = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            received.append(m)
+
+    b.add_observer(Obs())
+    t = threading.Thread(target=b.handle_receive_message, daemon=True)
+    t.start()
+    try:
+        big = Message(3, 0, 1)
+        big.add_params("model_params", {"w": np.arange(128 * 128, dtype=np.float32).reshape(128, 128)})
+        a.send_message(big)
+        deadline = time.time() + 10
+        while not received and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        b.stop_receive_message()
+        a.stop_receive_message()
+    assert received
+    np.testing.assert_array_equal(received[0].get("model_params")["w"],
+                                  big.msg_params["model_params"]["w"])
+
+
+def test_fold_accepts_chunk_decoded_message(eight_devices):
+    """The fold entry point must stream chunk-assembled (pre-decoded-leaves)
+    messages exactly like lazy whole frames — same accumulator, same result."""
+    import jax
+    from fedml_tpu.comm import wire
+    from fedml_tpu.comm.message import ChunkAssembler
+    from fedml_tpu.cross_silo import build_aggregator
+
+    cfg = tiny_config(extra={"streaming_aggregation": True})
+    ds, model = _load(cfg)
+    agg_whole = build_aggregator(cfg, ds, model)
+    agg_chunked = build_aggregator(cfg, ds, model)
+    base = jax.device_get(agg_whole.global_vars)
+    for cid in (1, 2):
+        params = _perturbed(base, cid)
+        whole = _upload_msg(cid, params, 16.0)
+        # the same reply delivered as chunk frames instead of one blob
+        asm = ChunkAssembler()
+        chunked = None
+        for f in wire.encode_chunk_frames(
+                raw_payload_bytes(params, cid),
+                stream_id=f"c{cid}", sender=cid, chunk_bytes=600):
+            m, err, _ = asm.feed(f)
+            assert err is None
+            if m is not None:
+                chunked = m
+        assert chunked is not None and chunked.tensor_frame() is not None
+        assert agg_whole.fold(cid, whole, 16.0, False)
+        assert agg_chunked.fold(cid, chunked, 16.0, False)
+    a = jax.device_get(agg_whole.aggregate(0))
+    b = jax.device_get(agg_chunked.aggregate(0))
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def raw_payload_bytes(params, rank):
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+
+    msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, rank, 0)
+    msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+    msg.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, 16.0)
+    msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, 0)
+    return msg.encode()
+
+
+def test_get_control_never_materializes(eight_devices):
+    """Reading an ABSENT control key (the raw upload's missing delta flag)
+    must not collapse the lazy tensor frame — the regression that silently
+    demoted streaming folds to the dense buffer-all path."""
+    params = {"w": np.ones((32, 32), np.float32)}
+    msg = _upload_msg(1, params)
+    assert msg.tensor_stream() is not None
+    assert msg.get_control("model_is_delta", False) is False
+    assert msg.tensor_stream() is not None  # still lazy
+    assert msg.get("model_is_delta", False) is False  # plain get materializes
+    assert msg.tensor_stream() is None
+
+
+# ---------------------------------------------------------------------------
+# soak harness (small), AOT satellites
+# ---------------------------------------------------------------------------
+
+def test_soak_small(eight_devices):
+    from fedml_tpu.cross_silo.async_soak import run_soak
+
+    res = run_soak(n_clients=200, concurrency=32, buffer_k=8, versions=3,
+                   drop_prob=0.1, latency_mean_s=0.002,
+                   redispatch_timeout_s=0.5, seed=1, timeout_s=60.0)
+    assert res["versions"] == 3
+    assert res["arrivals"] == 24
+    assert res["versions_per_sec"] > 0
+    assert res["peak_buffered_updates"] <= 2
+    assert res["unaccounted_drops"] == 0
+    assert res["fold_lag_p95_s"] is not None
+    assert res["staleness_max"] >= 1  # concurrency >> K forces staleness
+
+
+def test_client_train_program_rides_aot_store(eight_devices, tmp_path):
+    """Satellite: the cross-silo CLIENT local-train program exports through
+    the program store — a second (restarted) trainer deserializes instead of
+    re-tracing, with bitwise-identical training results."""
+    import jax
+    import fedml_tpu
+    from fedml_tpu.core import rng
+    from fedml_tpu.core.aot import AOT_HITS, AOT_MISSES
+    from fedml_tpu.cross_silo.client import FedMLTrainer
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    store = str(tmp_path / "aot")
+    mk = lambda **extra: tiny_config(
+        extra={"silo_dp": False, **extra})
+    cfg_plain = mk()
+    fedml_tpu.init(cfg_plain)
+    ds = loader.load(cfg_plain)
+    model = model_hub.create(cfg_plain, ds.class_num)
+    ix = ds.client_idx[0]
+    k0 = rng.root_key(cfg_plain.random_seed)
+    variables = jax.device_get(model.init(
+        {"params": jax.random.PRNGKey(1)},
+        np.asarray(ds.train_x[:2]), train=True))
+
+    plain = FedMLTrainer(cfg_plain, model, ds.train_x[ix], ds.train_y[ix])
+    out_plain, n_plain = plain.train(variables, 0, k0, client_idx=0)
+
+    cfg_aot = mk(aot_programs=True, aot_programs_dir=store)
+    m0, h0 = AOT_MISSES.value(), AOT_HITS.value()
+    t1 = FedMLTrainer(cfg_aot, model, ds.train_x[ix], ds.train_y[ix])
+    out_cold, _ = t1.train(variables, 0, k0, client_idx=0)
+    assert AOT_MISSES.value() - m0 == 1  # cold: traced + exported once
+
+    t2 = FedMLTrainer(cfg_aot, model, ds.train_x[ix], ds.train_y[ix])  # "restart"
+    m1 = AOT_MISSES.value()
+    out_warm, n_warm = t2.train(variables, 0, k0, client_idx=0)
+    assert AOT_MISSES.value() == m1, "warm trainer re-traced the program"
+    assert AOT_HITS.value() > h0
+    assert n_warm == n_plain
+    for a, b in zip(jax.tree_util.tree_leaves(out_plain),
+                    jax.tree_util.tree_leaves(out_warm)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(out_cold),
+                    jax.tree_util.tree_leaves(out_warm)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_server_warm_programs(eight_devices, tmp_path):
+    """Satellite: the async server's startup resolves every stored server
+    program via ProgramStore.warm() — zero failures, and a second
+    construction is served from the store."""
+    from fedml_tpu.cross_silo import build_aggregator
+
+    cfg = tiny_config(extra={"aot_programs": True,
+                             "aot_programs_dir": str(tmp_path / "aot")})
+    ds, model = _load(cfg)
+    agg = build_aggregator(cfg, ds, model)
+    stats = agg.warm_programs()
+    assert stats is not None
+    assert stats["failed"] == 0
+    assert stats["loaded"] + stats["built"] >= 1
+
+    agg2 = build_aggregator(cfg, ds, model)  # "restarted server"
+    stats2 = agg2.warm_programs()
+    assert stats2["failed"] == 0 and stats2["loaded"] >= 1
+
+    # flag unset -> no store, warm is a no-op None
+    cfg_off = tiny_config()
+    ds3, model3 = _load(cfg_off)
+    agg3 = build_aggregator(cfg_off, ds3, model3)
+    assert agg3.warm_programs() is None
