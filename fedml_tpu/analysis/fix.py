@@ -7,14 +7,25 @@ semantics-preserving mechanical form — the ``.get`` call::
     cfg.extra.get("fused_blocks")                     -> cfg_extra(cfg, 'fused_blocks', None)
     (getattr(cfg, "extra", {}) or {}).get("k", 3)     -> cfg_extra(cfg, 'k', 3)
     extra = cfg.extra; ... extra.get("silo_dp", True) -> cfg_extra(cfg, 'silo_dp', True)
+    x = extra.setdefault("k", 3)                      -> x = cfg_extra(cfg, 'k', 3)
 
 The original default expression is carried verbatim (``.get`` with no default
 becomes an explicit ``None``), so the rewrite never swaps in the registry
 default where the old code returned ``None`` — behavior is identical, the
-read just becomes registry-checked.  Sites the fixer cannot prove out —
-``setdefault`` (mutating), subscripts (KeyError semantics), ``in`` membership
-tests, non-literal flag names, and receivers whose owning config expression
-cannot be recovered — are reported for manual migration, never guessed at.
+read just becomes registry-checked.
+
+``setdefault`` in VALUE position is rewritten too (the ROADMAP carried
+item): the read half is exactly ``cfg_extra`` with the same default, and
+the dict-seeding side effect is what the registry replaces — every other
+registry-backed read supplies its own declared default, so the seed is
+dead weight.  A *statement*-position ``extra.setdefault(...)`` exists ONLY
+for that side effect (someone downstream reads the dict raw), so it is
+still reported for manual migration rather than silently deleted.
+
+Sites the fixer cannot prove out — statement-position ``setdefault``,
+subscripts (KeyError semantics), ``in`` membership tests, non-literal flag
+names, and receivers whose owning config expression cannot be recovered —
+are reported for manual migration, never guessed at.
 
 ``fix_source`` loops to a fixpoint (a ``.get`` nested inside another's
 default argument is rewritten on the next pass), which is also what makes
@@ -101,6 +112,12 @@ def _one_pass(source: str, relpath: str,
     caught by the fixpoint loop in :func:`fix_source`)."""
     tree = ast.parse(source)
     offsets = _line_offsets(source)
+    # calls whose value is discarded (bare expression statements): a
+    # setdefault here exists only for its dict-seeding side effect
+    stmt_position_calls = {
+        id(stmt.value) for stmt in ast.walk(tree)
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+    }
     extra_vars: set[str] = set()
     assigned: dict[str, Optional[str]] = {}
     candidates: list[tuple[tuple[int, int], str]] = []  # (span, replacement)
@@ -129,23 +146,26 @@ def _one_pass(source: str, relpath: str,
             continue
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
                 and node.args and _is_extra_expr(node.func.value, extra_vars):
-            if node.func.attr == "setdefault":
-                skip(node, "extra.setdefault(...) mutates the dict — migrate by hand")
+            if node.func.attr == "setdefault" and id(node) in stmt_position_calls:
+                skip(node, "statement-position extra.setdefault(...) exists only "
+                           "to seed the dict for a raw downstream read — "
+                           "migrate that read to cfg_extra by hand")
                 continue
-            if node.func.attr != "get":
+            if node.func.attr not in ("get", "setdefault"):
                 continue
+            verb = node.func.attr
             name = str_const(node.args[0])
             if name is None:
-                skip(node, "extra.get(<non-literal name>) — GL001 needs a "
+                skip(node, f"extra.{verb}(<non-literal name>) — GL001 needs a "
                            "literal flag name; migrate by hand")
                 continue
             cfg_src = _cfg_expr_of(node.func.value, assigned)
             if cfg_src is None:
-                skip(node, f"extra.get({name!r}): owning config object not "
+                skip(node, f"extra.{verb}({name!r}): owning config object not "
                            "recoverable — migrate by hand")
                 continue
             if len(node.args) > 2 or node.keywords:
-                skip(node, f"extra.get({name!r}, ...): unexpected call shape — "
+                skip(node, f"extra.{verb}({name!r}, ...): unexpected call shape — "
                            "migrate by hand")
                 continue
             default_src = ast.unparse(node.args[1]) if len(node.args) == 2 else "None"

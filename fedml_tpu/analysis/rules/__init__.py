@@ -11,6 +11,9 @@ from .gl003_donation import DonationSafetyRule
 from .gl004_locks import LockDisciplineRule
 from .gl005_metrics import MetricNamespaceRule
 from .gl006_tracer_branch import TracerBranchRule
+from .gl007_lock_order import LockOrderRule
+from .gl008_thread_races import ThreadRaceRule
+from .gl009_handlers import HandlerConformanceRule
 
 ALL_RULES = [
     FlagRegistryRule,
@@ -19,7 +22,11 @@ ALL_RULES = [
     LockDisciplineRule,
     MetricNamespaceRule,
     TracerBranchRule,
+    LockOrderRule,
+    ThreadRaceRule,
+    HandlerConformanceRule,
 ]
 
 __all__ = ["ALL_RULES", "FlagRegistryRule", "JitPurityRule", "DonationSafetyRule",
-           "LockDisciplineRule", "MetricNamespaceRule", "TracerBranchRule"]
+           "LockDisciplineRule", "MetricNamespaceRule", "TracerBranchRule",
+           "LockOrderRule", "ThreadRaceRule", "HandlerConformanceRule"]

@@ -1,0 +1,385 @@
+"""Shared AST machinery for the concurrency rules (GL007/GL008).
+
+Both rules reason about the same raw material — which locks a class owns,
+which statements run with which locks held, which ``self.<attr>`` accesses
+happen where, and which methods run on which thread — so the single
+:class:`FunctionScan` walker here produces one event stream per function
+and each rule projects out what it needs:
+
+- GL007 (lock order) consumes the *acquisition* events (``with`` on a lock
+  while other locks are held), the *self-call* events (one-hop
+  interprocedural edges), and the *blocking-call* events;
+- GL008 (thread races) consumes the *access* events (attr, write-kind,
+  locks held) plus the *thread-root* registrations.
+
+Lock identities are scoped to their defining module+class
+(``relpath::Cls.attr`` / ``relpath::NAME`` for module-level locks) so
+fixture packages and same-named classes in different modules never alias.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..engine import dotted_name
+
+#: methods that mutate their receiver — a call of one of these on
+#: ``self.<attr>`` counts as a WRITE of the attr for race purposes
+MUTATOR_METHODS = {
+    "append", "appendleft", "add", "extend", "update", "setdefault", "insert",
+    "pop", "popleft", "popitem", "remove", "discard", "clear", "sort",
+    "reverse", "put", "put_nowait",
+}
+
+_CTOR_METHODS = {"__init__", "__new__"}
+
+
+# -- lock discovery -----------------------------------------------------------
+
+def _lock_kind(value: ast.AST) -> Optional[str]:
+    """'Lock' / 'RLock' when ``value`` is a ``threading.Lock()``-style call.
+    A ``Condition`` is a lock too (``with self._cv:`` guards state exactly
+    like a mutex) and is reentrant by default (wraps an RLock)."""
+    if isinstance(value, ast.Call):
+        tail = dotted_name(value.func).rsplit(".", 1)[-1]
+        if tail in ("Lock", "RLock"):
+            return tail
+        if tail == "Condition":
+            return "RLock"
+    return None
+
+
+def class_locks(cls: ast.ClassDef) -> dict[str, str]:
+    """``{attr: kind}`` for ``self.<attr> = threading.Lock()`` assignments
+    anywhere in the class plus class-level ``<attr> = threading.Lock()``."""
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        kind = _lock_kind(node.value)
+        if kind is None:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                out[t.attr] = kind
+            elif isinstance(t, ast.Name) and node in cls.body:
+                out[t.id] = kind  # class-level shared lock
+    return out
+
+
+#: constructors whose instances are internally synchronized (or, for deque,
+#: whose single-element ops are GIL-atomic in CPython) — method calls on an
+#: attr holding one of these are not races; only REBINDING the attr is
+_SYNC_OBJECT_CTORS = {
+    "Event", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "deque",
+    "Condition", "Semaphore", "BoundedSemaphore", "Barrier",
+}
+
+
+def sync_object_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attrs assigned a thread-safe container/primitive anywhere in the
+    class (``self.x = threading.Event()`` / ``queue.Queue()`` / ...)."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            tail = dotted_name(node.value.func).rsplit(".", 1)[-1]
+            if tail in _SYNC_OBJECT_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        out.add(t.attr)
+    return out
+
+
+def module_locks(tree: ast.Module) -> dict[str, str]:
+    """Module-level ``NAME = threading.Lock()`` assignments."""
+    out: dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            kind = _lock_kind(stmt.value)
+            if kind:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = kind
+    return out
+
+
+def lock_id(relpath: str, cls_name: Optional[str], name: str) -> str:
+    return f"{relpath}::{cls_name}.{name}" if cls_name else f"{relpath}::{name}"
+
+
+def display_lock(lid: str) -> str:
+    """Human form of a lock id: strip the module prefix."""
+    return lid.split("::", 1)[-1]
+
+
+# -- blocking-call classification --------------------------------------------
+
+#: attribute calls that block on I/O or another thread — held under a lock
+#: they serialize every other critical-section entrant behind the peer
+_BLOCKING_ATTRS = {"recv", "recvfrom", "recv_into", "accept", "sendall",
+                   "connect", "block_until_ready"}
+
+
+def classify_blocking(node: ast.Call) -> Optional[str]:
+    """A short description when ``node`` is a blocking operation, else None.
+
+    Recognized: ``time.sleep``, any ``subprocess.*`` call, socket
+    send/recv/accept/connect, jax host syncs (``.block_until_ready()`` /
+    ``jax.device_get``), blocking ``<queue>.get()`` with no timeout, and
+    zero-arg ``.join()``/``.wait()`` (thread join / event wait, unbounded).
+    """
+    chain = dotted_name(node.func)
+    if chain == "time.sleep":
+        return "time.sleep()"
+    if chain.startswith("subprocess."):
+        return f"{chain}()"
+    if chain == "jax.device_get":
+        return "jax.device_get()"
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in _BLOCKING_ATTRS:
+            return f".{attr}()"
+        has_timeout = any(kw.arg in ("timeout", "block") for kw in node.keywords)
+        if attr == "get" and not node.args and not node.keywords:
+            # .get() with no key is a queue drain, not a dict lookup; only
+            # queue-looking receivers count so dict.get(key) stays silent
+            recv = dotted_name(node.func.value).rsplit(".", 1)[-1].lower()
+            if any(h in recv for h in ("queue", "inbox", "mailbox")) or recv in ("q", "_q"):
+                return ".get() (blocking queue read, no timeout)"
+        if attr in ("join", "wait") and not node.args and not has_timeout:
+            return f".{attr}() (unbounded)"
+    return None
+
+
+# -- the per-function walker --------------------------------------------------
+
+class Access:
+    __slots__ = ("attr", "line", "write", "held", "localdef", "mutcall")
+
+    def __init__(self, attr: str, line: int, write: bool,
+                 held: frozenset, localdef: Optional[str], mutcall: bool = False):
+        self.attr = attr
+        self.line = line
+        self.write = write
+        self.held = held          # lock ids held at the access
+        self.localdef = localdef  # name of the enclosing nested def, if any
+        self.mutcall = mutcall    # write via a mutator METHOD (not a rebind)
+
+
+class SelfCall:
+    __slots__ = ("name", "line", "held", "localdef")
+
+    def __init__(self, name: str, line: int, held: frozenset, localdef):
+        self.name = name
+        self.line = line
+        self.held = held
+        self.localdef = localdef
+
+
+class Acquire:
+    __slots__ = ("lock", "line", "held")
+
+    def __init__(self, lock: str, line: int, held: frozenset):
+        self.lock = lock
+        self.line = line
+        self.held = held  # locks already held when this one is taken
+
+
+class BlockingCall:
+    __slots__ = ("desc", "line", "held")
+
+    def __init__(self, desc: str, line: int, held: frozenset):
+        self.desc = desc
+        self.line = line
+        self.held = held
+
+
+class ThreadTarget:
+    """A callable handed to another thread: Thread(target=...), Timer,
+    executor.submit, a registered comm handler, or a comm event sink."""
+
+    __slots__ = ("kind", "method", "localdef", "line")
+
+    def __init__(self, kind: str, method: Optional[str], localdef: Optional[str], line: int):
+        self.kind = kind          # "thread" | "timer" | "submit" | "handler" | "sink"
+        self.method = method      # self.<method> target, if that form
+        self.localdef = localdef  # local closure/lambda target, if that form
+        self.line = line
+
+
+class FunctionScan(ast.NodeVisitor):
+    """One pass over a function body collecting the concurrency events.
+
+    ``locks`` maps syntactic receivers to lock ids: ``self.<attr>`` for
+    instance/class locks and bare names for module-level locks.  Nested
+    function bodies are walked too (their code usually runs under the
+    enclosing critical section, or on another thread — the ``localdef``
+    tag lets GL008 reassign them to callback roots).
+    """
+
+    def __init__(self, self_locks: dict[str, str], mod_locks: dict[str, str],
+                 relpath: str, cls_name: Optional[str]):
+        self.self_locks = self_locks
+        self.mod_locks = mod_locks
+        self.relpath = relpath
+        self.cls_name = cls_name
+        self._held: list[str] = []
+        self._localdef: list[str] = []
+        self.accesses: list[Access] = []
+        self.self_calls: list[SelfCall] = []
+        self.acquires: list[Acquire] = []
+        self.blocking: list[BlockingCall] = []
+        self.thread_targets: list[ThreadTarget] = []
+
+    # -- helpers ------------------------------------------------------------
+    def _lock_of(self, ctx: ast.AST) -> Optional[str]:
+        """Lock id for a with-item context expression, else None."""
+        if isinstance(ctx, ast.Call) and isinstance(ctx.func, ast.Attribute):
+            # with self._lock.acquire_timeout()-style helpers hold the lock
+            inner = self._lock_of(ctx.func.value)
+            if inner:
+                return inner
+        if isinstance(ctx, ast.Attribute) and isinstance(ctx.value, ast.Name):
+            if ctx.value.id == "self" and ctx.attr in self.self_locks:
+                return lock_id(self.relpath, self.cls_name, ctx.attr)
+            if ctx.value.id == self.cls_name and ctx.attr in self.self_locks:
+                return lock_id(self.relpath, self.cls_name, ctx.attr)
+        if isinstance(ctx, ast.Name) and ctx.id in self.mod_locks:
+            return lock_id(self.relpath, None, ctx.id)
+        return None
+
+    def _snapshot(self) -> frozenset:
+        return frozenset(self._held)
+
+    def _self_attr(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return ""
+
+    def _cur_localdef(self) -> Optional[str]:
+        return self._localdef[-1] if self._localdef else None
+
+    def _record(self, attr: str, line: int, write: bool,
+                mutcall: bool = False) -> None:
+        if attr and attr not in self.self_locks:
+            self.accesses.append(Access(attr, line, write, self._snapshot(),
+                                        self._cur_localdef(), mutcall))
+
+    # -- visitors -----------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        taken: list[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            lid = self._lock_of(item.context_expr)
+            if lid is not None:
+                self.acquires.append(Acquire(lid, node.lineno, self._snapshot()))
+                self._held.append(lid)
+                taken.append(lid)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in taken:
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        self._localdef.append(node.name)
+        self.generic_visit(node)
+        self._localdef.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._localdef.append(f"<lambda:{node.lineno}>")
+        self.generic_visit(node)
+        self._localdef.pop()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self._self_attr(node)
+        if attr:
+            self._record(attr, node.lineno,
+                         isinstance(node.ctx, (ast.Store, ast.Del)))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # self.x[k] = v / del self.x[k] mutate the container: count as write
+        attr = self._self_attr(node.value)
+        if attr and isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._record(attr, node.lineno, True, mutcall=True)
+            self.visit(node.slice)
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        desc = classify_blocking(node)
+        if desc is not None:
+            self.blocking.append(BlockingCall(desc, node.lineno, self._snapshot()))
+        # self.m(...) one-hop call edge
+        if isinstance(node.func, ast.Attribute) and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            self.self_calls.append(SelfCall(node.func.attr, node.lineno,
+                                            self._snapshot(), self._cur_localdef()))
+        else:
+            # self.<attr>.mutator(...) is a write of <attr>
+            if isinstance(node.func, ast.Attribute) and node.func.attr in MUTATOR_METHODS:
+                attr = self._self_attr(node.func.value)
+                if attr:
+                    self._record(attr, node.lineno, True, mutcall=True)
+        self._scan_thread_target(node)
+        self.generic_visit(node)
+
+    # -- thread-root registration sites -------------------------------------
+    def _target_of(self, arg: ast.AST) -> tuple[Optional[str], Optional[str]]:
+        """(self-method name, local-def name) a callable argument refers to."""
+        if isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name) \
+                and arg.value.id == "self":
+            return arg.attr, None
+        if isinstance(arg, ast.Name):
+            return None, arg.id
+        if isinstance(arg, ast.Lambda):
+            return None, f"<lambda:{arg.lineno}>"
+        return None, None
+
+    def _scan_thread_target(self, node: ast.Call) -> None:
+        chain = dotted_name(node.func)
+        tail = chain.rsplit(".", 1)[-1]
+        if tail in ("Thread", "Timer"):
+            cand = None
+            if tail == "Timer" and len(node.args) >= 2:
+                cand = node.args[1]
+            for kw in node.keywords:
+                if kw.arg in ("target", "function"):
+                    cand = kw.value
+            if cand is not None:
+                m, d = self._target_of(cand)
+                if m or d:
+                    self.thread_targets.append(
+                        ThreadTarget("timer" if tail == "Timer" else "thread",
+                                     m, d, node.lineno))
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "submit" \
+                and node.args:
+            m, d = self._target_of(node.args[0])
+            if m or d:
+                self.thread_targets.append(ThreadTarget("submit", m, d, node.lineno))
+        elif tail == "register_message_receive_handler" and len(node.args) >= 2:
+            m, d = self._target_of(node.args[1])
+            if m or d:
+                self.thread_targets.append(ThreadTarget("handler", m, d, node.lineno))
+        elif tail == "add_comm_event_sink" and node.args:
+            m, d = self._target_of(node.args[0])
+            if m or d:
+                self.thread_targets.append(ThreadTarget("sink", m, d, node.lineno))
+
+
+def scan_function(fn, self_locks: dict[str, str], mod_locks: dict[str, str],
+                  relpath: str, cls_name: Optional[str]) -> FunctionScan:
+    scan = FunctionScan(self_locks, mod_locks, relpath, cls_name)
+    for stmt in fn.body:
+        scan.visit(stmt)
+    return scan

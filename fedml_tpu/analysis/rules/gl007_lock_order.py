@@ -1,0 +1,221 @@
+"""GL007 — lock-order: acquisition cycles and blocking work under a lock.
+
+The buffered-async server, the comm backends, the health ledger, and the
+telemetry shippers together hold ~34 ``threading.Lock`` sites that the
+receive loop, watchdog timers, and caller threads traverse concurrently.
+Two whole-package invariants keep that surface deadlock-free:
+
+1. **Lock acquisition order is acyclic.**  The rule builds the package's
+   lock-acquisition graph: an edge ``A -> B`` whenever ``B`` is taken while
+   ``A`` is held — directly (nested ``with``) or one call-hop away through
+   a ``self.<method>()`` whose body takes ``B``.  A cycle means two threads
+   can take the same pair in opposite orders and deadlock; a self-edge on a
+   non-reentrant ``Lock`` (method holding it calls a method that re-takes
+   it) deadlocks the very first time that path runs.
+2. **No blocking operation runs under a lock.**  Socket send/recv/accept,
+   ``time.sleep``, ``subprocess.*``, unbounded ``.join()``/``.wait()``,
+   blocking queue reads, and jax host syncs (``.block_until_ready()``,
+   ``jax.device_get``) executed while a lock is held turn one slow peer
+   into a stalled critical section for every other thread — the 30-minute
+   soak hang the runtime sanitizer exists to catch, caught at lint time.
+   A deliberate hold (e.g. a per-socket write lock that exists precisely
+   to serialize ``sendall``) carries a GL007 suppression naming that
+   invariant.
+
+Lock identities are module+class scoped, so cycle detection cannot alias
+same-named locks of unrelated classes.  One-hop resolution covers
+``self``-method calls only; cross-object edges (e.g. manager lock ->
+ledger lock) are the runtime sanitizer's half of the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import Finding, ModuleInfo, Rule
+from ._concurrency import (
+    class_locks, display_lock, module_locks, scan_function,
+)
+
+
+class _FnInfo:
+    __slots__ = ("name", "scan", "line")
+
+    def __init__(self, name, scan, line):
+        self.name = name
+        self.scan = scan
+        self.line = line
+
+
+class LockOrderRule(Rule):
+    id = "GL007"
+    title = "lock-acquisition cycle or blocking operation under a lock"
+
+    def __init__(self):
+        #: (src, dst) -> (relpath, line, via) — first site observed
+        self._edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        self._kinds: dict[str, str] = {}
+
+    # -- per module ----------------------------------------------------------
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        mlocks = module_locks(mod.tree)
+        for name, kind in mlocks.items():
+            self._kinds[f"{mod.relpath}::{name}"] = kind
+        # module-level functions: locks can only be the module-level ones
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = scan_function(node, {}, mlocks, mod.relpath, None)
+                self._collect(mod, scan, {}, node.name, findings)
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = class_locks(cls)
+            for attr, kind in locks.items():
+                self._kinds[f"{mod.relpath}::{cls.name}.{attr}"] = kind
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+            scans = {m.name: _FnInfo(m.name, scan_function(
+                m, locks, mlocks, mod.relpath, cls.name), m.lineno)
+                for m in methods}
+            for info in scans.values():
+                self._collect(mod, info.scan, scans,
+                              f"{cls.name}.{info.name}", findings)
+        return findings
+
+    def _collect(self, mod: ModuleInfo, scan, peer_scans: dict,
+                 qualname: str, findings: list) -> None:
+        # direct acquisition edges
+        for acq in scan.acquires:
+            for held in acq.held:
+                if held != acq.lock:
+                    self._edges.setdefault(
+                        (held, acq.lock), (mod.relpath, acq.line, qualname))
+                else:
+                    self._self_edge(mod, acq.lock, acq.line, qualname, findings,
+                                    via=None)
+        # direct blocking ops
+        for b in scan.blocking:
+            if b.held:
+                findings.append(self._blocking_finding(
+                    mod, b.desc, b.line, qualname, b.held, via=None))
+        # one hop: self.m() while holding locks — m's acquisitions/blocking
+        # ops run under them too
+        for call in scan.self_calls:
+            if not call.held:
+                continue
+            callee = peer_scans.get(call.name)
+            if callee is None:
+                continue
+            for acq in callee.scan.acquires:
+                for held in call.held:
+                    if held != acq.lock:
+                        self._edges.setdefault(
+                            (held, acq.lock),
+                            (mod.relpath, call.line, f"{qualname} -> {call.name}()"))
+                    else:
+                        self._self_edge(mod, acq.lock, call.line, qualname,
+                                        findings, via=call.name)
+            for b in callee.scan.blocking:
+                findings.append(self._blocking_finding(
+                    mod, b.desc, call.line, qualname, call.held, via=call.name))
+
+    def _self_edge(self, mod: ModuleInfo, lock: str, line: int, qualname: str,
+                   findings: list, via: Optional[str]) -> None:
+        if self._kinds.get(lock) == "RLock":
+            return  # reentrant by design
+        hop = f" via self.{via}()" if via else ""
+        findings.append(Finding(
+            self.id, mod.relpath, line,
+            f"{qualname} re-acquires non-reentrant lock "
+            f"{display_lock(lock)} while already holding it{hop} — this "
+            "deadlocks on first execution",
+            symbol=f"selfdeadlock:{qualname}:{display_lock(lock)}"))
+
+    def _blocking_finding(self, mod: ModuleInfo, desc: str, line: int,
+                          qualname: str, held, via: Optional[str]) -> Finding:
+        hop = f" via self.{via}()" if via else ""
+        locks = ", ".join(sorted(display_lock(h) for h in held))
+        return Finding(
+            self.id, mod.relpath, line,
+            f"blocking {desc}{hop} while holding {locks} — every other "
+            "thread entering this critical section stalls behind the "
+            "slow peer; move it outside the lock or suppress naming the "
+            "serialization invariant",
+            symbol=f"block:{qualname}:{desc}")
+
+    # -- cross-module: cycle detection ---------------------------------------
+    def finalize(self, modules) -> Iterable[Finding]:
+        adj: dict[str, set[str]] = {}
+        for (src, dst) in self._edges:
+            adj.setdefault(src, set()).add(dst)
+            adj.setdefault(dst, set())
+        findings = []
+        for scc in _sccs(adj):
+            if len(scc) < 2:
+                continue
+            cyc = sorted(scc)
+            # anchor at the first recorded edge inside the cycle
+            anchor = min(
+                (site for pair, site in self._edges.items()
+                 if pair[0] in scc and pair[1] in scc),
+                key=lambda s: (s[0], s[1]))
+            path, line, via = anchor
+            order = " -> ".join(display_lock(x) for x in cyc)
+            findings.append(Finding(
+                self.id, path, line,
+                f"lock-order cycle {order} (edge recorded in {via}): two "
+                "threads taking these locks in opposite orders deadlock — "
+                "impose one global order or collapse to a single lock",
+                symbol="cycle:" + "|".join(display_lock(x) for x in cyc)))
+        return findings
+
+
+def _sccs(adj: dict[str, set[str]]) -> list[list[str]]:
+    """Tarjan (iterative) — strongly connected components of the lock graph."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    for root in adj:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj[nxt]))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+    return out

@@ -1,0 +1,242 @@
+"""GL008 — thread-shared-state races: every mutable attr shared across
+thread roots needs one common lock.
+
+GL004 catches the narrow shape "attr written under ``with self._lock`` in
+one method, touched bare in another".  This rule generalizes to the actual
+failure condition: an instance attribute reachable from **two or more
+thread roots**, **written** outside construction, with **no single lock
+held at every access**.  Thread roots are discovered, not assumed:
+
+- ``threading.Thread(target=self.m)`` / ``threading.Timer(dt, self.m)`` /
+  ``executor.submit(self.m)`` — ``m`` runs on its own thread;
+- methods registered as comm handlers
+  (``register_message_receive_handler(T, self.m)`` anywhere in the
+  package — name-matched so a subclass overriding a handler the base
+  class registered is still rooted) — ``m`` runs on the receive loop;
+- local closures handed to any of the above or to
+  ``add_comm_event_sink`` become their own synthetic root (only the
+  closure's accesses run on the foreign thread, not the whole method);
+- every public method is collectively the *caller* root — the user's
+  thread.
+
+Reachability follows ``self.<m>()`` calls transitively, and lock context
+is inferred interprocedurally: a method whose every internal call site
+holds ``self._lock`` analyzes as entered with it held (fixpoint), so the
+``# graftlint: disable=GL004(caller holds ...)`` helpers do not re-fire
+here — only genuinely barred accesses do.  Exemptions that keep this
+quiet on safe code: ctor accesses (no concurrency exists yet), attrs
+never written outside the ctor (immutable config), locks themselves, and
+attrs touched from a single root (thread-confined state).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import Finding, ModuleInfo, Rule
+from ._concurrency import (
+    class_locks, display_lock, module_locks, scan_function, sync_object_attrs,
+)
+
+_CTOR = {"__init__", "__new__"}
+
+#: entry-lock lattice TOP — "no call site seen yet"
+_TOP = None
+
+
+class _ClassInfo:
+    def __init__(self, relpath: str, name: str):
+        self.relpath = relpath
+        self.name = name
+        self.locks: dict[str, str] = {}
+        #: attrs holding internally-synchronized objects (Event/Queue/deque):
+        #: method calls on them are safe; only rebinding races
+        self.sync_attrs: set[str] = set()
+        #: method name -> FunctionScan
+        self.scans: dict = {}
+        #: method name -> def line
+        self.lines: dict[str, int] = {}
+        #: method names registered as thread/timer/submit targets in-class
+        self.thread_methods: set[str] = set()
+        #: self-methods registered as comm event sinks (run on the receive loop)
+        self.sink_methods: set[str] = set()
+        #: (method, localdef-name) closures handed to a thread/callback
+        self.closure_roots: set[tuple[str, str]] = set()
+
+
+class ThreadRaceRule(Rule):
+    id = "GL008"
+    title = "attr shared across thread roots without a common lock"
+
+    def __init__(self):
+        self._classes: list[_ClassInfo] = []
+        #: method names registered as comm handlers anywhere in the package
+        self._handler_names: set[str] = set()
+
+    # -- phase 1: per-module collection --------------------------------------
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        mlocks = module_locks(mod.tree)
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            info = _ClassInfo(mod.relpath, cls.name)
+            info.locks = class_locks(cls)
+            info.sync_attrs = sync_object_attrs(cls)
+            for m in cls.body:
+                if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                scan = scan_function(m, info.locks, mlocks, mod.relpath, cls.name)
+                info.scans[m.name] = scan
+                info.lines[m.name] = m.lineno
+                for t in scan.thread_targets:
+                    if t.kind == "handler" and t.method:
+                        self._handler_names.add(t.method)
+                    if t.method:
+                        if t.kind in ("thread", "timer", "submit"):
+                            info.thread_methods.add(t.method)
+                        elif t.kind == "sink":
+                            info.sink_methods.add(t.method)
+                    elif t.localdef:
+                        info.closure_roots.add((m.name, t.localdef))
+            self._classes.append(info)
+        return ()
+
+    # -- phase 2: per-class race analysis ------------------------------------
+    def finalize(self, modules) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for info in self._classes:
+            findings.extend(self._check_class(info))
+        return findings
+
+    def _roots(self, info: _ClassInfo) -> dict[str, set[str]]:
+        """root id -> seed method names.  Closure roots are handled apart.
+
+        Every registered handler of one manager runs on the SAME receive
+        loop (the comm manager dispatches sequentially), so all handler
+        methods share one ``receive-loop`` root — two handlers touching the
+        same attr is not, by itself, concurrency."""
+        roots: dict[str, set[str]] = {}
+        for m in info.scans:
+            if m in info.thread_methods:
+                roots[f"thread:{m}"] = {m}
+            elif m in self._handler_names or m in info.sink_methods:
+                roots.setdefault("receive-loop", set()).add(m)
+        seeded = {m for ms in roots.values() for m in ms}
+        caller = {m for m in info.scans
+                  if not m.startswith("_") and m not in seeded}
+        if caller:
+            roots["caller"] = caller
+        return roots
+
+    def _reach(self, info: _ClassInfo, seeds: set[str]) -> set[str]:
+        out, frontier = set(seeds), list(seeds)
+        while frontier:
+            m = frontier.pop()
+            scan = info.scans.get(m)
+            if scan is None:
+                continue
+            for call in scan.self_calls:
+                if call.name in info.scans and call.name not in out:
+                    out.add(call.name)
+                    frontier.append(call.name)
+        return out
+
+    def _entry_locks(self, info: _ClassInfo, rooted: set[str]) -> dict[str, frozenset]:
+        """Fixpoint: the set of locks PROVABLY held on every entry to each
+        method.  Root/public methods enter bare; an internal helper's entry
+        set is the intersection over its call sites of (locks held at the
+        site plus the caller's own entry set)."""
+        entry: dict[str, Optional[frozenset]] = {
+            m: (frozenset() if (m in rooted or not m.startswith("_") or m in _CTOR)
+                else _TOP)
+            for m in info.scans
+        }
+        for _ in range(len(info.scans) + 2):
+            changed = False
+            for caller, scan in info.scans.items():
+                base = entry[caller]
+                if base is _TOP:
+                    continue
+                for call in scan.self_calls:
+                    if call.name not in entry:
+                        continue
+                    contrib = frozenset(call.held) | base
+                    cur = entry[call.name]
+                    new = contrib if cur is _TOP else (cur & contrib)
+                    if new != cur:
+                        entry[call.name] = new
+                        changed = True
+            if not changed:
+                break
+        return {m: (s if s is not _TOP else frozenset()) for m, s in entry.items()}
+
+    def _check_class(self, info: _ClassInfo) -> list[Finding]:
+        roots = self._roots(info)
+        has_foreign = any(r != "caller" for r in roots) or info.closure_roots
+        if not has_foreign:
+            return []  # nothing concurrent ever starts from this class
+        rooted_seeds = {m for ms in roots.values() for m in ms}
+        entry = self._entry_locks(info, rooted_seeds)
+        # accesses per attr: (root, method, line, write, locks)
+        per_attr: dict[str, list[tuple[str, str, int, bool, frozenset]]] = {}
+
+        def add(root_id: str, method: str, acc) -> None:
+            if acc.attr in info.sync_attrs and acc.mutcall:
+                return  # mutating a synchronized object is safe; rebinds race
+            locks = acc.held | entry.get(method, frozenset())
+            per_attr.setdefault(acc.attr, []).append(
+                (root_id, method, acc.line, acc.write, locks))
+
+        for root_id, seeds in roots.items():
+            for m in self._reach(info, seeds):
+                if m in _CTOR:
+                    continue
+                for acc in info.scans[m].accesses:
+                    # closure bodies belong to their own (possibly foreign)
+                    # root, not the method that defines them
+                    if acc.localdef is not None and (m, acc.localdef) in info.closure_roots:
+                        continue
+                    add(root_id, m, acc)
+        for (method, local) in info.closure_roots:
+            scan = info.scans.get(method)
+            if scan is None:
+                continue
+            for acc in scan.accesses:
+                if acc.localdef == local:
+                    add(f"callback:{method}.{local}", method, acc)
+
+        findings: list[Finding] = []
+        for attr, accs in sorted(per_attr.items()):
+            if attr in info.locks:
+                continue
+            roots_seen = {a[0] for a in accs}
+            if len(roots_seen) < 2:
+                continue
+            if not any(write for _r, _m, _l, write, _k in accs):
+                continue  # read-only outside the ctor: immutable after publish
+            common = None
+            for _r, _m, _l, _w, locks in accs:
+                common = locks if common is None else (common & locks)
+            if common:
+                continue  # one lock covers every access
+            # anchor at the first bare write if any, else the first bare access
+            candidate_locks: set[str] = set()
+            for _r, _m, _l, _w, locks in accs:
+                candidate_locks |= locks
+            bare = [a for a in accs if not a[4]] or accs
+            bare_writes = [a for a in bare if a[3]]
+            root_id, method, line, _w, _k = min(
+                bare_writes or bare, key=lambda a: a[2])
+            other_roots = sorted(roots_seen - {root_id}) or sorted(roots_seen)
+            lock_hint = (f" (other sites hold {', '.join(sorted(display_lock(x) for x in candidate_locks))})"
+                         if candidate_locks else "")
+            findings.append(Finding(
+                self.id, info.relpath, line,
+                f"{info.name}.{attr} is shared with thread root(s) "
+                f"{', '.join(other_roots)} but this access in {method}() "
+                f"holds no common lock{lock_hint} — guard every access with "
+                "one lock or document the single-writer invariant with a "
+                "GL008 suppression",
+                symbol=f"{info.name}.{attr}"))
+        return findings

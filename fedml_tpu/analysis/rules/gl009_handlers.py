@@ -1,0 +1,150 @@
+"""GL009 — handler conformance: every sent message type has a receiver.
+
+The comm managers raise ``KeyError`` at *runtime* when a message arrives
+whose type no handler was registered for
+(``FedMLCommManager.receive_message``) — in a threaded receive loop that
+surfaces minutes into a soak as a contained-but-repeating handler error
+and a silently stalled protocol.  This rule closes the loop statically,
+package-wide (``finalize``):
+
+- **unhandled send**: a ``Message(<TYPE>, ...)`` constructed anywhere with
+  no ``register_message_receive_handler(<TYPE>, ...)`` in the whole
+  package;
+- **dead handler**: a registration for a type nothing ever sends — a
+  protocol leftover that silently rots (reported at the registration).
+
+Types resolve through ``MSG_TYPE_*`` constants (module-level int
+assignments), dotted imports (``md.MSG_TYPE_S2C_FINISH``), literal ints,
+and ``IfExp`` sends (both arms).  Sends whose type is a runtime value
+(``Message(msg_type, ...)`` in a generic helper) are *wildcards*: they
+cannot prove a handler missing, and any constant DEFINED in a module
+containing a wildcard send is exempt from dead-handler reporting — that
+module's protocol routes types we cannot see statically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name
+
+_CONST_PREFIX = "MSG_TYPE"
+
+
+class _Site:
+    __slots__ = ("relpath", "line", "idents", "label")
+
+    def __init__(self, relpath: str, line: int, idents: frozenset, label: str):
+        self.relpath = relpath
+        self.line = line
+        self.idents = idents  # symbolic constant names and/or int values
+        self.label = label    # display form
+
+
+class HandlerConformanceRule(Rule):
+    id = "GL009"
+    title = "message type sent without a registered handler (or dead handler)"
+
+    def __init__(self):
+        self._defs: dict[str, tuple[int, str]] = {}   # NAME -> (value, relpath)
+        self._sends: list[_Site] = []
+        self._registers: list[_Site] = []
+        self._wildcard_modules: set[str] = set()
+
+    # -- collection ----------------------------------------------------------
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, int):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id.startswith(_CONST_PREFIX):
+                        self._defs[t.id] = (stmt.value.value, mod.relpath)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            tail = fn.rsplit(".", 1)[-1]
+            if tail == "Message" and node.args:
+                idents, label = self._resolve(node.args[0])
+                if idents:
+                    self._sends.append(_Site(mod.relpath, node.lineno, idents, label))
+                elif label == "<dynamic>":
+                    self._wildcard_modules.add(mod.relpath)
+            elif tail == "register_message_receive_handler" and node.args:
+                idents, label = self._resolve(node.args[0])
+                if idents:
+                    self._registers.append(
+                        _Site(mod.relpath, node.lineno, idents, label))
+                # a dynamic registration wildcards nothing: it can only ADD
+                # handlers, so missing-handler reporting stays sound, and
+                # dead-handler reporting never fires on dynamic types anyway
+
+        return ()
+
+    def _resolve(self, node: ast.AST) -> tuple[frozenset, str]:
+        """(identity set, display label).  Identities are constant NAMEs
+        (resolved to values in finalize) or bare ints; an empty set with the
+        '<dynamic>' label marks a wildcard send."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return frozenset([node.value]), str(node.value)
+        name = dotted_name(node).rsplit(".", 1)[-1]
+        if name.startswith(_CONST_PREFIX):
+            return frozenset([name]), name
+        if isinstance(node, ast.IfExp):
+            a, la = self._resolve(node.body)
+            b, lb = self._resolve(node.orelse)
+            if a and b:
+                return a | b, f"{la}|{lb}"
+        return frozenset(), "<dynamic>"
+
+    # -- matching ------------------------------------------------------------
+    def _values(self, idents: frozenset) -> set:
+        """Every comparable identity: the int values of resolvable names
+        plus unresolvable names themselves (symbolic matching)."""
+        out: set = set()
+        for ident in idents:
+            if isinstance(ident, int):
+                out.add(ident)
+            elif ident in self._defs:
+                out.add(self._defs[ident][0])
+            else:
+                out.add(ident)
+        return out
+
+    def finalize(self, modules) -> Iterable[Finding]:
+        sent: set = set()
+        for s in self._sends:
+            sent |= self._values(s.idents)
+        handled: set = set()
+        for r in self._registers:
+            handled |= self._values(r.idents)
+        findings: list[Finding] = []
+        for s in self._sends:
+            missing = self._values(s.idents) - handled
+            if missing and len(missing) == len(self._values(s.idents)):
+                findings.append(Finding(
+                    self.id, s.relpath, s.line,
+                    f"message type {s.label} is sent here but no "
+                    "register_message_receive_handler for it exists anywhere "
+                    "in the package — the receive loop will raise KeyError "
+                    "and drop it",
+                    symbol=f"unhandled:{s.label}"))
+        for r in self._registers:
+            if self._values(r.idents) & sent:
+                continue
+            # a constant owned by a module with dynamic sends may well be
+            # routed through them — cannot call it dead
+            owners = {self._defs[i][1] for i in r.idents
+                      if not isinstance(i, int) and i in self._defs}
+            owners.add(r.relpath)
+            if owners & self._wildcard_modules:
+                continue
+            findings.append(Finding(
+                self.id, r.relpath, r.line,
+                f"handler registered for message type {r.label} but nothing "
+                "in the package ever sends it — dead protocol surface "
+                "(delete it or suppress naming the external sender)",
+                symbol=f"dead:{r.label}"))
+        return findings

@@ -1,0 +1,400 @@
+"""Runtime lock sanitizer — the dynamic half of the GL007 contract.
+
+The static lock-order rule sees ``self``-method call chains; it cannot see
+a manager-lock -> ledger-lock -> registry-lock chain crossing three
+objects, nor tell which of two theoretically-inverted orders a real run
+actually exercises.  This module instruments ``threading.Lock``/``RLock``
+*construction* so every lock created from ``fedml_tpu`` code records, at
+test time:
+
+- the **per-thread lock-order graph**: an edge ``A -> B`` whenever a
+  thread acquires ``B`` while holding ``A`` (per lock *instance*, with the
+  creation site as the human-readable label);
+- **hold times** per creation site, plus every hold longer than
+  ``FEDML_TPU_LOCKSAN_HOLD_S`` (default 0.5s) as a long-hold outlier with
+  the holder's stack;
+- **inversions**: cycles in the instance-order graph — the witnessed
+  two-sided evidence (``A`` before ``B`` on one thread, ``B`` before ``A``
+  on another) that a deadlock interleaving exists.
+
+Gating is absolute: unless ``FEDML_TPU_LOCKSAN=1`` is set,
+:func:`maybe_install_from_env` does nothing and ``threading.Lock`` is
+untouched — zero overhead, zero behavior change.  When enabled (the
+conftest installs it before any fedml_tpu module is imported, so
+module-level and constructor locks all route through the factory), a
+report dumps at interpreter exit to ``FEDML_TPU_LOCKSAN_REPORT`` (JSON)
+or stderr, and ``tests/test_sanitizer.py`` fails tier-1 if the async/comm
+suite ever witnesses an inversion.
+
+Locks created by foreign code (stdlib ``queue``, jax, ``threading.Event``
+internals) are left uninstrumented on purpose: the contract covers the
+package's ~34 lock sites, and instrumenting the interpreter's own plumbing
+would measure the sanitizer, not the framework.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+ENV_FLAG = "FEDML_TPU_LOCKSAN"
+ENV_REPORT = "FEDML_TPU_LOCKSAN_REPORT"
+ENV_HOLD = "FEDML_TPU_LOCKSAN_HOLD_S"
+
+#: bound on stored long-hold records / example stacks so a pathological run
+#: cannot grow the report without bound
+_MAX_LONG_HOLDS = 200
+
+# the REAL factories, captured at import: the sanitizer's own bookkeeping
+# lock must never be an instrumented lock
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_ACTIVE: "LockSanitizer | None" = None
+
+
+def _creation_site(depth: int = 2) -> tuple[str, str]:
+    """(full path, 'pkg/module.py:123' label) of the frame that called
+    ``threading.Lock()``, skipping sanitizer/threading internals (so an
+    ``Event`` created by package code attributes to the package line)."""
+    f = sys._getframe(depth)
+    while f is not None:
+        path = f.f_code.co_filename.replace("\\", "/")
+        if "sanitizer" not in path and not path.endswith("threading.py"):
+            parts = path.split("/")
+            return path, "/".join(parts[-2:]) + f":{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>", "<unknown>"
+
+
+def _short_stack(limit: int = 6) -> list[str]:
+    out = []
+    for frame in traceback.extract_stack()[:-2][-limit:]:
+        parts = frame.filename.replace("\\", "/").split("/")
+        out.append(f"{'/'.join(parts[-2:])}:{frame.lineno}:{frame.name}")
+    return out
+
+
+class _Held:
+    __slots__ = ("serial", "site", "t0", "depth")
+
+    def __init__(self, serial: int, site: str, t0: float):
+        self.serial = serial
+        self.site = site
+        self.t0 = t0
+        self.depth = 1
+
+
+class LockSanitizer:
+    """Shared state behind every instrumented lock in the process."""
+
+    def __init__(self, long_hold_s: float = 0.5):
+        self.long_hold_s = float(long_hold_s)
+        self._mu = _REAL_LOCK()
+        self._serial = 0
+        #: (serial_a, serial_b) -> count; site labels ride _sites
+        self.edges: dict[tuple[int, int], int] = {}
+        self._sites: dict[int, str] = {}
+        #: first example per edge: (thread name, short stack)
+        self._edge_examples: dict[tuple[int, int], tuple[str, list[str]]] = {}
+        #: site -> [holds, total_s, max_s]
+        self.holds: dict[str, list] = {}
+        self.long_holds: list[dict] = []
+        self._tls = threading.local()
+
+    # -- registration ---------------------------------------------------------
+    def register(self, site: str) -> int:
+        with self._mu:
+            self._serial += 1
+            self._sites[self._serial] = site
+            return self._serial
+
+    def _stack(self) -> list[_Held]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    # -- acquire/release hooks ------------------------------------------------
+    def on_acquired(self, serial: int, site: str) -> None:
+        stack = self._stack()
+        for held in stack:
+            if held.serial == serial:  # reentrant re-acquire: no new edge
+                held.depth += 1
+                return
+        if stack:
+            now_edges = [(h.serial, serial) for h in stack]
+            tname = threading.current_thread().name
+            with self._mu:
+                for e in now_edges:
+                    self.edges[e] = self.edges.get(e, 0) + 1
+                    if e not in self._edge_examples \
+                            and len(self._edge_examples) < 4 * _MAX_LONG_HOLDS:
+                        self._edge_examples[e] = (tname, _short_stack())
+        stack.append(_Held(serial, site, time.monotonic()))
+
+    def on_released(self, serial: int) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            held = stack[i]
+            if held.serial == serial:
+                held.depth -= 1
+                if held.depth > 0:
+                    return
+                del stack[i]
+                dur = time.monotonic() - held.t0
+                with self._mu:
+                    agg = self.holds.setdefault(held.site, [0, 0.0, 0.0])
+                    agg[0] += 1
+                    agg[1] += dur
+                    agg[2] = max(agg[2], dur)
+                    if dur >= self.long_hold_s and len(self.long_holds) < _MAX_LONG_HOLDS:
+                        self.long_holds.append({
+                            "site": held.site, "held_s": round(dur, 4),
+                            "thread": threading.current_thread().name,
+                            "stack": _short_stack(),
+                        })
+                return
+        # released on a thread that never recorded the acquire (e.g. a
+        # Condition handoff): nothing to time — ignore
+
+    def on_released_fully(self, serial: int) -> None:
+        """Condition.wait released the lock through ``_release_save``:
+        close the hold record regardless of reentrant depth."""
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].serial == serial:
+                stack[i].depth = 1
+                self.on_released(serial)
+                return
+
+    # -- reporting ------------------------------------------------------------
+    def _cycles(self, edges: set[tuple[int, int]]) -> list[list[int]]:
+        """Strongly connected components of size>1 in the instance graph —
+        each is a witnessed order inversion."""
+        adj: dict[int, set[int]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index: dict[int, int] = {}
+        low: dict[int, int] = {}
+        on_stack: set[int] = set()
+        stack: list[int] = []
+        counter = [0]
+        comps: list[list[int]] = []
+        for root in adj:
+            if root in index:
+                continue
+            work = [(root, iter(sorted(adj[root])))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append((nxt, iter(sorted(adj[nxt]))))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        comps.append(sorted(comp))
+        return comps
+
+    def report(self) -> dict:
+        with self._mu:
+            edges = set(self.edges)
+            sites = dict(self._sites)
+            examples = dict(self._edge_examples)
+            holds = {s: list(v) for s, v in self.holds.items()}
+            long_holds = list(self.long_holds)
+        inversions = []
+        for comp in self._cycles(edges):
+            comp_set = set(comp)
+            witness = [
+                {"edge": f"{sites.get(a, a)} -> {sites.get(b, b)}",
+                 "thread": examples.get((a, b), ("?", []))[0],
+                 "stack": examples.get((a, b), ("?", []))[1]}
+                for (a, b) in sorted(edges)
+                if a in comp_set and b in comp_set
+            ]
+            inversions.append({
+                "locks": sorted({sites.get(s, str(s)) for s in comp}),
+                "witnessed_edges": witness,
+            })
+        return {
+            "locks_instrumented": len(sites),
+            "edges_observed": len(edges),
+            "inversions": inversions,
+            "long_holds": long_holds,
+            "hold_stats": {
+                s: {"holds": v[0], "total_s": round(v[1], 4), "max_s": round(v[2], 4)}
+                for s, v in sorted(holds.items(),
+                                   key=lambda kv: -kv[1][2])
+            },
+        }
+
+
+class _SanLockBase:
+    """Instrumented wrapper around a real lock primitive.  Unknown
+    attributes (``_at_fork_reinit``, ``_is_owned``, ``_release_save``...)
+    delegate to the inner lock so Condition/fork integration keeps
+    working; the delegated forms bypass hold-timing, never correctness."""
+
+    _inner_factory = staticmethod(_REAL_LOCK)
+
+    def __init__(self, san: LockSanitizer, site: str):
+        self._inner = self._inner_factory()
+        self._san = san
+        self._site = site
+        self._serial = san.register(site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._san.on_acquired(self._serial, self._site)
+        return ok
+
+    acquire_lock = acquire  # ancient alias some libs still use
+
+    def release(self):
+        self._san.on_released(self._serial)
+        self._inner.release()
+
+    release_lock = release
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return f"<sanitized {type(self._inner).__name__} from {self._site}>"
+
+
+class _SanLock(_SanLockBase):
+    _inner_factory = staticmethod(_REAL_LOCK)
+
+
+class _SanRLock(_SanLockBase):
+    _inner_factory = staticmethod(_REAL_RLOCK)
+
+    # Condition integration: wait() must not be timed as one giant hold —
+    # the lock is RELEASED for the duration.  These mirror RLock's own
+    # protocol with the bookkeeping kept in step.
+    def _release_save(self):
+        self._san.on_released_fully(self._serial)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        self._san.on_acquired(self._serial, self._site)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+def _in_package(path: str) -> bool:
+    return "fedml_tpu/" in path
+
+
+def install(long_hold_s: float | None = None) -> LockSanitizer:
+    """Patch ``threading.Lock``/``RLock`` with the instrumenting factories.
+    Idempotent; returns the process sanitizer."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        return _ACTIVE
+    if long_hold_s is None:
+        long_hold_s = float(os.environ.get(ENV_HOLD, "0.5"))
+    san = LockSanitizer(long_hold_s=long_hold_s)
+
+    def make_lock():
+        path, site = _creation_site()
+        return _SanLock(san, site) if _in_package(path) else _REAL_LOCK()
+
+    def make_rlock():
+        path, site = _creation_site()
+        return _SanRLock(san, site) if _in_package(path) else _REAL_RLOCK()
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    _ACTIVE = san
+    atexit.register(_dump_on_exit)
+    return san
+
+
+def uninstall() -> None:
+    """Restore the real factories (already-created instrumented locks keep
+    working — they wrap real primitives)."""
+    global _ACTIVE
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _ACTIVE = None
+
+
+def active() -> "LockSanitizer | None":
+    return _ACTIVE
+
+
+def maybe_install_from_env() -> "LockSanitizer | None":
+    """The one public entry point for harness code: a strict no-op unless
+    ``FEDML_TPU_LOCKSAN=1``."""
+    if os.environ.get(ENV_FLAG) == "1":
+        return install()
+    return None
+
+
+def _dump_on_exit() -> None:
+    san = _ACTIVE
+    if san is None:
+        return
+    rep = san.report()
+    path = os.environ.get(ENV_REPORT)
+    if path:
+        try:
+            with open(path, "w") as f:
+                json.dump(rep, f, indent=1)
+                f.write("\n")
+        except OSError:
+            path = None
+    if not path:
+        summary = {k: rep[k] for k in ("locks_instrumented", "edges_observed")}
+        summary["inversions"] = len(rep["inversions"])
+        summary["long_holds"] = len(rep["long_holds"])
+        print(f"FEDML_TPU_LOCKSAN report: {json.dumps(summary)}", file=sys.stderr)
+        for inv in rep["inversions"]:
+            print(f"LOCKSAN INVERSION: {inv['locks']}", file=sys.stderr)

@@ -235,7 +235,12 @@ class ChunkAssembler:
     :class:`MessageStreamDecoder` immediately — tensor leaves decode while
     the rest of the upload is still in flight.  Streams idle longer than
     ``stream_timeout_s`` are evicted (``sweep``) so a sender that dies
-    mid-upload cannot leak buffered chunks forever."""
+    mid-upload cannot leak buffered chunks forever.
+
+    Thread model (GL008-audited): one assembler belongs to ONE receive
+    loop — ``feed`` and ``sweep`` are both called only from that thread
+    (``ObserverLoopMixin.handle_receive_message``), so ``_streams`` needs
+    no lock.  Sharing an assembler across loops would need one."""
 
     def __init__(self, stream_timeout_s: float = 120.0):
         self.stream_timeout_s = float(stream_timeout_s)

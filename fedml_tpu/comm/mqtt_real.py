@@ -123,7 +123,7 @@ class TcpMqttBroker:
         self._connected = False
         self._lock = threading.Lock()
 
-    def _ensure_connected(self) -> None:
+    def _ensure_connected(self) -> None:  # graftlint: disable=GL007(the lock IS the lazy-connect once-only gate: concurrent publishers must wait out the single dial rather than race two sessions under one client id)
         with self._lock:
             if not self._connected:
                 self._client.connect()

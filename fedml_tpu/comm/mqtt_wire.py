@@ -121,7 +121,7 @@ class _BrokerSession:
         # (pid -> (topic, payload))
         self._qos2_in: dict[int, tuple[str, bytes]] = {}
 
-    def send(self, data: bytes) -> None:
+    def send(self, data: bytes) -> None:  # graftlint: disable=GL007(_wlock exists precisely to serialize whole MQTT frames onto one socket; holding it across sendall IS the framing invariant)
         with self._wlock:
             self.sock.sendall(data)
 
@@ -272,7 +272,7 @@ class MiniMqttBroker:
         self._lock = threading.Lock()
         self._accepting = False
 
-    def start(self) -> int:
+    def start(self) -> int:  # graftlint: disable=GL008(_srv/_accepting are written before the accept thread exists (Thread.start is the publish barrier); stop() only flips the latch and close()s the socket to wake accept — never rebinds)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((self.host, self.port))
@@ -380,10 +380,10 @@ class SocketMqttClient:
         self.reconnects = 0
 
     # -- lifecycle -----------------------------------------------------------
-    def will_set(self, topic: str, payload: bytes, qos: int = 1) -> None:
+    def will_set(self, topic: str, payload: bytes, qos: int = 1) -> None:  # graftlint: disable=GL008(MQTT protocol: the will must be set before connect(); no reader/ping thread exists until connect starts them)
         self._will = (topic, payload, qos)
 
-    def connect(self) -> None:
+    def connect(self) -> None:  # graftlint: disable=GL008(the generation protocol is the synchronization: _gen/_stopping are written in the documented order below, and stale reader/ping threads self-retire on the next guard check — a lock here would have to be held across blocking socket reads to add anything)
         # a client may be re-connected after disconnect() (the adapter's
         # lazy-connect contract).  Order matters: retire the old generation
         # BEFORE clearing the stop flag — the other way round, a parked old
@@ -397,7 +397,7 @@ class SocketMqttClient:
         threading.Thread(target=self._reader_loop, args=(gen,), daemon=True).start()
         threading.Thread(target=self._ping_loop, args=(gen,), daemon=True).start()
 
-    def _do_connect(self) -> None:
+    def _do_connect(self) -> None:  # graftlint: disable=GL008(runs on the caller thread at connect() or on the one live reader during its own reconnect — the generation guard admits exactly one dialer, so _sock/_qos2_in have a single writer; readers of _sock gate on the _connected Event)
         # clean-session connect: the broker forgets the QoS2 handshake, so a
         # PUBLISH stashed between PUBREC and PUBREL will never see its PUBREL
         # — drop the stash or it is stranded (never dispatched, never freed).
@@ -437,7 +437,7 @@ class SocketMqttClient:
         if sock is not None:
             try:
                 with self._wlock:
-                    sock.sendall(_packet(DISCONNECT, 0, b""))
+                    sock.sendall(_packet(DISCONNECT, 0, b""))  # graftlint: disable=GL007(_wlock serializes whole frames on the socket; the DISCONNECT frame must not interleave a concurrent publish)
             except OSError:
                 pass
             try:
@@ -451,7 +451,7 @@ class SocketMqttClient:
         self._sock = None
 
     # -- io loops ------------------------------------------------------------
-    def _reader_loop(self, gen: int) -> None:
+    def _reader_loop(self, gen: int) -> None:  # graftlint: disable=GL008(ack/qos2 Event tables: publish() threads insert before send and wait on the Event; this loop only pops — CPython dict set/pop are atomic and the Event is the cross-thread handshake)
         while not self._stopping and gen == self._gen:
             sock = self._sock
             if sock is None or not self._connected.is_set():
@@ -552,7 +552,7 @@ class SocketMqttClient:
             except Exception:  # a handler crash must not kill the reader
                 log.exception("client %s: on_message handler failed", self.client_id)
 
-    def _send(self, data: bytes) -> None:
+    def _send(self, data: bytes) -> None:  # graftlint: disable=GL007(_wlock exists precisely to serialize whole MQTT frames onto one socket; holding it across sendall IS the framing invariant)
         sock = self._sock
         if sock is None:
             raise OSError("not connected")

@@ -346,7 +346,7 @@ class AsyncFedMLServerManager(FedMLServerManager):
             self._arm_watchdog()
 
     # -- teardown ------------------------------------------------------------
-    def finish(self) -> None:  # graftlint: disable=GL004(single boolean latch + timer handle; runs under _agg_lock when reached via send_finish, bare on the timeout path — both orders are safe because _finished only ever flips False->True)
+    def finish(self) -> None:  # graftlint: disable=GL004(single boolean latch + timer handle; runs under _agg_lock when reached via send_finish, bare on the timeout path — both orders are safe because _finished only ever flips False->True),GL008(same invariant: taking _agg_lock here would self-deadlock on the send_finish path, and the worst bare-path outcome is one extra watchdog fire that re-checks _finished under the lock and exits)
         self._finished = True
         w = self._watchdog
         self._watchdog = None

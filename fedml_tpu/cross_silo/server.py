@@ -512,7 +512,7 @@ class FedMLServerManager(FedMLCommManager):
             if self._init_sent:
                 return
             self._init_sent = True
-            self._broadcast_model(md.MSG_TYPE_S2C_INIT_CONFIG)
+            self._broadcast_model(md.MSG_TYPE_S2C_INIT_CONFIG)  # graftlint: disable=GL007(round-boundary broadcast: every client is idle until the new global arrives, so the host fetch under _agg_lock serializes nothing that could otherwise progress)
 
     def _candidate_ids(self) -> list[int]:
         """The candidate set for this round's selection — subclasses narrow
@@ -685,7 +685,7 @@ class FedMLServerManager(FedMLCommManager):
     def handle_message_client_finished(self, msg: Message) -> None:
         pass  # bookkeeping only
 
-    def finish(self) -> None:
+    def finish(self) -> None:  # graftlint: disable=GL008(teardown: finish can race the straggler timer's finish, but every resource close here is idempotent and metrics_server flips non-None->None exactly once per object)
         super().finish()
         if self.obs_collector is not None:
             self.obs_collector.close()  # release the JSONL append handle
@@ -699,7 +699,7 @@ class FedMLServerManager(FedMLCommManager):
             self.metrics_server = None
 
     # -- runner API ----------------------------------------------------------
-    def run_until_done(self, timeout: float = 600.0) -> list[dict]:
+    def run_until_done(self, timeout: float = 600.0) -> list[dict]:  # graftlint: disable=GL008(reads after done.wait() are ordered by the Event (set after the last locked write); the round_idx read in the timeout message is an intentionally racy diagnostic)
         thread = self.run_in_thread()
         self.start()
         if not self.done.wait(timeout):

@@ -121,7 +121,7 @@ class FAServerManager(FedMLCommManager):
             self._round0_sent = True
         self._broadcast_round()
 
-    def _broadcast_round(self) -> None:  # graftlint: disable=GL004(single receive-loop thread dispatches both callers; the lock only orders round-0 idempotence)
+    def _broadcast_round(self) -> None:  # graftlint: disable=GL004(single receive-loop thread dispatches both callers; the lock only orders round-0 idempotence),GL008(same single-receive-thread invariant: round_idx/selected mutate only on that thread; run_until_done reads after done.wait())
         """Sample this round's clients and send them the aggregator's
         init_msg (reference FA downlink; trie state, bounds, ...)."""
         if self.per_round >= len(self.client_ids):

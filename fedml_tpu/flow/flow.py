@@ -92,7 +92,7 @@ class FedMLAlgorithmFlow(FedMLCommManager):
         self._lock = threading.Lock()
 
     # -- DSL -----------------------------------------------------------------
-    def add_flow(self, flow_name: str, executor_task: Callable, flow_tag: str = ONCE) -> None:
+    def add_flow(self, flow_name: str, executor_task: Callable, flow_tag: str = ONCE) -> None:  # graftlint: disable=GL008(the flow graph is built single-threaded before run() starts the comm loop; handlers only ever read _steps after build())
         # the owning class is the second-to-last qualname component
         # ("Outer.<locals>.ClientEx.local_training" -> "ClientEx")
         parts = executor_task.__qualname__.split(".")
@@ -178,7 +178,7 @@ class FedMLAlgorithmFlow(FedMLCommManager):
         elif upstream:
             self.executor.set_params(Params(upstream_list=upstream))
         out = task(self.executor)
-        self._executed.append(name)
+        self._executed.append(name)  # graftlint: disable=GL008(appended only on the receive loop; callers read _executed after done.wait(), ordered by the Event)
         if tag == self.FINISH:
             # tell every other node the program is over (reference
             # _handle_flow_finish broadcast)

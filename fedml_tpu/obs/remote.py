@@ -122,7 +122,10 @@ class RemoteObsShipper:
         msg.add_params(MSG_ARG_KEY_OBS_BATCH, json.dumps(payload))
         try:
             self._send(msg)
-            self.shipped += len(payload)
+            # the flush thread and a caller-side flush can both land here:
+            # the += must run under the lock or concurrent flushes lose counts
+            with self._lock:
+                self.shipped += len(payload)
             OBS_SHIPPED.inc(len(payload))
             return len(payload)
         except Exception:
@@ -133,7 +136,8 @@ class RemoteObsShipper:
             keep = batch[-self.max_rebuffer:] if batch else []
             lost += len(batch) - len(keep)
             if lost:
-                self.dropped += lost
+                with self._lock:
+                    self.dropped += lost
                 OBS_DROPPED.inc(lost)
             if keep:
                 OBS_REBUFFERED.inc(len(keep))

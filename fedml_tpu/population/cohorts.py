@@ -49,7 +49,13 @@ COHORT_ROUNDS = obsreg.REGISTRY.counter(
 
 
 class CohortPipeline:
-    """Owns the sampler+store pair and the one-deep data prefetch."""
+    """Owns the sampler+store pair and the one-deep data prefetch.
+
+    Thread model (GL008-audited): ``_pending``/``_overlap_*`` are touched
+    only by the fit-loop thread (``prefetch_round``/``obtain``/``close``);
+    the worker thread runs ``_gather_job``, which reaches shared state only
+    through :class:`ShardedClientStore` (every access under its ``_lock``)
+    and the deterministic sampler (no mutable state past construction)."""
 
     def __init__(self, store: ShardedClientStore,
                  sampler: HierarchicalCohortSampler, prefetch: bool = True):

@@ -111,22 +111,28 @@ class FedMLAgent:
         self.env = env
         self.agent_id = agent_id or f"agent_{os.getpid()}"
         self.capacity = dict(capacity or {"num_devices": 1})
+        # the sweep thread (run_in_thread) and the caller (stop, fits,
+        # process_package from tests/CLI) both touch the run ledger; every
+        # access to _procs/_alloc/_manifest_cache holds _state_lock
+        self._state_lock = threading.Lock()
         self._procs: dict[str, subprocess.Popen] = {}
         self._alloc: dict[str, int] = {}  # run_id -> devices held
         # parsed-manifest cache keyed by (name, size, mtime): unfitting
         # packages stay queued across many polls and must not be re-opened
         # and re-parsed twice a second forever
         self._manifest_cache: dict[tuple, dict] = {}
-        self._running = False
+        self._running = threading.Event()
         self._register()
 
     # -- capacity registration / matching ------------------------------------
     def _register(self) -> None:
+        with self._state_lock:
+            running = sorted(self._alloc)
         record = {
             "id": self.agent_id,
             **self.capacity,
             "free_devices": self.free_devices(),
-            "running": sorted(self._alloc),
+            "running": running,
             "heartbeat": time.time(),
         }
         tmp = self.agents_dir / f".{self.agent_id}.tmp"
@@ -134,7 +140,9 @@ class FedMLAgent:
         tmp.replace(self.agents_dir / f"{self.agent_id}.json")
 
     def free_devices(self) -> int:
-        return int(self.capacity.get("num_devices", 1)) - sum(self._alloc.values())
+        with self._state_lock:
+            held = sum(self._alloc.values())
+        return int(self.capacity.get("num_devices", 1)) - held
 
     def fits(self, manifest: dict) -> bool:
         """Does this agent currently satisfy the job's computing section?"""
@@ -177,8 +185,9 @@ class FedMLAgent:
         proc = subprocess.Popen(
             manifest["job"], shell=True, cwd=run_dir, stdout=logf, stderr=logf, env=env
         )
-        self._procs[run_id] = proc
-        self._alloc[run_id] = parse_requirements(manifest.get("computing"))[0]
+        with self._state_lock:
+            self._procs[run_id] = proc
+            self._alloc[run_id] = parse_requirements(manifest.get("computing"))[0]
         self.db.upsert(run_id, status="RUNNING", pid=proc.pid, started=time.time())
         return run_id
 
@@ -192,11 +201,13 @@ class FedMLAgent:
                 st = pkg.stat()
                 key = (pkg.name, st.st_size, st.st_mtime_ns)
                 seen_keys.add(key)
-                manifest = self._manifest_cache.get(key)
+                with self._state_lock:
+                    manifest = self._manifest_cache.get(key)
                 if manifest is None:
                     with zipfile.ZipFile(pkg) as z:
                         manifest = json.loads(z.read("__fedml_job__.json"))
-                    self._manifest_cache[key] = manifest
+                    with self._state_lock:
+                        self._manifest_cache[key] = manifest
             except (FileNotFoundError, zipfile.BadZipFile, KeyError):
                 continue  # claimed by another agent / still being written
             if not self.fits(manifest):
@@ -205,7 +216,9 @@ class FedMLAgent:
                 claimed.append(self.process_package(pkg, manifest=manifest))
             except FileNotFoundError:
                 continue  # another agent claimed it between check and claim
-        for run_id, proc in list(self._procs.items()):
+        with self._state_lock:
+            procs = list(self._procs.items())
+        for run_id, proc in procs:
             rc = proc.poll()
             if rc is not None:
                 self.db.upsert(
@@ -213,18 +226,20 @@ class FedMLAgent:
                     status="FINISHED" if rc == 0 else "FAILED",
                     returncode=rc, finished=time.time(),
                 )
-                del self._procs[run_id]
-                self._alloc.pop(run_id, None)  # free the devices
+                with self._state_lock:
+                    self._procs.pop(run_id, None)
+                    self._alloc.pop(run_id, None)  # free the devices
         # drop cache entries for packages no longer in the queue
-        self._manifest_cache = {
-            k: v for k, v in self._manifest_cache.items() if k in seen_keys
-        }
+        with self._state_lock:
+            self._manifest_cache = {
+                k: v for k, v in self._manifest_cache.items() if k in seen_keys
+            }
         self._register()  # heartbeat + free-capacity refresh
         return claimed
 
     def run_forever(self, poll_s: float = 0.5) -> None:
-        self._running = True
-        while self._running:
+        self._running.set()
+        while self._running.is_set():
             self.sweep_once()
             time.sleep(poll_s)
 
@@ -234,8 +249,10 @@ class FedMLAgent:
         return t
 
     def stop(self) -> None:
-        self._running = False
-        for run_id, proc in self._procs.items():
+        self._running.clear()
+        with self._state_lock:
+            procs = list(self._procs.items())
+        for run_id, proc in procs:
             proc.terminate()
             self.db.upsert(run_id, status="UNDETERMINED")
 

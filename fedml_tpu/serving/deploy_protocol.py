@@ -218,7 +218,9 @@ class DeployMasterManager(FedMLCommManager):
                 if len(self.workers) >= n:
                     return
             time.sleep(0.05)
-        raise TimeoutError(f"only {len(self.workers)}/{n} workers reported online")
+        with self._lock:
+            online = len(self.workers)
+        raise TimeoutError(f"only {online}/{n} workers reported online")
 
     def _place_locked(self, replicas: int, endpoint: str) -> dict[int, int]:
         """Capacity-weighted round-robin split (reference splits a

@@ -13,6 +13,18 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Runtime lock sanitizer (ISSUE 9): FEDML_TPU_LOCKSAN=1 swaps threading.Lock
+# for an instrumented wrapper BEFORE any fedml_tpu module creates a lock, so
+# the whole suite records the lock-order graph and a report dumps at exit.
+# Strict no-op when the env var is unset (the sanitizer module is stdlib-only
+# and its import creates no locks).
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from fedml_tpu.analysis.sanitizer import maybe_install_from_env
+
+maybe_install_from_env()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

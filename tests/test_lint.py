@@ -436,6 +436,444 @@ def test_gl005_suppression(tmp_path):
     assert not r.findings and len(r.suppressed) == 1
 
 
+# -- GL007: lock order --------------------------------------------------------
+
+def test_gl007_nested_with_cycle_fires(tmp_path):
+    """A->B in one method, B->A in another: the classic ABBA deadlock."""
+    r = lint_files(tmp_path, {"mod.py": """
+        import threading
+
+        class M:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """})
+    cyc = [f for f in r.findings if f.rule == "GL007" and f.symbol.startswith("cycle:")]
+    assert len(cyc) == 1 and "M._a" in cyc[0].message and "M._b" in cyc[0].message
+
+
+def test_gl007_one_hop_cycle_and_self_deadlock(tmp_path):
+    """The interprocedural hop: holding A, call a self-method that takes B
+    (and the re-take of a non-reentrant lock through a helper)."""
+    r = lint_files(tmp_path, {"mod.py": """
+        import threading
+
+        class M:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def outer(self):
+                with self._a:
+                    self.take_b()
+
+            def take_b(self):
+                with self._b:
+                    pass
+
+            def reverse(self):
+                with self._b:
+                    with self._a:
+                        pass
+
+            def recurse(self):
+                with self._a:
+                    self.take_a()
+
+            def take_a(self):
+                with self._a:
+                    pass
+    """})
+    syms = {f.symbol for f in r.findings if f.rule == "GL007"}
+    assert any(s.startswith("cycle:") for s in syms), r.render()
+    assert any(s.startswith("selfdeadlock:M.recurse") for s in syms), r.render()
+
+
+def test_gl007_rlock_reentry_and_ordered_nesting_stay_clean(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import threading
+
+        class M:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._r = threading.RLock()
+
+            def consistent_ab(self):
+                with self._a:
+                    with self._r:
+                        pass
+
+            def also_ab(self):
+                with self._a:
+                    self.take_r()
+
+            def take_r(self):
+                with self._r:
+                    pass
+
+            def reenter(self):
+                with self._r:
+                    self.take_r()  # RLock: reentry is the point
+    """})
+    assert not [f for f in r.findings if f.rule == "GL007"], r.render()
+
+
+def test_gl007_blocking_ops_under_lock_fire_and_suppress(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import subprocess
+        import time
+        import threading
+
+        class M:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = None
+
+            def sleepy(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+            def drains(self):
+                with self._lock:
+                    item = self._queue.get()
+                return item
+
+            def spawns(self):
+                with self._lock:
+                    subprocess.run(["true"])
+
+            def syncs(self, x):
+                with self._lock:
+                    x.block_until_ready()
+
+            def documented(self):  # graftlint: disable=GL007(fixture: the lock serializes this send by design)
+                with self._lock:
+                    self._queue.sendall(b"x")
+
+            def fine(self):
+                time.sleep(1.0)  # no lock held
+                with self._lock:
+                    y = self._queue.get(timeout=1.0)  # bounded
+                return y
+    """})
+    gl007 = [f for f in r.findings if f.rule == "GL007"]
+    descs = {f.symbol for f in gl007}
+    assert {"block:M.sleepy:time.sleep()",
+            "block:M.drains:.get() (blocking queue read, no timeout)",
+            "block:M.spawns:subprocess.run()",
+            "block:M.syncs:.block_until_ready()"} <= descs, r.render()
+    assert len(r.suppressed) == 1
+    assert not any("fine" in f.symbol for f in gl007)
+
+
+# -- GL008: thread-shared-state races ----------------------------------------
+
+GL008_RACY = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.pending = []
+            self.total = 0
+
+        def start(self):
+            threading.Thread(target=self._worker, daemon=True).start()
+
+        def _worker(self):
+            with self._lock:
+                batch, self.pending = self.pending, []
+            self.total += len(batch)   # RMW outside the lock
+
+        def push(self, item):
+            with self._lock:
+                self.pending.append(item)
+
+        def stats(self):
+            return self.total
+"""
+
+
+def test_gl008_unlocked_rmw_across_threads_fires(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": GL008_RACY})
+    gl008 = [f for f in r.findings if f.rule == "GL008"]
+    assert [f.symbol for f in gl008] == ["Pump.total"], r.render()
+    assert "thread" in gl008[0].message
+
+
+def test_gl008_common_lock_everywhere_is_clean(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def start(self):
+                threading.Thread(target=self._worker, daemon=True).start()
+
+            def _worker(self):
+                with self._lock:
+                    self.total += 1
+
+            def stats(self):
+                with self._lock:
+                    return self.total
+    """})
+    assert not [f for f in r.findings if f.rule == "GL008"], r.render()
+
+
+def test_gl008_caller_holds_lock_inference(tmp_path):
+    """A private helper whose every call site holds the lock analyzes as
+    entered with it held — the PR-5 'caller holds the lock' methods do not
+    re-fire under GL008."""
+    r = lint_files(tmp_path, {"mod.py": """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def start(self):
+                threading.Thread(target=self._worker, daemon=True).start()
+
+            def _worker(self):
+                with self._lock:
+                    self._bump()
+
+            def add(self):
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):
+                self.total += 1  # every caller holds _lock
+    """})
+    assert not [f for f in r.findings if f.rule == "GL008"], r.render()
+
+
+def test_gl008_handler_roots_and_single_receive_loop(tmp_path):
+    """Registered comm handlers share ONE receive-loop root (no false race
+    between two handlers), but handler-vs-caller still fires."""
+    r = lint_files(tmp_path, {"mod.py": """
+        class Manager:
+            def __init__(self):
+                self.round_idx = 0
+                self.seen = 0
+
+            def register(self):
+                self.register_message_receive_handler(1, self.handle_a)
+                self.register_message_receive_handler(2, self.handle_b)
+
+            def handle_a(self, msg):
+                self.seen += 1       # only ever touched on the receive loop
+
+            def handle_b(self, msg):
+                self.seen += 1
+
+            def poll(self):
+                self.round_idx += 1  # caller thread
+                return self.round_idx
+
+            def handle_c(self, msg):
+                self.round_idx += 1
+    """})
+    gl008 = [f for f in r.findings if f.rule == "GL008"]
+    assert [f.symbol for f in gl008] == [], r.render()
+    # now make handle_c a registered handler too: round_idx becomes shared
+    r2 = lint_files(tmp_path / "v2", {"mod.py": """
+        class Manager:
+            def __init__(self):
+                self.round_idx = 0
+
+            def register(self):
+                self.register_message_receive_handler(3, self.handle_c)
+
+            def poll(self):
+                self.round_idx += 1
+                return self.round_idx
+
+            def handle_c(self, msg):
+                self.round_idx += 1
+    """})
+    assert [f.symbol for f in r2.findings if f.rule == "GL008"] == ["Manager.round_idx"]
+
+
+def test_gl008_sync_objects_callbacks_and_suppression(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._done = threading.Event()
+                self.count = 0
+                self.latch = False
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+                add_comm_event_sink(self._on_event)
+
+            def _loop(self):
+                while not self._done.is_set():
+                    self._q.get(timeout=0.1)   # sync objects: no race
+
+            def _on_event(self, event):
+                self.count += 1                # sink runs on the comm thread
+
+            def bump(self):
+                self.count += 1                # caller thread: race
+
+            def stop(self):  # graftlint: disable=GL008(fixture: one-way latch)
+                self.latch = True
+
+            def latched(self):
+                return self.latch
+    """})
+    gl008 = [f for f in r.findings if f.rule == "GL008"]
+    assert [f.symbol for f in gl008] == ["Worker.count"], r.render()
+    assert not any(f.symbol in ("Worker._q", "Worker._done") for f in gl008)
+
+
+def test_gl008_closure_thread_target_is_its_own_root(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import threading
+
+        class Ticker:
+            def __init__(self):
+                self.ticks = 0
+
+            def start(self):
+                def loop():
+                    self.ticks += 1   # runs on the spawned thread
+                threading.Thread(target=loop, daemon=True).start()
+
+            def read_modify(self):
+                self.ticks += 1       # caller thread
+    """})
+    assert [f.symbol for f in r.findings if f.rule == "GL008"] == ["Ticker.ticks"]
+
+
+def test_gl008_unthreaded_class_and_ctor_only_writes_are_clean(tmp_path):
+    r = lint_files(tmp_path, {"mod.py": """
+        import threading
+
+        class Config:
+            def __init__(self):
+                self.value = 1
+
+            def read(self):
+                return self.value
+
+            def write(self):
+                self.value = 2   # no thread ever starts: not concurrency
+
+        class Threaded:
+            def __init__(self):
+                self.limit = 10   # written ONLY here
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                return self.limit
+
+            def read(self):
+                return self.limit
+    """})
+    assert not [f for f in r.findings if f.rule == "GL008"], r.render()
+
+
+# -- GL009: handler conformance -----------------------------------------------
+
+def test_gl009_unhandled_send_fires_and_registration_clears(tmp_path):
+    r = lint_files(tmp_path, {
+        "defs.py": "MSG_TYPE_PING = 1\nMSG_TYPE_PONG = 2\n",
+        "node.py": """
+            from .defs import MSG_TYPE_PING, MSG_TYPE_PONG
+
+            class Node:
+                def register(self):
+                    self.register_message_receive_handler(MSG_TYPE_PING, self.on_ping)
+
+                def on_ping(self, msg):
+                    self.send_message(Message(MSG_TYPE_PONG, 0, 1))
+
+                def start(self):
+                    self.send_message(Message(MSG_TYPE_PING, 0, 1))
+        """,
+    })
+    gl009 = [f for f in r.findings if f.rule == "GL009"]
+    assert [f.symbol for f in gl009] == ["unhandled:MSG_TYPE_PONG"], r.render()
+
+
+def test_gl009_dead_handler_fires_and_wildcard_send_exempts(tmp_path):
+    r = lint_files(tmp_path, {
+        "node.py": """
+            MSG_TYPE_A = 1
+            MSG_TYPE_B = 2
+
+            class Node:
+                def register(self):
+                    self.register_message_receive_handler(MSG_TYPE_A, self.on_a)
+                    self.register_message_receive_handler(MSG_TYPE_B, self.on_b)
+
+                def start(self):
+                    self.send_message(Message(MSG_TYPE_A, 0, 1))
+        """,
+        "generic.py": """
+            MSG_TYPE_C = 3
+
+            class Generic:
+                def register(self):
+                    self.register_message_receive_handler(MSG_TYPE_C, self.on_c)
+
+                def send_any(self, msg_type):
+                    self.send_message(Message(msg_type, 0, 1))  # wildcard
+        """,
+    })
+    gl009 = [f for f in r.findings if f.rule == "GL009"]
+    # MSG_TYPE_B is provably dead; MSG_TYPE_C's module routes dynamic types
+    assert [f.symbol for f in gl009] == ["dead:MSG_TYPE_B"], r.render()
+
+
+def test_gl009_value_matching_ifexp_and_suppression(tmp_path):
+    r = lint_files(tmp_path, {
+        "a.py": """
+            MSG_TYPE_INIT = 1
+            MSG_TYPE_SYNC = 2
+
+            class Server:
+                def dispatch(self, first):
+                    self.send_message(Message(MSG_TYPE_INIT if first else MSG_TYPE_SYNC, 0, 1))
+
+                def external(self):
+                    self.send_message(Message(MSG_TYPE_EXTERNAL, 0, 1))  # graftlint: disable=GL009(fixture: handled by an out-of-repo peer)
+        """,
+        "b.py": """
+            class Client:
+                def register(self):
+                    self.register_message_receive_handler(1, self.on_init)
+                    self.register_message_receive_handler(2, self.on_sync)
+        """,
+    })
+    gl009 = [f for f in r.findings if f.rule == "GL009"]
+    assert not gl009, r.render()
+    assert len(r.suppressed) == 1
+
+
 # -- suppressions / baseline machinery ---------------------------------------
 
 def test_parse_suppressions_multiple_ids_and_reasons():
@@ -496,6 +934,87 @@ def test_cli_lint_json_over_package():
         rc = main(["lint", "--format", "json"])
     doc = json.loads(buf.getvalue())
     assert rc == 0 and doc["ok"] and doc["findings"] == []
+
+
+def _cli(args):
+    """Run the lint CLI in-process, capturing (rc, stdout)."""
+    import contextlib
+    import io
+
+    from fedml_tpu.cli import main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(args)
+    return rc, buf.getvalue()
+
+
+def test_cli_lint_json_shape_on_findings(tmp_path):
+    """The documented --format json contract on a dirty tree: every finding
+    carries rule/path/line/severity/message/key, counts_by_rule aggregates,
+    and suppressed findings are counted but not listed."""
+    (tmp_path / "core").mkdir()
+    (tmp_path / "core" / "flags.py").write_text(textwrap.dedent(FLAGS_FIXTURE))
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""
+        import threading
+        import time
+
+        class M:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+            def documented(self):  # graftlint: disable=GL007(fixture reason)
+                with self._lock:
+                    time.sleep(1.0)
+    """))
+    rc, out = _cli(["lint", str(tmp_path), "--format", "json"])
+    doc = json.loads(out)
+    assert rc == 1 and doc["ok"] is False
+    assert doc["parse_errors"] == []
+    assert doc["suppressed"] == 1 and doc["baselined"] == 0
+    assert doc["counts_by_rule"].get("GL007") == 1
+    # dead_flag + declared_flag declarations are dead in this fixture too
+    assert doc["counts_by_rule"].get("GL001") == 2
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "severity", "message", "key"}
+        assert f["severity"] in ("error", "warning") and f["line"] > 0
+    keys = {f["key"] for f in doc["findings"]}
+    assert any(k.startswith("GL007:mod.py:block:M.slow") for k in keys), keys
+
+
+def test_cli_baseline_write_and_read_round_trip(tmp_path):
+    """--write-baseline grandfathers the current findings; a second CLI run
+    against that baseline exits 0 with everything baselined; fixing the code
+    then leaves a stale baseline that changes nothing."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "def f(cfg):\n"
+        "    extra = getattr(cfg, 'extra', {}) or {}\n"
+        "    return extra.get('rogue_flag')\n")
+    baseline = tmp_path / "baseline.json"
+    rc, out = _cli(["lint", str(pkg), "--baseline", str(baseline), "--write-baseline"])
+    assert rc == 0 and "baselined" in out
+    doc = json.loads(baseline.read_text())
+    assert doc["version"] == 1 and doc["findings"]
+    assert all({"key", "rule", "path", "line", "message"} <= set(e)
+               for e in doc["findings"])
+    # second run: same findings, now grandfathered -> exit 0
+    rc2, out2 = _cli(["lint", str(pkg), "--baseline", str(baseline),
+                      "--format", "json"])
+    doc2 = json.loads(out2)
+    assert rc2 == 0 and doc2["ok"] and doc2["findings"] == []
+    assert doc2["baselined"] == len(doc["findings"])
+    # the fixed tree stays clean against the now-stale baseline
+    (pkg / "mod.py").write_text("def f(cfg):\n    return None\n")
+    rc3, out3 = _cli(["lint", str(pkg), "--baseline", str(baseline),
+                      "--format", "json"])
+    doc3 = json.loads(out3)
+    assert rc3 == 0 and doc3["ok"] and doc3["baselined"] == 0
 
 
 # -- the flag registry + accessor --------------------------------------------

@@ -84,10 +84,10 @@ def test_fix_skips_manual_sites_and_suppressions(tmp_path):
     (tmp_path / "mod.py").write_text(textwrap.dedent('''
         def f(cfg, name):
             a = cfg.extra["seg_base"]
-            b = cfg.extra.setdefault("gan_z_dim", 3)
+            cfg.extra.setdefault("gan_z_dim", 3)  # statement: seeds the dict
             c = "silo_dp" in cfg.extra
             d = cfg.extra.get(name)
-            return a, b, c, d
+            return a, c, d
 
 
         def g(cfg):  # graftlint: disable=GL001(deliberate raw read)
@@ -101,6 +101,49 @@ def test_fix_skips_manual_sites_and_suppressions(tmp_path):
     assert "seg_base" in notes and "setdefault" in notes
     assert "membership test" in notes and "non-literal" in notes
     assert "fused_blocks" not in notes  # suppressed site: no nag either
+
+
+def test_fix_rewrites_value_position_setdefault(tmp_path):
+    """The ROADMAP carried item: ``x = extra.setdefault(k, v)`` reads the
+    flag with default ``v`` — rewritten to the registry-backed read.  The
+    statement form (pure dict seeding) stays manual."""
+    src = textwrap.dedent('''
+        def f(cfg):
+            a = cfg.extra.setdefault("mlp_hidden", 64)
+            extra = cfg.extra
+            b = extra.setdefault("silo_dp")
+            if extra.setdefault("fused_blocks", False):
+                a += 1
+            cfg.extra.setdefault("comm_topk_ratio", 0.1)  # statement form
+            return a, b
+    ''')
+    fixed, n, skipped = fix_source(src, "mod.py")
+    assert n == 3, fixed
+    assert "cfg_extra(cfg, 'mlp_hidden', 64)" in fixed
+    assert "cfg_extra(cfg, 'silo_dp', None)" in fixed
+    assert "cfg_extra(cfg, 'fused_blocks', False)" in fixed
+    # the statement-position seed survives untouched, with a manual note
+    assert 'cfg.extra.setdefault("comm_topk_ratio", 0.1)' in fixed
+    assert any("statement-position" in s for s in skipped)
+    compile(fixed, "mod.py", "exec")
+    again, n2, _ = fix_source(fixed, "mod.py")
+    assert n2 == 0 and again == fixed  # idempotent
+
+
+def test_fix_setdefault_semantics_match_on_value_use():
+    """For the value use itself, setdefault(k, v) and cfg_extra(cfg, k, v)
+    agree whether the flag is set or unset."""
+    from fedml_tpu.arguments import Config
+
+    src = "def f(cfg):\n    return cfg.extra.setdefault('mlp_hidden', 64)\n"
+    fixed, n, _ = fix_source(src, "mod.py")
+    assert n == 1
+    orig_ns, fixed_ns = {}, {}
+    exec(compile(src, "o.py", "exec"), orig_ns)
+    exec(compile(fixed, "f.py", "exec"), fixed_ns)
+    for extra in ({}, {"mlp_hidden": 256}):
+        assert (orig_ns["f"](Config(dataset="synthetic", model="lr", extra=dict(extra)))
+                == fixed_ns["f"](Config(dataset="synthetic", model="lr", extra=dict(extra))))
 
 
 def test_fixed_package_is_gl001_legacy_clean(tmp_path):
