@@ -32,6 +32,11 @@ Three benches, one JSON line:
    latencies, injected drops) against one buffered-async server —
    versions/s (floor-guarded), staleness histogram, fold-lag p95, peak
    buffered updates <= 2, zero unaccounted drops.
+7. **Chaos recovery** (ISSUE 10): the same async shape run clean and
+   killed-and-recovered (recovery journal + seeded chaos on the dispatch
+   leg, server hard-killed mid-run, restarted against its journal) — the
+   recovered run must retain >= 0.5x the clean versions/s (floor-guarded)
+   with monotone version, zero unaccounted losses, peak buffered <= 2.
 
 The reference publishes no numeric baselines (BASELINE.md) and has no MFU
 accounting at all; the 0.35 target comes from BASELINE.json's north star.
@@ -397,6 +402,50 @@ def bench_async_soak():
     )
 
 
+def bench_chaos():
+    """Crash recovery under chaos (ISSUE 10): the same buffered-async shape
+    run twice — CLEAN (no journal, no chaos) and KILL-AND-RECOVER (recovery
+    journal on, every chaos fault class live on the dispatch leg, the server
+    hard-killed mid-run and restarted against its journal).  The guarded
+    number is ``recovery_ratio`` = recovered-run versions/s over the clean
+    run's: recovery must cost at most half the throughput, or restarts are
+    not production-viable.  Platform independent (host-side server path).
+
+    Both runs pay the journal's per-round snapshot (the clean leg runs with
+    the journal ON, kill-free), so the ratio isolates what the CRASH costs —
+    re-discovery, epoch fencing, watchdog re-issue — not what durability
+    costs.  Both runs also re-assert the correctness invariants (completion,
+    monotone version, zero unaccounted losses, peak buffered <= 2) as floor
+    violations — a recovery that loses work silently is a regression, not a
+    statistic."""
+    import shutil
+    import tempfile
+
+    from fedml_tpu.cross_silo.async_soak import run_kill_recover_soak, run_soak
+
+    clients = int(os.environ.get("BENCH_CHAOS_CLIENTS", "2000"))
+    concurrency = int(os.environ.get("BENCH_CHAOS_CONCURRENCY", "256"))
+    buffer_k = int(os.environ.get("BENCH_CHAOS_BUFFER_K", "32"))
+    versions = int(os.environ.get("BENCH_CHAOS_VERSIONS", "12"))
+    common = dict(n_clients=clients, concurrency=concurrency,
+                  buffer_k=buffer_k, versions=versions, drop_prob=0.02,
+                  latency_mean_s=0.003, redispatch_timeout_s=2.0, seed=0,
+                  timeout_s=600.0)
+    clean_journal = tempfile.mkdtemp(prefix="bench_chaos_clean_")
+    try:
+        clean = run_soak(journal_dir=clean_journal, **common)
+    finally:
+        shutil.rmtree(clean_journal, ignore_errors=True)
+    recovered = run_kill_recover_soak(**common)
+    ratio = (recovered["versions_per_sec"] / clean["versions_per_sec"]
+             if clean["versions_per_sec"] else None)
+    return {
+        "clean": clean,
+        "recovered": recovered,
+        "recovery_ratio": round(ratio, 4) if ratio is not None else None,
+    }
+
+
 def bench_llm(peak):
     import jax
     import jax.numpy as jnp
@@ -473,6 +522,8 @@ def _run_one(mode):
         result = bench_aot_cold_start()
     elif mode == "async_soak":
         result = bench_async_soak()
+    elif mode == "chaos":
+        result = bench_chaos()
     else:
         result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
@@ -541,6 +592,12 @@ POPULATION_RSS_MULTIPLE_FLOOR = 16.0
 #: number is ~22/s, so 2.0 catches order-of-magnitude regressions while
 #: tolerating loaded-box noise).
 ASYNC_VERSIONS_PER_SEC_FLOOR = 2.0
+#: Kill-and-recover soak throughput as a fraction of the clean run's
+#: versions/s (ISSUE 10) — platform independent.  A mid-run SIGKILL +
+#: journal recovery (re-discovery, epoch fence, watchdog re-issue of lost
+#: dispatches) must retain at least half the clean throughput, or server
+#: restarts are not production-viable.
+CHAOS_RECOVERY_RATIO_FLOOR = 0.5
 #: Warm start-to-first-round as a fraction of cold (ISSUE 7) — platform
 #: independent (the AOT store removes re-tracing everywhere; on CPU the
 #: deserialized program's compile additionally rides the persistent
@@ -600,6 +657,10 @@ def main():
     # server, staleness-decayed folds, K-arrival virtual rounds; floor on
     # versions/s + the peak-buffered/unaccounted-drop acceptance bounds
     async_soak = _subprocess_bench("async_soak")
+    # ISSUE-10 chaos: the same async shape clean vs killed-and-recovered
+    # under seeded chaos — floor on recovered/clean versions/s plus the
+    # recovery correctness invariants
+    chaos = _subprocess_bench("chaos")
     # ISSUE-7 cold_start: two fresh processes share one AOT program store +
     # compilation cache root; the first populates it, the second must
     # deserialize every program (misses == 0) and start in <= 0.5x the time
@@ -664,6 +725,24 @@ def main():
     if async_soak.get("unaccounted_drops", 0) != 0:
         violations.append(
             f"async soak lost {async_soak['unaccounted_drops']} drops unaccounted")
+    chaos_ratio = chaos.get("recovery_ratio")
+    if chaos_ratio is not None and chaos_ratio < CHAOS_RECOVERY_RATIO_FLOOR:
+        # same one-retry policy as the other wall-clock floors
+        chaos = _subprocess_bench("chaos")
+        chaos_ratio = chaos.get("recovery_ratio")
+    if chaos_ratio is not None and chaos_ratio < CHAOS_RECOVERY_RATIO_FLOOR:
+        violations.append(
+            f"chaos recovery ratio {chaos_ratio} < floor "
+            f"{CHAOS_RECOVERY_RATIO_FLOOR} (recovered run lost too much throughput)")
+    rec = chaos.get("recovered", {})
+    if rec and not rec.get("monotone", True):
+        violations.append("chaos recovered run version not monotone")
+    if rec.get("unaccounted", 0) != 0:
+        violations.append(
+            f"chaos recovered run lost {rec['unaccounted']} drops unaccounted")
+    if rec.get("peak_buffered_updates", 0) > 2:
+        violations.append(
+            f"chaos recovered run peak buffered {rec['peak_buffered_updates']} > 2")
     pop_rss = population.get("rss_multiple")
     if pop_rss is not None and pop_rss > POPULATION_RSS_MULTIPLE_FLOOR:
         violations.append(
@@ -701,6 +780,7 @@ def main():
             "crosssilo_comm": crosssilo,
             "population": population,
             "async": async_soak,
+            "chaos": chaos,
             "aot": aot,
             "lint": lint_section,
         },

@@ -93,6 +93,44 @@ def sync_object_attrs(cls: ast.ClassDef) -> set[str]:
     return out
 
 
+def _ctor_class_name(value: ast.AST) -> Optional[str]:
+    """Class name a constructor-ish assignment value refers to:
+    ``Cls(...)``, ``pkg.mod.Cls(...)``, and the fluent-builder form
+    ``Cls(...).attach(...)`` (a method chain whose root is a ctor call —
+    the ledger's ``ClientHealthLedger().attach_comm()`` idiom)."""
+    if not isinstance(value, ast.Call):
+        return None
+    func = value.func
+    if isinstance(func, ast.Name):
+        name = func.id
+        return name if name[:1].isupper() else None
+    if isinstance(func, ast.Attribute):
+        if isinstance(func.value, ast.Call):
+            return _ctor_class_name(func.value)  # fluent chain: recurse to root
+        name = func.attr
+        return name if name[:1].isupper() else None
+    return None
+
+
+def class_attr_types(cls: ast.ClassDef) -> dict[str, str]:
+    """``{attr: ClassName}`` for ``self.<attr> = SomeClass(...)`` assignments
+    anywhere in the class — the receiver-type map the GL007 cross-object
+    one-hop resolution uses to find which locks ``self.<attr>.<m>()`` can
+    take."""
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        cname = _ctor_class_name(node.value)
+        if cname is None:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                out[t.attr] = cname
+    return out
+
+
 def module_locks(tree: ast.Module) -> dict[str, str]:
     """Module-level ``NAME = threading.Lock()`` assignments."""
     out: dict[str, str] = {}
@@ -179,6 +217,21 @@ class SelfCall:
         self.localdef = localdef
 
 
+class AttrMethodCall:
+    """``self.<attr>.<method>(...)`` — a one-hop call INTO another object.
+    GL007 resolves ``attr`` through the owning class's attr-type map and
+    adds held-lock -> callee-lock edges (the manager-lock -> ledger-lock
+    class of ordering that used to be runtime-sanitizer-only)."""
+
+    __slots__ = ("attr", "method", "line", "held")
+
+    def __init__(self, attr: str, method: str, line: int, held: frozenset):
+        self.attr = attr
+        self.method = method
+        self.line = line
+        self.held = held
+
+
 class Acquire:
     __slots__ = ("lock", "line", "held")
 
@@ -230,6 +283,7 @@ class FunctionScan(ast.NodeVisitor):
         self._localdef: list[str] = []
         self.accesses: list[Access] = []
         self.self_calls: list[SelfCall] = []
+        self.attr_calls: list[AttrMethodCall] = []
         self.acquires: list[Acquire] = []
         self.blocking: list[BlockingCall] = []
         self.thread_targets: list[ThreadTarget] = []
@@ -325,6 +379,19 @@ class FunctionScan(ast.NodeVisitor):
                 and node.func.value.id == "self":
             self.self_calls.append(SelfCall(node.func.attr, node.lineno,
                                             self._snapshot(), self._cur_localdef()))
+        elif isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Attribute) \
+                and isinstance(node.func.value.value, ast.Name) \
+                and node.func.value.value.id == "self":
+            # self.<attr>.<method>(...): the cross-OBJECT one-hop call —
+            # GL007 resolves <attr>'s class and projects its locks
+            self.attr_calls.append(AttrMethodCall(
+                node.func.value.attr, node.func.attr, node.lineno,
+                self._snapshot()))
+            # fall through to the mutator check below (self.x.append(...)
+            # is both an attr-call and a write of x)
+            if node.func.attr in MUTATOR_METHODS:
+                self._record(node.func.value.attr, node.lineno, True, mutcall=True)
         else:
             # self.<attr>.mutator(...) is a write of <attr>
             if isinstance(node.func, ast.Attribute) and node.func.attr in MUTATOR_METHODS:

@@ -24,8 +24,13 @@ Two whole-package invariants keep that surface deadlock-free:
 
 Lock identities are module+class scoped, so cycle detection cannot alias
 same-named locks of unrelated classes.  One-hop resolution covers
-``self``-method calls only; cross-object edges (e.g. manager lock ->
-ledger lock) are the runtime sanitizer's half of the contract.
+``self``-method calls AND cross-object attr calls: ``self.<attr>.<m>()``
+while holding a lock resolves ``<attr>`` through the owning class's
+``self.<attr> = SomeClass(...)`` assignments to SomeClass (cross-module,
+fluent builders included) and projects the locks ``SomeClass.<m>`` acquires
+as held-lock -> callee-lock edges — the manager-lock -> ledger-lock class
+of ordering that used to be visible only to the runtime sanitizer.  Deeper
+chains (two objects away) remain the sanitizer's half of the contract.
 """
 
 from __future__ import annotations
@@ -35,7 +40,7 @@ from typing import Iterable, Optional
 
 from ..engine import Finding, ModuleInfo, Rule
 from ._concurrency import (
-    class_locks, display_lock, module_locks, scan_function,
+    class_attr_types, class_locks, display_lock, module_locks, scan_function,
 )
 
 
@@ -56,6 +61,14 @@ class LockOrderRule(Rule):
         #: (src, dst) -> (relpath, line, via) — first site observed
         self._edges: dict[tuple[str, str], tuple[str, int, str]] = {}
         self._kinds: dict[str, str] = {}
+        #: class name -> method -> [lock ids acquired anywhere in the body]
+        #: (same-named classes in different modules merge conservatively —
+        #: a spurious union edge can only over-report, never miss a cycle)
+        self._class_acquires: dict[str, dict[str, list[str]]] = {}
+        #: deferred cross-object call sites, resolved in finalize once every
+        #: class's locks are known: (relpath, line, qualname, attr, method,
+        #: held, owner-class attr-type map)
+        self._attr_call_sites: list[tuple] = []
 
     # -- per module ----------------------------------------------------------
     def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
@@ -74,14 +87,26 @@ class LockOrderRule(Rule):
             locks = class_locks(cls)
             for attr, kind in locks.items():
                 self._kinds[f"{mod.relpath}::{cls.name}.{attr}"] = kind
+            attr_types = class_attr_types(cls)
             methods = [n for n in cls.body
                        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
             scans = {m.name: _FnInfo(m.name, scan_function(
                 m, locks, mlocks, mod.relpath, cls.name), m.lineno)
                 for m in methods}
+            acq_by_method = self._class_acquires.setdefault(cls.name, {})
             for info in scans.values():
-                self._collect(mod, info.scan, scans,
-                              f"{cls.name}.{info.name}", findings)
+                acq_by_method.setdefault(info.name, []).extend(
+                    a.lock for a in info.scan.acquires)
+                qualname = f"{cls.name}.{info.name}"
+                self._collect(mod, info.scan, scans, qualname, findings)
+                # cross-object one-hop: self.<attr>.<m>() while holding a
+                # lock — resolution deferred to finalize (the callee class
+                # may live in a module not yet parsed)
+                for call in info.scan.attr_calls:
+                    if call.held:
+                        self._attr_call_sites.append(
+                            (mod.relpath, call.line, qualname, call.attr,
+                             call.method, call.held, attr_types))
         return findings
 
     def _collect(self, mod: ModuleInfo, scan, peer_scans: dict,
@@ -147,6 +172,20 @@ class LockOrderRule(Rule):
 
     # -- cross-module: cycle detection ---------------------------------------
     def finalize(self, modules) -> Iterable[Finding]:
+        # resolve the deferred cross-object hops now that every class's lock
+        # acquisitions are known: held lock -> each lock the callee method
+        # takes (one object hop only; aliased class names merge, which can
+        # only add edges)
+        for relpath, line, qualname, attr, method, held, attr_types in self._attr_call_sites:
+            cls_name = attr_types.get(attr)
+            if cls_name is None:
+                continue
+            for callee_lock in self._class_acquires.get(cls_name, {}).get(method, []):
+                for h in held:
+                    if h != callee_lock:
+                        self._edges.setdefault(
+                            (h, callee_lock),
+                            (relpath, line, f"{qualname} -> {attr}.{method}()"))
         adj: dict[str, set[str]] = {}
         for (src, dst) in self._edges:
             adj.setdefault(src, set()).add(dst)

@@ -63,13 +63,36 @@ CHUNK_FRAMES = obsreg.REGISTRY.counter(
     "Transport chunk frames fed to the per-peer stream assembler.",
 )
 
-#: transient decode failures are retried this many times with linear backoff
+#: transient decode failures are retried this many times with capped
+#: exponential backoff + deterministic jitter (see :func:`backoff_delay`)
 DECODE_RETRY_LIMIT = 3
-DECODE_RETRY_BACKOFF_S = 0.2
+DECODE_RETRY_BACKOFF_S = 0.2   # base of the exponential schedule
+DECODE_RETRY_CAP_S = 2.0       # ceiling of the exponential schedule
 
 #: a chunked upload whose sender dies mid-stream is evicted (and metered as
-#: a drop attributed to that sender) after this long without a new chunk
+#: a drop attributed to that sender) after this long without a new chunk —
+#: the DEFAULT; ``extra.comm_chunk_idle_sweep_s`` overrides per run (the
+#: FedMLCommManager threads it through ``configure_chunk_sweep``)
 CHUNK_STREAM_TIMEOUT_S = 120.0
+
+
+def backoff_delay(attempt: int, *, base: float = DECODE_RETRY_BACKOFF_S,
+                  cap: float = DECODE_RETRY_CAP_S, seed: int = 0) -> float:
+    """Capped exponential backoff with DETERMINISTIC jitter.
+
+    ``base * 2**attempt`` clipped at ``cap``, scaled by a jitter factor in
+    ``[0.5, 1.0)`` drawn from ``default_rng([seed, attempt])`` — so N peers
+    retrying the same flaky dependency de-synchronize (different seeds)
+    while any single schedule is exactly reproducible (same seed, same
+    attempt → same delay, the property the chaos soak's determinism
+    assertions rely on).  Replaces the old linear ``base * (attempt+1)``
+    schedule, whose waits grew too slowly to ride out a multi-second
+    object-store brownout within DECODE_RETRY_LIMIT attempts."""
+    import numpy as np
+
+    raw = min(float(cap), float(base) * (2.0 ** int(attempt)))
+    frac = float(np.random.default_rng([int(seed), int(attempt)]).random())
+    return raw * (0.5 + 0.5 * frac)
 
 #: process-wide comm event sinks ``fn(event, **info)`` for the drop/retry
 #: signals the counters above aggregate — the client health ledger
@@ -125,6 +148,16 @@ class ObserverLoopMixin:
         # per-peer reassembly of transport chunk frames (lazily built: the
         # unchunked protocol never pays for it)
         self._chunk_assembler = None
+        self._chunk_sweep_s = CHUNK_STREAM_TIMEOUT_S
+
+    def configure_chunk_sweep(self, seconds: float) -> None:
+        """Set the idle-stream eviction timeout (``extra.
+        comm_chunk_idle_sweep_s``); applies to streams opened after the call
+        — configure before the receive loop starts, as FedMLCommManager
+        does."""
+        self._chunk_sweep_s = float(seconds)
+        if self._chunk_assembler is not None:
+            self._chunk_assembler.stream_timeout_s = float(seconds)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -166,7 +199,7 @@ class ObserverLoopMixin:
                 # incrementally, and only the FINAL chunk yields a Message
                 CHUNK_FRAMES.inc()
                 if self._chunk_assembler is None:
-                    self._chunk_assembler = ChunkAssembler(CHUNK_STREAM_TIMEOUT_S)
+                    self._chunk_assembler = ChunkAssembler(self._chunk_sweep_s)
                 msg, err, sender = self._chunk_assembler.feed(data)
                 if err is not None:
                     MSG_DROPPED.inc(reason=err)
@@ -203,7 +236,7 @@ class ObserverLoopMixin:
                         attempts + 1, exc_info=True,
                     )
                     retry_pending.append((
-                        time.monotonic() + DECODE_RETRY_BACKOFF_S * (attempts + 1),
+                        time.monotonic() + backoff_delay(attempts),
                         data, attempts + 1,
                     ))
                 else:

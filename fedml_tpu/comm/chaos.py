@@ -1,0 +1,309 @@
+"""Deterministic chaos injection at the comm boundary (ISSUE 10).
+
+The only fault model the framework ever exercised was the async soak's
+seeded upload drops — injected inside the test harness, invisible to the
+transports.  This module makes partial failure a FIRST-CLASS, reproducible
+property of any comm backend: a :class:`ChaosCommManager` wraps the real
+manager (in-proc, gRPC, TCP, MQTT — anything speaking
+:class:`~fedml_tpu.comm.base.BaseCommunicationManager`) and applies a
+seeded per-peer fault schedule to every send:
+
+====================  =====================================================
+fault                 observable effect
+====================  =====================================================
+``drop``              the frame silently vanishes (sender sees success)
+``delay``             delivered late (uniform in (0, chaos_delay_max_s])
+``duplicate``         delivered twice (at-least-once redelivery)
+``reorder``           held back, delivered AFTER the next frame to the peer
+``corrupt``           ships truncated — must die in the receive loop's
+                      undecodable-drop path, never in a handler
+``reset``             ``ConnectionResetError`` raised at the sender
+``partition``         every send in a timed window fails like ``reset``
+====================  =====================================================
+
+**Determinism is the point.**  Each decision draws from
+``default_rng([seed, sender_rank, receiver, nonce])`` where ``nonce`` is the
+per-receiver send ordinal — so the same seed over the same message sequence
+reproduces the same fault schedule exactly (the kill-and-recover soak's
+reproducibility assertion), and two endpoints with the same seed still see
+independent schedules.  Every injection lands in
+``fedml_chaos_injected_total{fault=...}`` and in the wrapper's local
+``schedule`` list (the test-facing record).
+
+Gated entirely on the ``extra.chaos_*`` flags: all probabilities zero and no
+partition window means :func:`wrap_with_chaos` returns the inner manager
+UNTOUCHED — no wrapper object, no per-send rng, wire bytes byte-identical to
+the chaos-free build.
+
+Thread model (GL008-audited): ``send_message`` may be called from the
+receive loop, watchdog timers, and the caller's thread; the nonce counter
+and reorder hold-back slots mutate under ``_lock``, while actual transport
+sends run OUTSIDE it (a slow peer must not serialize the other threads'
+fault rolls).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core.flags import cfg_extra
+from ..obs import registry as obsreg
+from .base import BaseCommunicationManager
+from .message import Message
+
+log = logging.getLogger("fedml_tpu.comm.chaos")
+
+__all__ = ["ChaosConfig", "ChaosCommManager", "chaos_from_config",
+           "wrap_with_chaos"]
+
+CHAOS_INJECTED = obsreg.REGISTRY.counter(
+    "fedml_chaos_injected_total",
+    "Faults injected by the chaos comm wrapper, by fault kind.",
+    labels=("fault",),
+)
+CHAOS_SENDS = obsreg.REGISTRY.counter(
+    "fedml_chaos_sends_total",
+    "Sends that passed through the chaos wrapper (faulted or clean).",
+)
+
+#: faults whose frame reaches no handler — the sender believes it sent, the
+#: receiver never dispatches it (corrupt frames die in the receive loop's
+#: drop path); these are the losses the redispatch watchdog must recover
+SILENT_LOSS_FAULTS = ("drop", "corrupt", "partition_lost")
+
+
+class ChaosConfig:
+    """Parsed ``extra.chaos_*`` flags.  ``from_config`` returns ``None``
+    when every probability is zero and no partition window is set — the
+    gate that keeps the default path bit-identical."""
+
+    __slots__ = ("seed", "drop", "delay", "delay_max_s", "duplicate",
+                 "reorder", "corrupt", "reset", "partition")
+
+    def __init__(self, *, seed: int = 0, drop: float = 0.0, delay: float = 0.0,
+                 delay_max_s: float = 0.05, duplicate: float = 0.0,
+                 reorder: float = 0.0, corrupt: float = 0.0,
+                 reset: float = 0.0,
+                 partition: Optional[tuple[float, float]] = None):
+        self.seed = int(seed)
+        self.drop = float(drop)
+        self.delay = float(delay)
+        self.delay_max_s = float(delay_max_s)
+        self.duplicate = float(duplicate)
+        self.reorder = float(reorder)
+        self.corrupt = float(corrupt)
+        self.reset = float(reset)
+        self.partition = partition  # (start_s, duration_s) after manager start
+
+    @classmethod
+    def from_config(cls, cfg: Any) -> Optional["ChaosConfig"]:
+        if cfg is None:
+            return None
+        part_spec = cfg_extra(cfg, "chaos_partition")
+        partition = None
+        if part_spec:
+            try:
+                start_s, dur_s = (float(x) for x in str(part_spec).split(":"))
+                partition = (start_s, dur_s)
+            except ValueError:
+                raise ValueError(
+                    f"chaos_partition must be 'start_s:duration_s', got "
+                    f"{part_spec!r}") from None
+        obj = cls(
+            seed=int(cfg_extra(cfg, "chaos_seed")),
+            drop=float(cfg_extra(cfg, "chaos_drop_prob")),
+            delay=float(cfg_extra(cfg, "chaos_delay_prob")),
+            delay_max_s=float(cfg_extra(cfg, "chaos_delay_max_s")),
+            duplicate=float(cfg_extra(cfg, "chaos_duplicate_prob")),
+            reorder=float(cfg_extra(cfg, "chaos_reorder_prob")),
+            corrupt=float(cfg_extra(cfg, "chaos_corrupt_prob")),
+            reset=float(cfg_extra(cfg, "chaos_reset_prob")),
+            partition=partition,
+        )
+        if not obj.active():
+            return None
+        return obj
+
+    def active(self) -> bool:
+        return bool(self.partition) or any(
+            p > 0.0 for p in (self.drop, self.delay, self.duplicate,
+                              self.reorder, self.corrupt, self.reset))
+
+
+class ChaosCommManager(BaseCommunicationManager):
+    """Seeded fault-injecting decorator over any comm backend (module doc).
+
+    Unknown attributes delegate to the inner manager, so transport-specific
+    surface (``configure_chunk_sweep``, ``router``, ports) keeps working
+    through the wrapper.
+    """
+
+    def __init__(self, inner: BaseCommunicationManager, chaos: ChaosConfig,
+                 rank: int):
+        self.inner = inner
+        self.chaos = chaos
+        self.rank = int(rank)
+        self._lock = threading.Lock()
+        self._nonce: dict[int, int] = {}
+        self._held: dict[int, Message] = {}
+        self._t0 = time.monotonic()
+        #: deterministic injection record: (fault, receiver, nonce) — the
+        #: reproducibility tests and the soak's accounting identity read it
+        self.schedule: list[tuple[str, int, int]] = []
+        self.injected: dict[str, int] = {}
+
+    # -- bookkeeping ----------------------------------------------------------
+    def _note(self, fault: str, rid: int, nonce: int) -> None:
+        CHAOS_INJECTED.inc(fault=fault)
+        with self._lock:
+            self.schedule.append((fault, rid, nonce))
+            self.injected[fault] = self.injected.get(fault, 0) + 1
+
+    def silent_losses(self) -> int:
+        """Frames no handler will ever see (drop/corrupt/partition-lost) —
+        the quantity the recovery accounting identity charges against
+        redispatches + rejected-stale + tracked in-flight."""
+        with self._lock:
+            return sum(self.injected.get(f, 0) for f in SILENT_LOSS_FAULTS)
+
+    def _in_partition(self) -> bool:
+        if not self.chaos.partition:
+            return False
+        start_s, dur_s = self.chaos.partition
+        dt = time.monotonic() - self._t0
+        return start_s <= dt < start_s + dur_s
+
+    # -- the fault schedule ---------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        rid = int(msg.get_receiver_id())
+        with self._lock:
+            self._nonce[rid] = nonce = self._nonce.get(rid, 0) + 1
+            held = self._held.pop(rid, None)
+        CHAOS_SENDS.inc()
+        rng = np.random.default_rng(
+            [self.chaos.seed, self.rank, rid, nonce])
+        # one roll per fault class, drawn in a FIXED order so the schedule
+        # is a pure function of (seed, sender, receiver, nonce)
+        rolls = rng.random(6)
+        try:
+            if self._in_partition():
+                # the network is down: the held frame (already "accepted"
+                # from its caller's perspective) is lost silently; the
+                # current send fails loudly like a reset would
+                if held is not None:
+                    self._note("partition_lost", rid, nonce)
+                self._note("partition", rid, nonce)
+                raise ConnectionResetError(
+                    f"chaos: partition window active (peer {rid})")
+            if rolls[0] < self.chaos.reset:
+                self._note("reset", rid, nonce)
+                raise ConnectionResetError(f"chaos: connection reset (peer {rid})")
+            if rolls[1] < self.chaos.drop:
+                self._note("drop", rid, nonce)
+                return
+            if rolls[2] < self.chaos.corrupt:
+                self._note("corrupt", rid, nonce)
+                self._send_corrupt(msg, rid, rng)
+                return
+            if rolls[3] < self.chaos.duplicate:
+                self._note("duplicate", rid, nonce)
+                self.inner.send_message(msg)
+                self.inner.send_message(msg)
+                return
+            if rolls[4] < self.chaos.reorder:
+                self._note("reorder", rid, nonce)
+                with self._lock:
+                    prev = self._held.get(rid)
+                    if prev is None:
+                        self._held[rid] = msg
+                        return
+                # a hold-back slot is already occupied: deliver normally
+                self.inner.send_message(msg)
+                return
+            if rolls[5] < self.chaos.delay:
+                self._note("delay", rid, nonce)
+                delay_s = float(rng.random()) * self.chaos.delay_max_s
+                t = threading.Timer(delay_s, self._send_late, args=(msg,))
+                t.daemon = True
+                t.start()
+                return
+            self.inner.send_message(msg)
+        finally:
+            # the held-back frame goes out AFTER the current one — that IS
+            # the reorder — unless the partition already claimed it
+            if held is not None and not self._in_partition():
+                try:
+                    self.inner.send_message(held)
+                except Exception:
+                    log.warning("chaos: flushing held frame to %d failed", rid,
+                                exc_info=True)
+
+    def _send_corrupt(self, msg: Message, rid: int, rng) -> None:
+        """Ship a TRUNCATED encoding of the frame so the receiver's decode
+        dies deterministically in the receive loop's drop path (header/
+        length validation) — never inside a handler.  Backends without a
+        raw-bytes send degrade to a drop (same observable: no dispatch)."""
+        send_raw = getattr(self.inner, "send_raw", None)
+        if send_raw is None:
+            return
+        data = msg.encode()
+        cut = max(1, int(len(data) * (0.25 + 0.5 * float(rng.random()))))
+        try:
+            send_raw(rid, bytes(data[:cut]))
+        except Exception:
+            log.warning("chaos: corrupt-frame send to %d failed", rid,
+                        exc_info=True)
+
+    def _send_late(self, msg: Message) -> None:
+        try:
+            self.inner.send_message(msg)
+        except Exception:
+            log.warning("chaos: delayed send failed", exc_info=True)
+
+    # -- passthrough ----------------------------------------------------------
+    def add_observer(self, observer) -> None:
+        self.inner.add_observer(observer)
+
+    def remove_observer(self, observer) -> None:
+        self.inner.remove_observer(observer)
+
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        # best-effort flush of reorder hold-backs so a clean shutdown does
+        # not strand the last frame of a stream
+        with self._lock:
+            held = list(self._held.items())
+            self._held.clear()
+        for _rid, msg in held:
+            try:
+                self.inner.send_message(msg)
+            except Exception:
+                pass
+        self.inner.stop_receive_message()
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+
+def chaos_from_config(cfg: Any) -> Optional[ChaosConfig]:
+    return ChaosConfig.from_config(cfg)
+
+
+def wrap_with_chaos(inner: BaseCommunicationManager, cfg: Any,
+                    rank: int) -> BaseCommunicationManager:
+    """The one gate: no ``chaos_*`` flag set → ``inner`` returned untouched
+    (no wrapper, byte-identical traffic); any fault enabled → the seeded
+    wrapper."""
+    chaos = chaos_from_config(cfg)
+    if chaos is None:
+        return inner
+    log.info("chaos: wrapping %s (rank %d, seed %d)",
+             type(inner).__name__, rank, chaos.seed)
+    return ChaosCommManager(inner, chaos, rank)

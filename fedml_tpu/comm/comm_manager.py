@@ -27,6 +27,17 @@ class FedMLCommManager(Observer):
         self.backend = backend or getattr(cfg, "backend", C.COMM_BACKEND_INPROC)
         self.message_handler_dict: dict[int, Callable[[Message], None]] = {}
         self.com_manager: BaseCommunicationManager = self._init_manager()
+        # deterministic chaos injection (comm/chaos.py): any extra.chaos_*
+        # fault enabled wraps the backend in the seeded fault scheduler; all
+        # unset -> the backend object itself, byte-identical traffic
+        from .chaos import wrap_with_chaos
+
+        self.com_manager = wrap_with_chaos(self.com_manager, cfg, rank)
+        # idle chunk-stream eviction timeout (extra.comm_chunk_idle_sweep_s);
+        # configured before the receive loop starts
+        if hasattr(self.com_manager, "configure_chunk_sweep"):
+            self.com_manager.configure_chunk_sweep(
+                float(cfg_extra(cfg, "comm_chunk_idle_sweep_s")))
         self.com_manager.add_observer(self)
 
     # -- reference API shape -------------------------------------------------

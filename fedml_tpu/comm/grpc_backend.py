@@ -109,6 +109,18 @@ class GRPCCommManager(ObserverLoopMixin, BaseCommunicationManager):
         else:
             stub(payload, timeout=60.0)
 
+    def send_raw(self, receiver_id: int, payload: bytes) -> None:
+        """One raw unary call to a peer, bypassing Message encode — the
+        chaos wrapper's corrupt-frame injection point."""
+        rid = int(receiver_id)
+        if rid not in self._channels:
+            self._channels[rid] = grpc.insecure_channel(
+                self._target_for(rid), options=_GRPC_OPTS)
+        stub = self._channels[rid].unary_unary(
+            SERVICE_METHOD, request_serializer=_identity,
+            response_deserializer=_identity)
+        stub(payload, timeout=60.0)
+
     def stop_receive_message(self) -> None:
         super().stop_receive_message()
         self._server.stop(grace=0.2)

@@ -68,3 +68,9 @@ class InProcCommManager(ObserverLoopMixin, BaseCommunicationManager):
 
     def send_message(self, msg: Message) -> None:
         self.router.route(msg)
+
+    def send_raw(self, receiver_id: int, payload: bytes) -> None:
+        """Deliver raw frame bytes to a peer's inbox, bypassing the Message
+        round trip — the chaos wrapper's corrupt-frame injection point (a
+        real transport would deliver torn bytes exactly like this)."""
+        self.router.queues[receiver_id].put(payload)

@@ -117,6 +117,14 @@ class TCPCommManager(ObserverLoopMixin, BaseCommunicationManager):
             else:
                 send_frame(s, payload)
 
+    def send_raw(self, receiver_id: int, payload: bytes) -> None:
+        """One raw frame to a peer, bypassing Message encode — the chaos
+        wrapper's corrupt-frame injection point."""
+        host = self.ip_config.get(int(receiver_id), "127.0.0.1")
+        with socket.create_connection(
+                (host, self.base_port + int(receiver_id)), timeout=30.0) as s:
+            send_frame(s, payload)
+
     def stop_receive_message(self) -> None:
         super().stop_receive_message()
         try:

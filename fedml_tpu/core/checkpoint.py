@@ -9,12 +9,16 @@ which is table stakes on TPU pods.
 
 from __future__ import annotations
 
+import logging
 import os
+import shutil
 from pathlib import Path
 from typing import Any, Optional
 
 import jax
 import numpy as np
+
+log = logging.getLogger("fedml_tpu.core.checkpoint")
 
 
 class RoundCheckpointer:
@@ -36,11 +40,45 @@ class RoundCheckpointer:
         self.mngr.save(round_idx, args=self._ocp.args.StandardSave(state))
         self.mngr.wait_until_finished()
 
+    def _step_intact(self, step: int) -> bool:
+        """Integrity probe of one step: every array/metadata file orbax
+        committed must still be readable.  A crash can leave the LATEST step
+        truncated (the commit marker landed but a tensor file did not flush
+        fully on a hard kill) — mirroring the AOT store's corrupt-entry
+        semantics, such a step is discarded rather than served.  The probe
+        restores with template-less StandardRestore args: a FRESH manager
+        (the recovery case) has no handler registered for a bare restore."""
+        try:
+            self.mngr.restore(step, args=self._ocp.args.StandardRestore())
+            return True
+        except Exception as e:  # orbax raises transport-specific types
+            log.warning("checkpoint step %s under %s is unreadable (%s: %s) — "
+                        "discarding and falling back to the previous step",
+                        step, self.directory, type(e).__name__, e)
+            return False
+
+    def _discard_step(self, step: int) -> None:
+        for name in (str(step), f"{step}"):
+            p = self.directory / name
+            if p.exists():
+                shutil.rmtree(p, ignore_errors=True)
+        try:
+            self.mngr.reload()
+        except Exception:
+            pass
+
     def latest_round(self) -> Optional[int]:
-        return self.mngr.latest_step()
+        """Newest INTACT step (corrupt/partial steps are discarded so a
+        truncated latest checkpoint falls back to the previous good one)."""
+        steps = sorted(self.mngr.all_steps(), reverse=True)
+        for step in steps:
+            if self._step_intact(step):
+                return step
+            self._discard_step(step)
+        return None
 
     def restore(self, round_idx: Optional[int] = None, template: Optional[dict] = None) -> dict:
-        step = round_idx if round_idx is not None else self.mngr.latest_step()
+        step = round_idx if round_idx is not None else self.latest_round()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
         if template is not None:

@@ -138,6 +138,39 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "frames that interleave at the socket level (receivers "
              "reassemble + decode incrementally per peer); 0 = one frame "
              "per message, byte-identical to the unchunked protocol."),
+    FlagSpec("comm_chunk_idle_sweep_s", "float", 120.0,
+             "Idle timeout for a partially assembled chunk stream: a sender "
+             "that dies mid-upload has its stream evicted (a metered, "
+             "sender-attributed drop) after this long without a new chunk."),
+    # -- deterministic chaos injection (fedml_tpu/comm/chaos.py) --------------
+    FlagSpec("chaos_seed", "int", 0,
+             "Seed of the deterministic per-peer fault schedule; the same "
+             "seed over the same message sequence reproduces the same "
+             "faults exactly."),
+    FlagSpec("chaos_drop_prob", "float", 0.0,
+             "Per-send probability a message silently vanishes on the wire."),
+    FlagSpec("chaos_delay_prob", "float", 0.0,
+             "Per-send probability a message is delivered late (uniform in "
+             "(0, chaos_delay_max_s])."),
+    FlagSpec("chaos_delay_max_s", "float", 0.05,
+             "Upper bound of an injected delivery delay."),
+    FlagSpec("chaos_duplicate_prob", "float", 0.0,
+             "Per-send probability a message is delivered twice (at-least-"
+             "once transport redelivery)."),
+    FlagSpec("chaos_reorder_prob", "float", 0.0,
+             "Per-send probability a message is held back and delivered "
+             "AFTER the next message to the same peer."),
+    FlagSpec("chaos_corrupt_prob", "float", 0.0,
+             "Per-send probability the encoded frame ships with flipped "
+             "bytes (must die in the receive loop's drop path, never in a "
+             "handler)."),
+    FlagSpec("chaos_reset_prob", "float", 0.0,
+             "Per-send probability the transport raises ConnectionResetError "
+             "instead of sending (the peer-gone failure senders must survive)."),
+    FlagSpec("chaos_partition", "str", None,
+             "Timed network partition as 'start_s:duration_s' after comm-"
+             "manager start: every send inside the window fails with "
+             "ConnectionResetError (unset = no partition)."),
     FlagSpec("grpc_base_port", "int", 8890, "gRPC backend rank-0 port."),
     FlagSpec("grpc_ip_config", "dict", None,
              "gRPC backend rank -> host mapping (unset = localhost)."),
@@ -171,6 +204,17 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "Async dispatch deadline: an upload not back within this many "
              "seconds counts a health breach and the work is re-issued to "
              "another client; 0 disables the watchdog."),
+    FlagSpec("server_journal_dir", "str", None,
+             "Durable server recovery journal directory: the cross-silo "
+             "servers (sync + buffered-async) atomically snapshot their full "
+             "protocol state at round boundaries and recover from it on "
+             "restart with a bumped session epoch (unset = no journal, "
+             "wire + aggregation bit-identical to before the flag existed)."),
+    FlagSpec("server_journal_keep", "int", 3,
+             "Journal snapshots retained on disk (older steps are pruned)."),
+    FlagSpec("server_journal_every_rounds", "int", 1,
+             "Snapshot cadence in (virtual) rounds; the final round is "
+             "always journaled."),
     FlagSpec("straggler_timeout_s", "float", 0.0,
              "Bounded-wait straggler deadline per round; 0 = wait forever."),
     FlagSpec("straggler_quorum_frac", "float", 0.5,

@@ -55,7 +55,7 @@ from ..obs.metrics import MetricsLogger
 from . import message_define as md
 from .server import (
     AGGREGATE_TIME, BUFFERED_PEAK, CLIENT_ROUND_TRIP, FedMLAggregator,
-    FedMLServerManager,
+    FedMLServerManager, REJECTED_STALE,
 )
 
 log = logging.getLogger("fedml_tpu.cross_silo.async_server")
@@ -113,6 +113,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
     buffer and dispatch ledger — every access runs under ``_agg_lock``.
     """
 
+    #: journal recovery runs at the END of this __init__ (the base-class
+    #: recover would fire before the async dispatch ledger exists)
+    _journal_recover_deferred = True
+
     def __init__(self, cfg, aggregator: FedMLAggregator, backend: Optional[str] = None,
                  logger: Optional[MetricsLogger] = None):
         super().__init__(cfg, aggregator, backend=backend, logger=logger)
@@ -144,21 +148,51 @@ class AsyncFedMLServerManager(FedMLServerManager):
         self.staleness_max = 0
         self.first_dispatch_monotonic: Optional[float] = None
         self.finished_monotonic: Optional[float] = None
+        # recovery (ISSUE 10): the journaled in-flight table at restart.
+        # _recovered_outstanding re-enters _outstanding when dispatching
+        # resumes (lost dispatches then re-issue through the existing
+        # watchdog); _prev_epoch_inflight is the ACCEPTANCE set for uploads
+        # still carrying the pre-crash epoch — a (client, version) pair in it
+        # was dispatched but never folded into the journaled state, so
+        # folding it once (with corrected staleness) cannot double-count.
+        # Anything else from the old epoch is rejected deterministically.
+        self._recovered_outstanding: dict[int, int] = {}
+        self._prev_epoch_inflight: dict[int, int] = {}
+        self._journal_recover()
 
     # -- protocol ------------------------------------------------------------
     def send_init_msg(self) -> None:
-        """All clients online: warm the program store, open the version-0
-        span, dispatch the initial concurrency wave, arm the watchdog."""
+        """All clients online: warm the program store, open the round span,
+        dispatch the initial concurrency wave, arm the watchdog.
+
+        A recovered server re-enters here at the journaled version: the
+        journaled in-flight table re-arms first (those dispatches were sent
+        pre-crash — their uploads may still arrive under the old epoch and
+        fold via ``_prev_epoch_inflight``, or never arrive and re-issue
+        through the existing redispatch watchdog), then ``_refill`` tops the
+        concurrency back up with new-epoch work."""
         with self._agg_lock:
             if self._init_sent:
                 return
             self._init_sent = True
+            if self.server_version >= self.comm_round:
+                # crash landed after the final virtual round's snapshot but
+                # before the FINISH broadcast: nothing left to fold
+                self._finished = True
+                self.finished_monotonic = time.monotonic()
+                self.send_finish()
+                return
             warm = self.aggregator.warm_programs()
             if warm is not None:
                 log.info("async server: program store warm %s", warm)
             self._round_span = obstrace.Span(
-                "round", round_idx=0, async_mode=True)
+                "round", round_idx=self.server_version, async_mode=True)
             self.first_dispatch_monotonic = time.monotonic()
+            if self._recovered_outstanding:
+                now = time.monotonic()
+                for cid, ver in self._recovered_outstanding.items():
+                    self._outstanding.setdefault(cid, (ver, now))
+                self._recovered_outstanding = {}
             self._refill()
             self._arm_watchdog()
 
@@ -172,6 +206,25 @@ class AsyncFedMLServerManager(FedMLServerManager):
             # materialize the tensor section and defeat the streaming fold
             client_version = int(msg.get_control(md.MSG_ARG_KEY_ROUND_INDEX,
                                                  self.server_version))
+            if self.journal is not None:
+                # session-epoch fence (recovery): an old-epoch upload folds
+                # EXACTLY ONCE iff its (client, version) survives in the
+                # journaled in-flight table — dispatched pre-crash, never
+                # folded into the recovered state; its staleness below is
+                # computed against the RECOVERED version (corrected decay).
+                # Everything else from the old epoch is rejected: the work it
+                # carries is either already in the journal or unattributable.
+                epoch = int(msg.get_control(
+                    md.MSG_ARG_KEY_SESSION_EPOCH, self.session_epoch))
+                if epoch != self.session_epoch:
+                    accept = (epoch == self.session_epoch - 1
+                              and self._prev_epoch_inflight.get(sender)
+                              == client_version)
+                    if not accept:
+                        self.rejected_stale += 1
+                        REJECTED_STALE.inc(reason="epoch")
+                        return
+                    del self._prev_epoch_inflight[sender]
             staleness = max(0, self.server_version - client_version)
             sent_at = self._sent_at.pop(sender, None)
             if sent_at is not None:
@@ -247,6 +300,9 @@ class AsyncFedMLServerManager(FedMLServerManager):
         self.round_idx = self.server_version  # keep base-class reporting honest
         self._arrivals_in_round = 0
         self._round_staleness = []
+        # virtual-round boundary: the accumulator is freshly reset and the
+        # dispatch ledger is consistent — the journal's commit point
+        self._journal_snapshot()
         if self.server_version >= self.comm_round:
             self._finished = True
             self.finished_monotonic = time.monotonic()
@@ -274,6 +330,9 @@ class AsyncFedMLServerManager(FedMLServerManager):
         msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, self.aggregator._host_global())
         msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
         msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.server_version)
+        if self.journal is not None:
+            # recovery fence: the client echoes this epoch with its upload
+            msg.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, self.session_epoch)
         obstrace.inject(msg, self._round_span)
         try:
             self._sent_at[cid] = time.perf_counter()
@@ -345,6 +404,59 @@ class AsyncFedMLServerManager(FedMLServerManager):
             self._refill()
             self._arm_watchdog()
 
+    # -- recovery journal ------------------------------------------------------
+    def _journal_recover(self) -> None:  # graftlint: disable=GL004(construction-time: runs from __init__ before the receive loop or any timer thread exists)
+        """Install the newest intact journal snapshot: server version, model
+        + server state, dispatch ledger (in-flight table, round-robin cursor,
+        throttle set), streaming partials, staleness cursors, health scores,
+        and run accounting — then resume under a bumped session epoch."""
+        if self.journal is None:
+            return
+        snap = self.journal.restore(model_template=self.aggregator.model_state())
+        if snap is None:
+            return
+        p = snap["protocol"]
+        self.session_epoch = int(p.get("session_epoch", 0)) + 1
+        self.server_version = int(p.get("server_version", 0))
+        self.round_idx = self.server_version
+        self.recovered_step = int(snap["step"])
+        self._rr_cursor = int(p.get("rr_cursor", 0))
+        self._ever_dispatched = {int(c) for c in p.get("ever_dispatched", [])}
+        self._throttled = {int(c) for c in p.get("throttled", [])}
+        self.total_arrivals = int(p.get("total_arrivals", 0))
+        self.timeout_redispatches = int(p.get("timeout_redispatches", 0))
+        self.rejected_stale = int(p.get("rejected_stale", 0))
+        self.staleness_sum = int(p.get("staleness_sum", 0))
+        self.staleness_max = int(p.get("staleness_max", 0))
+        self._recovered_outstanding = {
+            int(c): int(v) for c, v in (p.get("outstanding") or {}).items()}
+        self._prev_epoch_inflight = dict(self._recovered_outstanding)
+        if snap["model"] is not None:
+            self.aggregator.restore_model_state(snap["model"])
+        self.aggregator.restore_stream_state(p, snap["arrays"])
+        self.health.import_state(p.get("health") or {})
+        log.info("recovered from journal step %d (version %d, session epoch "
+                 "%d, %d in-flight re-armed)", self.recovered_step,
+                 self.server_version, self.session_epoch,
+                 len(self._recovered_outstanding))
+
+    def _journal_protocol_state(self) -> dict:  # graftlint: disable=GL004(caller holds _agg_lock: _journal_snapshot runs at the locked virtual-round boundary)
+        return {
+            "kind": "async", "session_epoch": self.session_epoch,
+            "server_version": self.server_version, "round_idx": self.round_idx,
+            "outstanding": {str(c): int(v)
+                            for c, (v, _t) in sorted(self._outstanding.items())},
+            "throttled": sorted(self._throttled),
+            "ever_dispatched": sorted(self._ever_dispatched),
+            "rr_cursor": int(self._rr_cursor),
+            "total_arrivals": int(self.total_arrivals),
+            "timeout_redispatches": int(self.timeout_redispatches),
+            "rejected_stale": int(self.rejected_stale),
+            "staleness_sum": int(self.staleness_sum),
+            "staleness_max": int(self.staleness_max),
+            "health": self.health.export_state(),
+        }
+
     # -- teardown ------------------------------------------------------------
     def finish(self) -> None:  # graftlint: disable=GL004(single boolean latch + timer handle; runs under _agg_lock when reached via send_finish, bare on the timeout path — both orders are safe because _finished only ever flips False->True),GL008(same invariant: taking _agg_lock here would self-deadlock on the send_finish path, and the worst bare-path outcome is one extra watchdog fire that re-checks _finished under the lock and exits)
         self._finished = True
@@ -353,6 +465,20 @@ class AsyncFedMLServerManager(FedMLServerManager):
         if w is not None:
             w.cancel()
         super().finish()
+
+    def hard_kill(self) -> None:  # graftlint: disable=GL004(crash simulation: deliberately lock-free — a SIGKILL takes no locks either; every surviving thread re-checks state under _agg_lock and exits),GL008(same invariant)
+        """Crash simulation for the chaos harness: stop the receive loop and
+        watchdog ABRUPTLY — no finish broadcast, no journal write, no
+        teardown bookkeeping.  Everything not already committed to the
+        journal is lost, exactly like a SIGKILL; only the process (which a
+        real SIGKILL would reclaim) stays alive for the test to inspect."""
+        self._finished = True
+        for timer in (self._watchdog, self._status_timer):
+            if timer is not None:
+                timer.cancel()
+        self._watchdog = None
+        self._status_timer = None
+        self.com_manager.stop_receive_message()
 
     # -- accounting (soak harness / bench) ------------------------------------
     def async_summary(self) -> dict:
@@ -370,7 +496,11 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 "staleness_mean": round(self.staleness_sum / max(1, self.total_arrivals), 4),
                 "staleness_max": self.staleness_max,
                 "timeout_redispatches": self.timeout_redispatches,
+                "rejected_stale": self.rejected_stale,
+                "recovered_step": self.recovered_step,
+                "session_epoch": self.session_epoch,
                 "outstanding_at_end": len(self._outstanding),
+                "prev_epoch_inflight_at_end": len(self._prev_epoch_inflight),
                 "throttled_at_end": len(self._throttled),
                 "wall_s": round(wall, 4) if wall is not None else None,
                 "versions_per_sec": (round(self.server_version / wall, 4)

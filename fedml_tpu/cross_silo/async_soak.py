@@ -159,6 +159,10 @@ class _SimulatedFleet:
             elif mtype in (md.MSG_TYPE_S2C_INIT_CONFIG,
                            md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT):
                 version = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX, 0))
+                # session epoch (recovery fence): echoed back like a real
+                # client — control-only read so 10k simulated clients never
+                # pay a tensor decode
+                epoch = msg.get_control(md.MSG_ARG_KEY_SESSION_EPOCH)
                 with self._lock:
                     self._nonce += 1
                     nonce = self._nonce
@@ -170,7 +174,8 @@ class _SimulatedFleet:
                 latency = float(rng.lognormal(self.mu, self.sigma))
                 with self._cond:
                     heapq.heappush(self._heap,
-                                   (time.monotonic() + latency, nonce, rid, version))
+                                   (time.monotonic() + latency, nonce, rid,
+                                    version, epoch))
                     self._cond.notify()
             # FINISH needs no ack in the soak
 
@@ -183,10 +188,11 @@ class _SimulatedFleet:
                     self._cond.wait(timeout=max(0.001, min(wait, 0.2)))
                 if self._stop:
                     return
-                _due, nonce, rid, version = heapq.heappop(self._heap)
-            self._send_reply(rid, version, nonce)
+                _due, nonce, rid, version, epoch = heapq.heappop(self._heap)
+            self._send_reply(rid, version, nonce, epoch)
 
-    def _send_reply(self, rid: int, version: int, nonce: int) -> None:
+    def _send_reply(self, rid: int, version: int, nonce: int,
+                    epoch=None) -> None:
         import jax
 
         from ..comm.message import Message
@@ -202,6 +208,8 @@ class _SimulatedFleet:
         reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
         reply.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, float(16 + (rid % 7) * 8))
         reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, version)
+        if epoch is not None:
+            reply.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, int(epoch))
         try:
             self.router.route(reply)
         except Exception:
@@ -210,28 +218,12 @@ class _SimulatedFleet:
             self.replies_sent += 1
 
 
-def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64,
-             versions: int = 20, staleness_exponent: float = 0.5,
-             drop_prob: float = 0.02, latency_mean_s: float = 0.005,
-             latency_sigma: float = 1.0, redispatch_timeout_s: float = 2.0,
-             seed: int = 0, workers: int = 4, timeout_s: float = 600.0) -> dict:
-    """Drive one buffered-async server to ``versions`` virtual rounds under
-    ``n_clients`` simulated clients; returns the accounting dict (versions/s,
-    staleness stats, fold-lag p50/p95, peak buffered updates, drop/retry
-    accounting)."""
-    import jax
-
-    import fedml_tpu
+def _soak_config(run_id: str, n_clients: int, concurrency: int, buffer_k: int,
+                 versions: int, staleness_exponent: float,
+                 redispatch_timeout_s: float, extra_flags: Optional[dict] = None):
     from fedml_tpu.arguments import Config
 
-    from ..comm.inproc import InProcRouter
-    from ..data import loader
-    from ..models import model_hub
-    from . import build_server, message_define as md
-    from .async_server import FOLD_LAG, STALENESS
-
-    run_id = f"soak_async_{seed}_{n_clients}_{versions}"
-    cfg = Config(
+    return Config(
         training_type="cross_silo", dataset="synthetic", model="lr",
         client_num_in_total=n_clients, client_num_per_round=concurrency,
         comm_round=versions, epochs=1, batch_size=16, learning_rate=0.1,
@@ -244,8 +236,38 @@ def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64
             "async_staleness_exponent": staleness_exponent,
             "async_concurrency": concurrency,
             "async_redispatch_timeout_s": redispatch_timeout_s,
+            **(extra_flags or {}),
         },
     )
+
+
+def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64,
+             versions: int = 20, staleness_exponent: float = 0.5,
+             drop_prob: float = 0.02, latency_mean_s: float = 0.005,
+             latency_sigma: float = 1.0, redispatch_timeout_s: float = 2.0,
+             seed: int = 0, workers: int = 4, timeout_s: float = 600.0,
+             journal_dir: Optional[str] = None) -> dict:
+    """Drive one buffered-async server to ``versions`` virtual rounds under
+    ``n_clients`` simulated clients; returns the accounting dict (versions/s,
+    staleness stats, fold-lag p50/p95, peak buffered updates, drop/retry
+    accounting).  ``journal_dir`` turns on the recovery journal WITHOUT any
+    kill — the bench's clean leg uses it so the recovery ratio isolates the
+    crash/chaos cost from the journal's per-round snapshot cost."""
+    import jax
+
+    import fedml_tpu
+
+    from ..comm.inproc import InProcRouter
+    from ..data import loader
+    from ..models import model_hub
+    from . import build_server, message_define as md
+    from .async_server import FOLD_LAG, STALENESS
+
+    run_id = f"soak_async_{seed}_{n_clients}_{versions}"
+    cfg = _soak_config(run_id, n_clients, concurrency, buffer_k, versions,
+                       staleness_exponent, redispatch_timeout_s,
+                       extra_flags=({"server_journal_dir": journal_dir}
+                                    if journal_dir else None))
     fedml_tpu.init(cfg)
     # the server only needs the dataset for its eval arrays + sample batch;
     # load it with a small client count so the partitioner never has to
@@ -320,3 +342,182 @@ def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64
         "comm_pressure": {"drops": server.health.comm_drops,
                           "retries": server.health.comm_retries},
     }
+
+
+#: default seeded chaos for the kill-and-recover soak: every fault class
+#: exercised on the server->client dispatch leg, mild enough that the
+#: watchdog keeps the run progressing
+DEFAULT_CHAOS_FLAGS = {
+    "chaos_drop_prob": 0.02,
+    "chaos_corrupt_prob": 0.01,
+    "chaos_duplicate_prob": 0.01,
+    "chaos_reorder_prob": 0.02,
+    "chaos_delay_prob": 0.05,
+    "chaos_delay_max_s": 0.002,
+}
+
+
+def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
+                          buffer_k: int = 16, versions: int = 8,
+                          kill_at_version: Optional[int] = None,
+                          staleness_exponent: float = 0.5,
+                          drop_prob: float = 0.02,
+                          latency_mean_s: float = 0.003,
+                          latency_sigma: float = 1.0,
+                          redispatch_timeout_s: float = 1.0, seed: int = 0,
+                          workers: int = 4, journal_dir: Optional[str] = None,
+                          chaos: Optional[dict] = None,
+                          timeout_s: float = 300.0) -> dict:
+    """Kill-and-recover soak (ISSUE 10): run the buffered-async server under
+    seeded chaos with the recovery journal on, HARD-KILL it mid-run (abrupt
+    receive-loop/watchdog teardown — the in-process equivalent of SIGKILL:
+    nothing past the last journal snapshot survives), restart it against the
+    same journal dir, and drive the SAME simulated fleet to completion.
+
+    The returned accounting proves the recovery invariants the dryrun/bench
+    assert: the restarted server resumes at the journaled version
+    (``recovered_version``, monotone continuity), completes all ``versions``,
+    and every silent loss (fleet upload drops + chaos drop/corrupt on the
+    dispatch leg) is accounted as a watchdog redispatch, a deterministic
+    stale-epoch rejection, a tracked in-flight slot, or a slot that was
+    in flight at the kill but past the last snapshot (``unaccounted`` == 0 —
+    nothing vanishes without a trail)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import fedml_tpu
+
+    from ..comm.chaos import ChaosCommManager
+    from ..comm.inproc import InProcRouter
+    from ..data import loader
+    from ..models import model_hub
+    from . import build_server, message_define as md
+
+    owns_journal = journal_dir is None
+    if owns_journal:
+        journal_dir = tempfile.mkdtemp(prefix="soak_journal_")
+    chaos_flags = dict(DEFAULT_CHAOS_FLAGS if chaos is None else chaos)
+    chaos_flags.setdefault("chaos_seed", seed)
+    kill_at = kill_at_version if kill_at_version is not None else max(1, versions // 2)
+
+    run_id = f"soak_killrec_{seed}_{n_clients}_{versions}"
+    cfg = _soak_config(run_id, n_clients, concurrency, buffer_k, versions,
+                       staleness_exponent, redispatch_timeout_s,
+                       extra_flags={"server_journal_dir": journal_dir,
+                                    **chaos_flags})
+    fedml_tpu.init(cfg)
+    ds_cfg = dataclasses.replace(cfg, client_num_in_total=8, client_num_per_round=8)
+    ds = loader.load(ds_cfg)
+    model = model_hub.create(ds_cfg, ds.class_num)
+
+    try:
+        InProcRouter.reset(run_id)
+        server_a = build_server(cfg, ds, model, backend="INPROC")
+        router = InProcRouter.get(run_id)
+        shared: queue.Queue = queue.Queue()
+        router.queues = _FanInQueues(shared, router.queues[0])
+
+        template = jax.device_get(server_a.aggregator.global_vars)
+        fleet = _SimulatedFleet(
+            router, md, template, drop_prob=drop_prob,
+            latency_mean_s=latency_mean_s, latency_sigma=latency_sigma,
+            seed=seed, workers=workers)
+        fleet.start(shared)
+
+        t0 = time.monotonic()
+        server_a.run_in_thread()
+        server_a.start()
+        # wait for the kill point (bare version read: an intentionally racy
+        # poll — the kill does not need a consistent snapshot, the journal
+        # provides one)
+        deadline = time.monotonic() + timeout_s
+        while server_a.server_version < kill_at:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"kill-recover soak never reached version {kill_at}: "
+                    f"{server_a.async_summary()}")
+            if server_a.done.is_set():
+                break  # tiny runs can finish before the poll sees kill_at
+            time.sleep(0.005)
+        a_summary = server_a.async_summary()
+        a_chaos = (server_a.com_manager.silent_losses()
+                   if isinstance(server_a.com_manager, ChaosCommManager) else 0)
+        t_kill = time.monotonic()
+        server_a.hard_kill()
+
+        # restart against the same journal: the constructor recovers
+        server_b = build_server(cfg, ds, model, backend="INPROC")
+        recovered_version = server_b.server_version
+        recovered_inflight = len(server_b._prev_epoch_inflight)
+        # journaled carry-over of the redispatch counter: B resumed it from
+        # the snapshot, so B's final value minus this is B's OWN work
+        recovered_redisp = server_b.timeout_redispatches
+        t_restart = time.monotonic()
+        server_b.run_in_thread()
+        server_b.start()
+        completed = server_b.done.wait(timeout_s)
+        t_done = time.monotonic()
+        b_summary = server_b.async_summary()
+        b_chaos = (server_b.com_manager.silent_losses()
+                   if isinstance(server_b.com_manager, ChaosCommManager) else 0)
+        peak = max(int(server_a.aggregator.peak_buffered_updates),
+                   int(server_b.aggregator.peak_buffered_updates))
+        server_b.finish()
+        fleet.stop(shared)
+        InProcRouter.reset(run_id)
+        if not completed:
+            raise RuntimeError(
+                f"recovered server did not reach {versions} versions in "
+                f"{timeout_s}s: {b_summary}, recovered_at={recovered_version}, "
+                f"kill_summary={a_summary}")
+
+        # -- the accounting identity ------------------------------------------
+        # silent losses: fleet-injected upload drops + chaos drop/corrupt on
+        # the dispatch leg (both lifetimes)
+        losses = fleet.drops_injected + a_chaos + b_chaos
+        # accounted: redispatches observed in BOTH lifetimes (A's kill-time
+        # truth + B's post-recovery delta over the journaled carry-over),
+        # stale-epoch rejections, still-tracked slots, and slots that were in
+        # flight at the kill but newer than the last snapshot (lost with the
+        # crash — visible here because the harness read A's table before
+        # killing it)
+        b_own_redisp = b_summary["timeout_redispatches"] - recovered_redisp
+        total_redisp = a_summary["timeout_redispatches"] + b_own_redisp
+        accounted = (total_redisp
+                     + b_summary["rejected_stale"]
+                     + b_summary["outstanding_at_end"]
+                     + b_summary["prev_epoch_inflight_at_end"]
+                     + max(0, a_summary["outstanding_at_end"] - recovered_inflight))
+        unaccounted = max(0, losses - accounted)
+        wall = (t_kill - t0) + (t_done - t_restart)
+        return {
+            "clients": n_clients,
+            "concurrency": concurrency,
+            "buffer_k": buffer_k,
+            "versions": b_summary["server_version"],
+            "versions_at_kill": a_summary["server_version"],
+            "recovered_version": recovered_version,
+            "recovered_inflight": recovered_inflight,
+            "session_epoch": b_summary["session_epoch"],
+            "monotone": (0 < recovered_version <= a_summary["server_version"]
+                         <= b_summary["server_version"]),
+            "arrivals": b_summary["arrivals"],
+            "wall_s": round(wall, 4),
+            "versions_per_sec": round(b_summary["server_version"] / max(wall, 1e-9), 4),
+            "fleet_drops_injected": fleet.drops_injected,
+            "chaos_silent_losses": a_chaos + b_chaos,
+            "timeout_redispatches": total_redisp,
+            "rejected_stale": b_summary["rejected_stale"],
+            "outstanding_at_end": b_summary["outstanding_at_end"],
+            "prev_epoch_inflight_at_end": b_summary["prev_epoch_inflight_at_end"],
+            "lost_inflight_at_kill": max(
+                0, a_summary["outstanding_at_end"] - recovered_inflight),
+            "unaccounted": unaccounted,
+            "peak_buffered_updates": peak,
+            "journal_dir": journal_dir,
+        }
+    finally:
+        if owns_journal:
+            shutil.rmtree(journal_dir, ignore_errors=True)

@@ -17,6 +17,7 @@ import contextlib
 import logging
 import math
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -42,6 +43,14 @@ log = logging.getLogger("fedml_tpu.cross_silo.client")
 # training is serialized within the process; single-device trainers
 # (dp_active=False) are unaffected.
 _DP_TRAIN_LOCK = threading.Lock()
+
+#: reconnect/resume handshake (ISSUE 10): a send that fails because the
+#: server is mid-restart retries with capped exponential backoff +
+#: deterministic jitter (comm.base.backoff_delay) before the upload is
+#: abandoned to the server's redispatch watchdog
+RECONNECT_TRIES = 5
+RECONNECT_BASE_S = 0.05
+RECONNECT_CAP_S = 2.0
 
 
 def _leaf_delta(new, old):
@@ -165,6 +174,12 @@ class ClientMasterManager(FedMLCommManager):
         self.seed_key = rng.root_key(cfg.random_seed)
         self.done = threading.Event()
         self.rounds_trained = 0
+        # reconnect/resume bookkeeping (ISSUE 10): the server's session epoch
+        # rides every dispatch when its recovery journal is on; an epoch bump
+        # means the server restarted — count it, echo the DISPATCH's epoch in
+        # the reply (acceptance is about which dispatch produced the work)
+        self._last_epoch: Optional[int] = None
+        self.server_restarts_seen = 0
         # compressed uploads (extra.comm_compression: qsgd8 | topk): the
         # reply carries the DELTA vs the received global model, compressed
         # per-leaf on the wire-v2 format; the top-k error-feedback residual
@@ -245,6 +260,17 @@ class ClientMasterManager(FedMLCommManager):
 
     def _train_and_send(self, msg: Message) -> None:
         round_idx = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
+        # session epoch (control-only read: absent on a journal-less server,
+        # and materializing tensors here would be wasted work) — echoed back
+        # verbatim so the server's recovery fence can attribute the upload
+        epoch = msg.get_control(md.MSG_ARG_KEY_SESSION_EPOCH)
+        if epoch is not None:
+            if self._last_epoch is not None and int(epoch) != self._last_epoch:
+                self.server_restarts_seen += 1
+                log.info("client %d: server session epoch %s -> %s "
+                         "(server restarted; resuming)",
+                         self.rank, self._last_epoch, epoch)
+            self._last_epoch = int(epoch)
         params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
         client_idx = int(msg.get(md.MSG_ARG_KEY_CLIENT_INDEX, self.rank - 1))
         new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key, client_idx)
@@ -256,7 +282,37 @@ class ClientMasterManager(FedMLCommManager):
             reply.add_params(md.MSG_ARG_KEY_MODEL_IS_DELTA, True)
         reply.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
         reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
-        self.send_message(reply)
+        if epoch is not None:
+            reply.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, int(epoch))
+        self._send_with_reconnect(reply, seed_extra=round_idx)
+
+    def _send_with_reconnect(self, reply: Message, seed_extra: int = 0) -> None:
+        """Upload with the reconnect handshake: a server mid-restart refuses
+        connections for a bounded window, so retry with capped exponential
+        backoff + deterministic jitter (seeded per client/round — a silo
+        fleet de-synchronizes instead of stampeding the restarted listener).
+        Exhausted retries abandon the upload loudly: the server's straggler
+        quorum / redispatch watchdog owns recovery from there."""
+        from ..comm.base import backoff_delay
+
+        for attempt in range(RECONNECT_TRIES):
+            try:
+                self.send_message(reply)
+                return
+            except Exception:
+                if attempt + 1 >= RECONNECT_TRIES:
+                    break
+                delay = backoff_delay(
+                    attempt, base=RECONNECT_BASE_S, cap=RECONNECT_CAP_S,
+                    seed=self.rank * 1_000_003 + int(seed_extra))
+                log.warning(
+                    "client %d: upload send failed (attempt %d/%d) — "
+                    "reconnecting in %.3fs", self.rank, attempt + 1,
+                    RECONNECT_TRIES, delay, exc_info=True)
+                time.sleep(delay)
+        log.error("client %d: upload abandoned after %d reconnect attempts "
+                  "(server redispatch recovers the slot)",
+                  self.rank, RECONNECT_TRIES)
 
     def _maybe_compress(self, new_vars, global_vars, round_idx: int):
         """(payload, is_delta) for the model reply.  Compression off -> the

@@ -1,0 +1,281 @@
+"""Durable server recovery journal — crash-safe cross-silo rounds (ISSUE 10).
+
+Every capability shipped so far assumes the server process lives forever:
+the sync and buffered-async managers keep the version counter, streaming
+accumulator, in-flight dispatch table, and health scores only in memory, so
+a mid-run SIGKILL loses the round and strands every client.  Production FL
+is defined by partial failure (PAPERS.md 2405.20431 names client churn and
+unreliable links the dominant cross-silo cost; 2604.10859 shows reconnect
+behavior dominating tail latency), so recovery is a protocol property here,
+not an ops afterthought:
+
+- :class:`ServerJournal` atomically snapshots the **full server protocol
+  state** at (virtual-)round boundaries: the model/server-state tree rides
+  the existing orbax :class:`~fedml_tpu.core.checkpoint.RoundCheckpointer`,
+  and the protocol sidecar (server version, session epoch, in-flight
+  dispatch table, streaming-accumulator partials, staleness cursors, health
+  ledger) is one ``MAGIC + json meta + npz`` file written with the
+  tmp+``os.replace``+flock pattern proven in ``core/aot.py`` — readers see
+  an old or a complete new step, never a torn one.
+- **Corrupt or partial steps are discarded, never served.**  ``restore``
+  walks steps newest-first and falls back to the previous intact step when
+  the latest one is truncated (a hard kill mid-snapshot), mirroring the AOT
+  store's corrupt-entry rebuild semantics; the model checkpointer applies
+  the same discipline to its own steps.
+- **A session epoch fences the crash boundary.**  Each snapshot records the
+  epoch it was taken under; a recovering server resumes at ``epoch + 1`` and
+  stamps the new epoch into every dispatch, so uploads produced by pre-crash
+  dispatches are recognizable and can be folded with corrected staleness or
+  rejected deterministically — never double-folded (the policy lives in the
+  server managers; the journal supplies the fence).
+
+Gated entirely on ``extra.server_journal_dir``: unset means
+:func:`journal_from_config` returns ``None`` and both server managers run
+their exact pre-existing paths — wire bytes and aggregation results stay
+bit-identical to the flag-free build.
+
+Thread model (GL008-audited): one journal belongs to ONE server manager and
+every ``snapshot``/``restore`` call runs under that manager's ``_agg_lock``
+(round boundaries / construction), so the journal itself is lock-free; the
+flock below is CROSS-process (a lingering pre-crash writer vs the restarted
+server), not cross-thread.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import logging
+import os
+import re
+import tempfile
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core.checkpoint import RoundCheckpointer
+from ..core.flags import cfg_extra
+from ..obs import registry as obsreg
+
+log = logging.getLogger("fedml_tpu.cross_silo.journal")
+
+__all__ = ["ServerJournal", "journal_from_config"]
+
+#: on-disk step format: MAGIC + one json meta line + an npz payload.  Bump
+#: the magic when the envelope changes — old steps are then discarded as
+#: corrupt and recovery falls back, never misreads.
+_MAGIC = b"FMLJRN1\n"
+_STEP_RE = re.compile(r"^step_(\d{10})\.journal$")
+
+SNAPSHOTS = obsreg.REGISTRY.counter(
+    "fedml_journal_snapshots_total",
+    "Server protocol-state snapshots committed to the recovery journal.",
+)
+SNAPSHOT_TIME = obsreg.REGISTRY.histogram(
+    "fedml_journal_snapshot_seconds",
+    "Wall time of one journal snapshot (model checkpoint + protocol sidecar).",
+)
+RECOVERIES = obsreg.REGISTRY.counter(
+    "fedml_journal_recoveries_total",
+    "Journal restore attempts at server construction, by result "
+    "(recovered = state applied, empty = no intact step found).",
+    labels=("result",),
+)
+DISCARDED = obsreg.REGISTRY.counter(
+    "fedml_journal_steps_discarded_total",
+    "Corrupt/partial journal steps discarded during recovery (the restart "
+    "fell back to the previous intact step).",
+)
+
+
+class ServerJournal:
+    """Atomic, step-addressed snapshots of one server's protocol state.
+
+    ``snapshot(step, protocol, arrays, model_state)`` commits:
+
+    - ``model_state`` (a pytree dict, e.g. ``{"global_vars": ..,
+      "server_state": ..}``) through a :class:`RoundCheckpointer` under
+      ``<dir>/model`` at the same ``step``;
+    - ``protocol`` (JSON-able dict: versions, epoch, dispatch table,
+      cursors) + ``arrays`` (named float64/float32 numpy arrays: the
+      streaming-accumulator partials) as one atomically replaced sidecar.
+
+    ``restore(model_template)`` returns the newest step whose sidecar AND
+    model checkpoint both read back intact, as
+    ``{"step", "protocol", "arrays", "model"}`` — or ``None``.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(str(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep = max(1, int(keep))
+        self._model_ckpt: Optional[RoundCheckpointer] = None
+
+    # -- paths ---------------------------------------------------------------
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{int(step):010d}.journal")
+
+    def steps(self) -> list[int]:
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            m = _STEP_RE.match(n)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _model(self) -> RoundCheckpointer:
+        if self._model_ckpt is None:
+            self._model_ckpt = RoundCheckpointer(
+                os.path.join(self.directory, "model"), keep=self.keep)
+        return self._model_ckpt
+
+    # -- write side ----------------------------------------------------------
+    def snapshot(self, step: int, protocol: dict,
+                 arrays: Optional[dict] = None,
+                 model_state: Optional[dict] = None) -> None:
+        """Commit one step.  Write order is model-first so a crash between
+        the two writes leaves a sidecar-less model step (ignored) rather
+        than a sidecar pointing at a missing model — the sidecar is the
+        commit record."""
+        t0 = time.perf_counter()
+        with self._journal_flock():
+            has_model = model_state is not None
+            if has_model:
+                self._model().save(int(step), model_state)
+            arrays = dict(arrays or {})
+            buf = io.BytesIO()
+            np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+            payload = buf.getvalue()
+            meta = {
+                "step": int(step),
+                "has_model": bool(has_model),
+                "payload_len": len(payload),
+                "created_unix": round(time.time(), 3),
+                "protocol": protocol,
+            }
+            blob = (_MAGIC + json.dumps(meta, sort_keys=True).encode("utf-8")
+                    + b"\n" + payload)
+            path = self._step_path(step)
+            fd, tmp = tempfile.mkstemp(dir=self.directory, prefix=".tmp_",
+                                       suffix=".journal")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)  # atomic: readers see old or complete new
+            except OSError:
+                with contextlib.suppress(OSError):
+                    os.remove(tmp)
+                raise
+            self._prune()
+        SNAPSHOTS.inc()
+        SNAPSHOT_TIME.observe(time.perf_counter() - t0)
+
+    def _prune(self) -> None:
+        for step in self.steps()[: -self.keep]:
+            with contextlib.suppress(OSError):
+                os.remove(self._step_path(step))
+
+    # -- read side -----------------------------------------------------------
+    def _load_step(self, step: int) -> Optional[tuple[dict, dict]]:
+        """(protocol, arrays) for one sidecar, or None when it is corrupt
+        (bad magic, truncated meta/payload, unreadable npz)."""
+        try:
+            with open(self._step_path(step), "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        try:
+            if not blob.startswith(_MAGIC):
+                raise ValueError("bad magic")
+            rest = blob[len(_MAGIC):]
+            nl = rest.find(b"\n")
+            if nl < 0:
+                raise ValueError("truncated meta")
+            meta = json.loads(rest[:nl].decode("utf-8"))
+            payload = rest[nl + 1:]
+            if int(meta.get("payload_len", -1)) != len(payload):
+                raise ValueError("truncated payload")
+            with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+                arrays = {k: np.asarray(z[k]) for k in z.files}
+            return dict(meta), arrays
+        except Exception as e:
+            log.warning("journal: discarding unusable step %s (%s: %s)",
+                        self._step_path(step), type(e).__name__, e)
+            return None
+
+    def restore(self, model_template: Optional[dict] = None) -> Optional[dict]:
+        """Newest intact snapshot, falling back past corrupt steps.
+
+        A step counts only when its sidecar parses AND (when the snapshot
+        carried a model) the model checkpoint at the same step restores;
+        anything less is discarded and the previous step is tried."""
+        for step in reversed(self.steps()):
+            loaded = self._load_step(step)
+            if loaded is None:
+                DISCARDED.inc()
+                with contextlib.suppress(OSError):
+                    os.remove(self._step_path(step))
+                continue
+            meta, arrays = loaded
+            model = None
+            if meta.get("has_model"):
+                try:
+                    model = self._model().restore(step, template=model_template)
+                except Exception as e:
+                    log.warning("journal: step %d sidecar is intact but its "
+                                "model checkpoint is not (%s: %s) — falling "
+                                "back", step, type(e).__name__, e)
+                    DISCARDED.inc()
+                    with contextlib.suppress(OSError):
+                        os.remove(self._step_path(step))
+                    continue
+            RECOVERIES.inc(result="recovered")
+            return {"step": step, "protocol": meta["protocol"],
+                    "arrays": arrays, "model": model}
+        RECOVERIES.inc(result="empty")
+        return None
+
+    # -- cross-process coordination ------------------------------------------
+    @contextlib.contextmanager
+    def _journal_flock(self):
+        """Advisory flock over the journal dir's writers: a restarted server
+        and a not-yet-dead predecessor must not interleave a step write
+        (same pattern as the AOT store's per-entry lock).  Reads never lock —
+        atomic replace keeps them safe."""
+        lock_path = os.path.join(self.directory, ".journal.lock")
+        try:
+            import fcntl
+        except ImportError:  # non-posix: best effort
+            yield
+            return
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            with contextlib.suppress(OSError):
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+
+def journal_from_config(cfg: Any) -> Optional[ServerJournal]:
+    """The one gate: ``extra.server_journal_dir`` unset/falsy → ``None``
+    (both server managers then run their exact pre-existing paths)."""
+    if cfg is None or not cfg_extra(cfg, "server_journal_dir"):
+        return None
+    root = cfg_extra(cfg, "server_journal_dir")
+    keep = int(cfg_extra(cfg, "server_journal_keep"))
+    try:
+        return ServerJournal(str(root), keep=keep)
+    except OSError as e:
+        log.warning("journal: directory %s unusable (%s) — running without "
+                    "crash recovery", root, e)
+        return None

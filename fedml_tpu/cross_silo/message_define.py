@@ -24,6 +24,13 @@ MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
 MSG_ARG_KEY_CLIENT_STATUS = "client_status"
 MSG_ARG_KEY_ROUND_INDEX = "round_idx"
 MSG_ARG_KEY_CLIENT_OS = "client_os"
+# TPU-native extension: the server's crash-recovery session epoch (ISSUE 10).
+# Stamped into every dispatch when extra.server_journal_dir is set and echoed
+# back in the client's model reply, so a recovered server can tell uploads
+# produced by pre-crash dispatches from current-epoch work and fold or reject
+# them deterministically (never double-folded).  Absent when the journal is
+# off — the wire stays byte-identical to the journal-free protocol.
+MSG_ARG_KEY_SESSION_EPOCH = "session_epoch"
 
 CLIENT_STATUS_ONLINE = "ONLINE"
 CLIENT_OS_PYTHON = "python"

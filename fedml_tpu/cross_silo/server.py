@@ -63,6 +63,13 @@ BUFFERED_PEAK = obsreg.REGISTRY.gauge(
     "Peak client updates simultaneously buffered on the server (streaming "
     "aggregation holds ~2 regardless of clients-per-round).",
 )
+REJECTED_STALE = obsreg.REGISTRY.counter(
+    "fedml_crosssilo_stale_rejected_total",
+    "Uploads rejected deterministically after a server recovery, by reason "
+    "(epoch = produced by a pre-crash dispatch with no surviving in-flight "
+    "slot — folding it would double-count work already in the journal).",
+    labels=("reason",),
+)
 
 
 def _apply_delta(global_leaf, delta_leaf):
@@ -360,6 +367,55 @@ class FedMLAggregator:
         self._np_global = None
         self._stream_tmpl = None
 
+    # -- recovery-journal state (cross_silo/journal.py) -----------------------
+    def model_state(self) -> dict:
+        """The round-resumable model tree (also the restore template):
+        global variables + the algorithm's server state."""
+        return {"global_vars": self.global_vars, "server_state": self.server_state}
+
+    def restore_model_state(self, state: dict) -> None:
+        """Install a journaled :meth:`model_state` snapshot (recovery path);
+        invalidates the host copy + stream template the old tree seeded."""
+        self.global_vars = jax.tree_util.tree_map(jnp.asarray, state["global_vars"])
+        self.server_state = jax.tree_util.tree_map(jnp.asarray, state["server_state"])
+        self._np_global = None
+        self._stream_tmpl = None
+
+    def export_stream_state(self) -> tuple[dict, dict]:
+        """(protocol-json, named-arrays) of the streaming accumulator for the
+        recovery journal.  At round boundaries this is empty (the fold buffer
+        resets on aggregate); mid-round snapshots carry the partial sums so
+        nothing folded is lost."""
+        proto = {
+            "stream_w": float(self._stream_w),
+            "stream_w_delta": float(self._stream_w_delta),
+            "stream_folded": int(self._stream_folded),
+            "stream_samples": {str(k): float(v)
+                               for k, v in sorted(self.sample_num_dict.items())},
+        }
+        arrays = {f"stream_sum_{i}": a for i, a in enumerate(self._stream_sum or [])}
+        return proto, arrays
+
+    def restore_stream_state(self, proto: dict, arrays: dict) -> None:
+        """Inverse of :meth:`export_stream_state` — call after
+        :meth:`restore_model_state` (the template must match the restored
+        global tree)."""
+        if not proto.get("stream_folded"):
+            return
+        tmpl, _ = self._stream_template()
+        try:
+            self._stream_sum = [np.asarray(arrays[f"stream_sum_{i}"], np.float32)
+                                for i in range(len(tmpl))]
+        except KeyError:
+            log.warning("journal: streaming partials incomplete — restarting "
+                        "the fold buffer empty")
+            return
+        self._stream_w = float(proto.get("stream_w", 0.0))
+        self._stream_w_delta = float(proto.get("stream_w_delta", 0.0))
+        self._stream_folded = int(proto.get("stream_folded", 0))
+        for k, v in (proto.get("stream_samples") or {}).items():
+            self.sample_num_dict[int(k)] = float(v)
+
     def test_on_server(self) -> dict:
         return {k: float(v) for k, v in self._eval_fn(self.global_vars, *self._test).items()}
 
@@ -433,6 +489,8 @@ class FedMLServerManager(FedMLCommManager):
         self.straggler_timeout = float(cfg_extra(cfg, "straggler_timeout_s") or 0)
         self.quorum_frac = float(cfg_extra(cfg, "straggler_quorum_frac") or 0.5)
         self._round_timer: Optional[threading.Timer] = None
+        self._status_timer: Optional[threading.Timer] = None
+        self._status_probe_attempt = 0
         self._agg_lock = threading.Lock()
         self._init_sent = False
         # set by handlers/timers when the run cannot make progress; surfaced
@@ -472,6 +530,23 @@ class FedMLServerManager(FedMLCommManager):
         self._round_payload_bytes = 0
         # Prometheus exposition, gated on extra['metrics_port']
         self.metrics_server = obsreg.maybe_start_metrics_server(cfg)
+        # durable recovery journal (cross_silo/journal.py), gated on
+        # extra.server_journal_dir: snapshot full protocol state at round
+        # boundaries, recover on restart under a bumped session epoch.
+        # Unset -> journal None, epoch never stamped, wire + aggregation
+        # byte/bit-identical to before the flag existed.
+        from .journal import journal_from_config
+
+        self.journal = journal_from_config(cfg)
+        self.session_epoch = 0
+        #: step the journal resumed from (None = fresh start) — the chaos
+        #: harness asserts version continuity through it
+        self.recovered_step: Optional[int] = None
+        self.rejected_stale = 0
+        self._journal_every = max(1, int(
+            cfg_extra(cfg, "server_journal_every_rounds"))) if self.journal else 1
+        if not getattr(type(self), "_journal_recover_deferred", False):
+            self._journal_recover()
 
     # -- protocol ------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -488,15 +563,60 @@ class FedMLServerManager(FedMLCommManager):
             self.register_message_receive_handler(MSG_TYPE_C2S_OBS, lambda _msg: None)
 
     def start(self) -> None:
-        """Ask every client for status (reference connection_ready path)."""
+        """Ask every client for status (reference connection_ready path).
+
+        Sends are best-effort per client and a re-probe timer retries the
+        ranks still missing: one unreachable/lossy peer (an injected chaos
+        fault, a client mid-reconnect after a server restart) must delay
+        discovery, not deadlock it."""
         for cid in self.client_ids:
             msg = Message(md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, 0, cid)
-            self.send_message(msg)
+            try:
+                self.send_message(msg)
+            except Exception:
+                log.warning("status probe to client %d failed; re-probe "
+                            "timer retries", cid, exc_info=True)
+        self._arm_status_reprobe()
+
+    def _arm_status_reprobe(self) -> None:  # graftlint: disable=GL008(single handle + attempt counter, benign race: finish() cancelling while the timer re-arms costs at most one extra probe, which re-checks _init_sent/done under _agg_lock and exits)
+        from ..comm.base import backoff_delay
+
+        # capped exponential from a small base (deterministic jitter): a
+        # probe lost to a flaky wire re-fires in ~100ms, a genuinely slow
+        # fleet is re-probed at a gentle 1s cadence
+        attempt = self._status_probe_attempt
+        self._status_probe_attempt = attempt + 1
+        t = threading.Timer(backoff_delay(attempt, base=0.1, cap=1.0),
+                            self._on_status_reprobe)
+        t.daemon = True
+        self._status_timer = t
+        t.start()
+
+    def _on_status_reprobe(self) -> None:
+        """Retry CHECK_CLIENT_STATUS for ranks that never answered (their
+        probe or reply was lost on the wire); disarms once the round starts."""
+        with self._agg_lock:
+            if self._init_sent or self.done.is_set():
+                return
+            missing = [c for c in self.client_ids if c not in self.active_clients]
+        for cid in missing:
+            try:
+                self.send_message(Message(md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, 0, cid))
+            except Exception:
+                log.warning("status re-probe to client %d failed", cid,
+                            exc_info=True)
+        self._arm_status_reprobe()
 
     def handle_message_client_status(self, msg: Message) -> None:
+        ready = False
         if msg.get(md.MSG_ARG_KEY_CLIENT_STATUS) == md.CLIENT_STATUS_ONLINE:
-            self.active_clients.add(msg.get_sender_id())
-        if len(self.active_clients) == len(self.client_ids):
+            with self._agg_lock:
+                self.active_clients.add(msg.get_sender_id())
+                ready = len(self.active_clients) == len(self.client_ids)
+        else:
+            with self._agg_lock:
+                ready = len(self.active_clients) == len(self.client_ids)
+        if ready:
             self.send_init_msg()
 
     def send_init_msg(self) -> None:
@@ -507,11 +627,22 @@ class FedMLServerManager(FedMLCommManager):
         receive and straggler-timer threads touch under the same lock, and
         the ``_init_sent`` check makes the call idempotent: a status reply
         arriving mid-run (e.g. a liveness probe answer from a cross-device
-        fleet) must not re-fire round 0."""
+        fleet) must not re-fire round 0.
+
+        A recovered server (``recovered_step`` set) re-enters here with
+        ``round_idx`` already at the interrupted round: the broadcast simply
+        re-issues that round under the new session epoch — the reconnect/
+        resume handshake from the clients' side is just answering the status
+        check and training on the re-dispatched global."""
         with self._agg_lock:
             if self._init_sent:
                 return
             self._init_sent = True
+            if self.round_idx >= self.comm_round:
+                # crash landed after the final round's snapshot but before
+                # the FINISH broadcast: nothing left to train
+                self.send_finish()
+                return
             self._broadcast_model(md.MSG_TYPE_S2C_INIT_CONFIG)  # graftlint: disable=GL007(round-boundary broadcast: every client is idle until the new global arrives, so the host fetch under _agg_lock serializes nothing that could otherwise progress)
 
     def _candidate_ids(self) -> list[int]:
@@ -521,6 +652,21 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_receive_model(self, msg: Message) -> None:
         with self._agg_lock:
+            if self.journal is not None:
+                # session-epoch fence (recovery): an upload produced by a
+                # pre-crash dispatch is rejected deterministically — the
+                # recovered server re-broadcasts the interrupted round and
+                # the client redoes it under the new epoch, so accepting the
+                # old reply could double-count the same work
+                epoch = int(msg.get_control(
+                    md.MSG_ARG_KEY_SESSION_EPOCH, self.session_epoch))
+                if epoch != self.session_epoch:
+                    self.rejected_stale += 1
+                    REJECTED_STALE.inc(reason="epoch")
+                    log.info("rejecting stale-epoch upload from client %s "
+                             "(epoch %d, current %d)",
+                             msg.get_sender_id(), epoch, self.session_epoch)
+                    return
             if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) != self.round_idx:
                 return  # stale round (post-timeout arrival)
             sender = int(msg.get_sender_id())
@@ -606,6 +752,7 @@ class FedMLServerManager(FedMLCommManager):
         self.logger.log(metrics)
         self.history.append(metrics)
         self.round_idx += 1
+        self._journal_snapshot()
         if self.round_idx >= self.comm_round:
             self.send_finish()
             return
@@ -664,6 +811,10 @@ class FedMLServerManager(FedMLCommManager):
             msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
             msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
             msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            if self.journal is not None:
+                # recovery fence: clients echo this epoch in their reply so a
+                # restarted server can tell pre-crash work from current work
+                msg.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, self.session_epoch)
             obstrace.inject(msg, self._round_span)
             try:
                 self._sent_at[cid] = time.perf_counter()
@@ -676,9 +827,56 @@ class FedMLServerManager(FedMLCommManager):
                 log.warning("broadcast to client %d failed; continuing", cid, exc_info=True)
         self._arm_straggler_timer()
 
+    # -- recovery journal -----------------------------------------------------
+    def _journal_recover(self) -> None:  # graftlint: disable=GL004(construction-time: runs from __init__ before the receive loop or any timer thread exists)
+        """Install the newest intact journal snapshot (construction-time):
+        round index, model/server-state tree, streaming partials, health
+        scores; resume under a bumped session epoch so pre-crash uploads are
+        recognizable."""
+        if self.journal is None:
+            return
+        snap = self.journal.restore(model_template=self.aggregator.model_state())
+        if snap is None:
+            return
+        proto = snap["protocol"]
+        self.session_epoch = int(proto.get("session_epoch", 0)) + 1
+        self.round_idx = int(proto.get("round_idx", 0))
+        self.recovered_step = int(snap["step"])
+        if snap["model"] is not None:
+            self.aggregator.restore_model_state(snap["model"])
+        self.aggregator.restore_stream_state(proto, snap["arrays"])
+        self.health.import_state(proto.get("health") or {})
+        log.info("recovered from journal step %d (round %d, session epoch %d)",
+                 self.recovered_step, self.round_idx, self.session_epoch)
+
+    def _journal_protocol_state(self) -> dict:  # graftlint: disable=GL004(caller holds _agg_lock: _journal_snapshot runs at locked round boundaries)
+        return {"kind": "sync", "session_epoch": self.session_epoch,
+                "round_idx": self.round_idx,
+                "rejected_stale": self.rejected_stale,
+                "health": self.health.export_state()}
+
+    def _journal_snapshot(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: round-boundary sites only)
+        """Commit the full protocol state at a round boundary (cadence:
+        ``server_journal_every_rounds``; the final round always commits)."""
+        if self.journal is None:
+            return
+        step = self.round_idx
+        if (step % self._journal_every) and step < self.comm_round:
+            return
+        stream_proto, arrays = self.aggregator.export_stream_state()
+        self.journal.snapshot(
+            step, {**self._journal_protocol_state(), **stream_proto},
+            arrays, model_state=self.aggregator.model_state())
+
     def send_finish(self) -> None:
         for cid in self.client_ids:
-            self.send_message(Message(md.MSG_TYPE_S2C_FINISH, 0, cid))
+            try:
+                self.send_message(Message(md.MSG_TYPE_S2C_FINISH, 0, cid))
+            except Exception:
+                # best-effort terminal broadcast: one unreachable peer must
+                # not strand the rest of the fleet without FINISH or leave
+                # done unset (the run DID complete)
+                log.warning("FINISH to client %d failed", cid, exc_info=True)
         self.done.set()
         self.finish()
 
@@ -686,6 +884,10 @@ class FedMLServerManager(FedMLCommManager):
         pass  # bookkeeping only
 
     def finish(self) -> None:  # graftlint: disable=GL008(teardown: finish can race the straggler timer's finish, but every resource close here is idempotent and metrics_server flips non-None->None exactly once per object)
+        t = self._status_timer
+        self._status_timer = None
+        if t is not None:
+            t.cancel()
         super().finish()
         if self.obs_collector is not None:
             self.obs_collector.close()  # release the JSONL append handle

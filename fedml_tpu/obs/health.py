@@ -198,6 +198,28 @@ class ClientHealthLedger:
         HEALTH_BREACHES.set(breaches, client=label)
         HEALTH_COMM_FAILURES.set(failures, client=label)
 
+    def export_state(self) -> dict:
+        """JSON-able snapshot of the raw per-client signal state (EWMA RTT,
+        breach/failure counts) for the server recovery journal — the inverse
+        of :meth:`import_state`.  Scores are derived, so they are not stored."""
+        with self._lock:
+            return {str(cid): dict(e) for cid, e in sorted(self._clients.items())}
+
+    def import_state(self, state: dict) -> None:
+        """Install a journaled :meth:`export_state` snapshot (recovery path):
+        a restarted server remembers which clients were degraded instead of
+        re-learning it one breach at a time."""
+        if not state:
+            return
+        with self._lock:
+            for cid, e in state.items():
+                entry = self._entry(int(cid))
+                for k in ("ewma_rtt_s", "rtts", "breaches", "comm_failures"):
+                    if k in e:
+                        entry[k] = e[k]
+        for cid in state:
+            self._export(int(cid))
+
     def summary(self) -> dict:
         """{client: {score, ewma_rtt_s, rtts, breaches, comm_failures}} plus
         the process-wide comm pressure under the ``_comm`` key."""

@@ -34,7 +34,42 @@ def main() -> int:
     p.add_argument("--redispatch-timeout-s", type=float, default=2.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout-s", type=float, default=600.0)
+    p.add_argument("--kill-recover", action="store_true",
+                   help="ISSUE-10 mode: run with the recovery journal + "
+                        "seeded chaos, HARD-KILL the server mid-run, restart "
+                        "it, and assert the recovery invariants (monotone "
+                        "version, zero unaccounted losses)")
+    p.add_argument("--journal-dir", default=None,
+                   help="journal directory for --kill-recover (default: a "
+                        "fresh temp dir, removed afterwards)")
     args = p.parse_args()
+
+    if args.kill_recover:
+        from fedml_tpu.cross_silo.async_soak import run_kill_recover_soak
+
+        res = run_kill_recover_soak(
+            n_clients=args.clients, concurrency=args.concurrency,
+            buffer_k=args.buffer_k, versions=args.versions,
+            staleness_exponent=args.staleness_exponent,
+            drop_prob=args.drop_prob, latency_mean_s=args.latency_mean_s,
+            latency_sigma=args.latency_sigma,
+            redispatch_timeout_s=args.redispatch_timeout_s, seed=args.seed,
+            journal_dir=args.journal_dir, timeout_s=args.timeout_s,
+        )
+        print(json.dumps(res, indent=2))
+        failures = []
+        if res["versions"] < args.versions:
+            failures.append(f"only {res['versions']}/{args.versions} versions closed")
+        if not res["monotone"]:
+            failures.append("server version not monotone through the restart")
+        if res["unaccounted"] != 0:
+            failures.append(f"{res['unaccounted']} losses unaccounted")
+        if res["peak_buffered_updates"] > 2:
+            failures.append(f"peak buffered updates {res['peak_buffered_updates']} > 2")
+        if failures:
+            print("SOAK FAILED: " + "; ".join(failures), file=sys.stderr)
+            return 1
+        return 0
 
     from fedml_tpu.cross_silo.async_soak import run_soak
 

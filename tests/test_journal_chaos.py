@@ -1,0 +1,594 @@
+"""Crash-safe federated rounds (ISSUE 10): the durable server recovery
+journal, the session-epoch fence (never double-folded), the deterministic
+chaos harness at the comm boundary, and the satellite hardening — exp-backoff
+decode retries, the configurable chunk-stream sweep, and the checkpoint
+corrupt-step fallback."""
+
+import dataclasses
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _load(cfg):
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    return ds, model
+
+
+# ---------------------------------------------------------------------------
+# ServerJournal: atomic snapshots, corrupt-step fallback
+# ---------------------------------------------------------------------------
+
+def test_journal_snapshot_restore_roundtrip(tmp_path):
+    from fedml_tpu.cross_silo.journal import ServerJournal
+
+    j = ServerJournal(str(tmp_path / "j"), keep=3)
+    model_state = {"global_vars": {"w": np.arange(6, dtype=np.float32)},
+                   "server_state": {}}
+    j.snapshot(1, {"session_epoch": 0, "server_version": 1,
+                   "outstanding": {"3": 0}},
+               arrays={"stream_sum_0": np.ones(4, np.float32)},
+               model_state=model_state)
+    snap = j.restore(model_template=model_state)
+    assert snap["step"] == 1
+    assert snap["protocol"]["server_version"] == 1
+    assert snap["protocol"]["outstanding"] == {"3": 0}
+    np.testing.assert_array_equal(snap["arrays"]["stream_sum_0"],
+                                  np.ones(4, np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(snap["model"]["global_vars"]["w"]),
+        np.arange(6, dtype=np.float32))
+
+
+def test_journal_corrupt_latest_step_falls_back(tmp_path):
+    """A truncated latest sidecar (hard kill mid-write would be prevented by
+    atomic replace, but disk corruption is not) is discarded; restore serves
+    the previous intact step — the AOT store's corrupt-entry semantics."""
+    from fedml_tpu.cross_silo.journal import ServerJournal
+
+    j = ServerJournal(str(tmp_path / "j"), keep=5)
+    for step in (1, 2, 3):
+        j.snapshot(step, {"server_version": step}, arrays={})
+    # truncate step 3's sidecar mid-payload
+    p3 = j._step_path(3)
+    blob = open(p3, "rb").read()
+    with open(p3, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    snap = j.restore()
+    assert snap["step"] == 2
+    assert snap["protocol"]["server_version"] == 2
+    # the corrupt step is gone from disk (discarded, not retried forever)
+    assert 3 not in j.steps()
+
+
+def test_journal_garbage_and_empty(tmp_path):
+    from fedml_tpu.cross_silo.journal import ServerJournal
+
+    j = ServerJournal(str(tmp_path / "j"))
+    assert j.restore() is None  # empty journal: fresh start
+    with open(j._step_path(7), "wb") as f:
+        f.write(b"not a journal at all")
+    assert j.restore() is None  # pure garbage: discarded, still fresh start
+
+
+def test_journal_prunes_to_keep(tmp_path):
+    from fedml_tpu.cross_silo.journal import ServerJournal
+
+    j = ServerJournal(str(tmp_path / "j"), keep=2)
+    for step in range(1, 6):
+        j.snapshot(step, {"server_version": step}, arrays={})
+    assert j.steps() == [4, 5]
+
+
+def test_journal_from_config_gate(tmp_path):
+    from fedml_tpu.cross_silo.journal import journal_from_config
+
+    assert journal_from_config(tiny_config()) is None
+    assert journal_from_config(None) is None
+    j = journal_from_config(tiny_config(
+        extra={"server_journal_dir": str(tmp_path / "j")}))
+    assert j is not None and j.keep == 3
+
+
+# ---------------------------------------------------------------------------
+# RoundCheckpointer: corrupt/partial step falls back (satellite)
+# ---------------------------------------------------------------------------
+
+def test_checkpointer_truncated_latest_step_discarded(tmp_path):
+    """A truncated latest orbax step must be discarded and latest_round()
+    fall back to the previous intact step (mirrors the AOT store's
+    corrupt-entry rebuild semantics)."""
+    from fedml_tpu.core.checkpoint import RoundCheckpointer
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"), keep=5)
+    state = {"w": np.arange(8, dtype=np.float32)}
+    ck.save(0, state)
+    ck.save(1, {"w": np.arange(8, dtype=np.float32) + 1})
+    assert ck.latest_round() == 1
+    # corrupt step 1: truncate every regular file in its directory
+    step_dir = tmp_path / "ck" / "1"
+    corrupted = 0
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            p = os.path.join(root, name)
+            data = open(p, "rb").read()
+            with open(p, "wb") as f:
+                f.write(data[: max(1, len(data) // 3)])
+            corrupted += 1
+    assert corrupted > 0
+    assert ck.latest_round() == 0  # fell back past the corrupt step
+    restored = ck.restore(template=state)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(8, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# chaos: determinism, gate, fault classes
+# ---------------------------------------------------------------------------
+
+class _FakeComm:
+    """Minimal inner backend recording every delivery."""
+
+    def __init__(self, fail=False):
+        self.sent = []
+        self.raw = []
+        self.fail = fail
+
+    def send_message(self, msg):
+        if self.fail:
+            raise ConnectionResetError("inner down")
+        self.sent.append(msg)
+
+    def send_raw(self, rid, payload):
+        self.raw.append((rid, bytes(payload)))
+
+    def add_observer(self, obs):
+        pass
+
+    def remove_observer(self, obs):
+        pass
+
+    def handle_receive_message(self):
+        pass
+
+    def stop_receive_message(self):
+        self.stopped = True
+
+
+def _mk_msg(rid=1, nonce=0):
+    from fedml_tpu.comm.message import Message
+
+    m = Message(3, 0, rid)
+    m.add_params("round_idx", nonce)
+    m.add_params("model_params", np.arange(64, dtype=np.float32))
+    return m
+
+
+def _chaos_mgr(inner, **kw):
+    from fedml_tpu.comm.chaos import ChaosCommManager, ChaosConfig
+
+    return ChaosCommManager(inner, ChaosConfig(**kw), rank=0)
+
+
+def test_chaos_gate_returns_inner_untouched():
+    """All chaos flags unset -> wrap_with_chaos returns the INNER OBJECT
+    (no wrapper, no per-send rng — the default path is bit-identical)."""
+    from fedml_tpu.comm.chaos import chaos_from_config, wrap_with_chaos
+
+    inner = _FakeComm()
+    cfg = tiny_config()
+    assert chaos_from_config(cfg) is None
+    assert wrap_with_chaos(inner, cfg, rank=0) is inner
+    on = tiny_config(extra={"chaos_drop_prob": 0.5})
+    assert wrap_with_chaos(inner, on, rank=0) is not inner
+
+
+def test_chaos_same_seed_reproduces_schedule():
+    """The acceptance property: same seed + same message sequence -> the
+    IDENTICAL fault schedule; a different seed -> a different one."""
+    def run(seed):
+        inner = _FakeComm()
+        mgr = _chaos_mgr(inner, seed=seed, drop=0.2, duplicate=0.1,
+                         reorder=0.1, corrupt=0.1, delay=0.0)
+        for i in range(200):
+            mgr.send_message(_mk_msg(rid=1 + (i % 3), nonce=i))
+        return list(mgr.schedule)
+
+    a, b, c = run(7), run(7), run(8)
+    assert a == b
+    assert a != c
+    assert len(a) > 0
+
+
+def test_chaos_drop_duplicate_and_counters():
+    inner = _FakeComm()
+    mgr = _chaos_mgr(inner, seed=1, drop=0.3, duplicate=0.3)
+    n = 300
+    for i in range(n):
+        mgr.send_message(_mk_msg(rid=1, nonce=i))
+    drops = mgr.injected.get("drop", 0)
+    dups = mgr.injected.get("duplicate", 0)
+    assert drops > 0 and dups > 0
+    # delivered = sends - drops + duplicates (each duplicate delivers twice)
+    assert len(inner.sent) == n - drops + dups
+    assert mgr.silent_losses() == drops
+
+
+def test_chaos_reset_raises_and_partition_window():
+    inner = _FakeComm()
+    mgr = _chaos_mgr(inner, seed=0, reset=1.0)
+    with pytest.raises(ConnectionResetError):
+        mgr.send_message(_mk_msg())
+    # partition: a window starting immediately fails every send
+    inner2 = _FakeComm()
+    mgr2 = _chaos_mgr(inner2, seed=0, partition=(0.0, 60.0))
+    with pytest.raises(ConnectionResetError):
+        mgr2.send_message(_mk_msg())
+    assert mgr2.injected.get("partition") == 1
+    # a window that has not opened yet delivers normally
+    inner3 = _FakeComm()
+    mgr3 = _chaos_mgr(inner3, seed=0, partition=(60.0, 60.0))
+    mgr3.send_message(_mk_msg())
+    assert len(inner3.sent) == 1
+
+
+def test_chaos_reorder_holds_frame_until_next_send():
+    inner = _FakeComm()
+    mgr = _chaos_mgr(inner, seed=3, reorder=1.0)
+    first, second = _mk_msg(rid=1, nonce=0), _mk_msg(rid=1, nonce=1)
+    mgr.send_message(first)
+    assert inner.sent == []  # held back
+    mgr.send_message(second)
+    # second went out first... both present, order flipped; second is itself
+    # reorder-rolled (prob 1.0) but its hold slot was freed by the flush
+    assert first in inner.sent
+    # stop flushes any residue so a clean shutdown strands nothing
+    mgr.stop_receive_message()
+    assert second in inner.sent
+
+
+def test_chaos_corrupt_frame_dies_in_receive_loop_drop_path():
+    """A corrupt-frame injection must be dropped by the receive loop's
+    undecodable path (metered), never dispatched to a handler."""
+    from fedml_tpu.comm.base import MSG_DROPPED
+    from fedml_tpu.comm.inproc import InProcCommManager, InProcRouter
+
+    run_id = "chaos_corrupt_test"
+    InProcRouter.reset(run_id)
+    rx = InProcCommManager(run_id, rank=1)
+    tx = InProcCommManager(run_id, rank=0)
+    mgr = _chaos_mgr(tx, seed=0, corrupt=1.0)
+
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    rx.add_observer(Obs())
+    t = threading.Thread(target=rx.handle_receive_message, daemon=True)
+    t.start()
+    base = MSG_DROPPED.value(reason="undecodable")
+    mgr.send_message(_mk_msg(rid=1))
+    deadline = time.monotonic() + 5.0
+    while MSG_DROPPED.value(reason="undecodable") == base:
+        assert time.monotonic() < deadline, "corrupt frame never dropped"
+        time.sleep(0.01)
+    rx.stop_receive_message()
+    t.join(timeout=5.0)
+    assert got == []  # nothing reached a handler
+    assert mgr.injected.get("corrupt") == 1
+    InProcRouter.reset(run_id)
+
+
+# ---------------------------------------------------------------------------
+# session-epoch fence: folded-once-or-rejected, never double-folded
+# ---------------------------------------------------------------------------
+
+def _async_server(tmp_path, **extra):
+    from fedml_tpu.cross_silo import build_server
+    from fedml_tpu.comm.inproc import InProcRouter
+
+    cfg = tiny_config(
+        training_type="cross_silo", comm_round=50, run_id="epoch_fence",
+        frequency_of_the_test=0,
+        extra={"async_aggregation": True, "async_buffer_k": 100,
+               "async_redispatch_timeout_s": 0.0,
+               "server_journal_dir": str(tmp_path / "j"), **extra})
+    ds, model = _load(cfg)
+    InProcRouter.reset("epoch_fence")
+    return build_server(cfg, ds, model, backend="INPROC"), ds, model
+
+
+def _epoch_upload(rank, params, version, epoch):
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+
+    msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, rank, 0)
+    msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+    msg.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, 16.0)
+    msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, int(version))
+    msg.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, int(epoch))
+    return Message.decode(msg.encode())
+
+
+def test_epoch_fence_folds_inflight_once_rejects_rest(tmp_path, eight_devices):
+    """The never-double-folded policy, unit-level: an old-epoch upload folds
+    exactly once iff its (client, version) survives in the recovered
+    in-flight table; a redelivery and an unknown sender are both rejected
+    deterministically."""
+    import jax
+
+    server, ds, model = _async_server(tmp_path)
+    base = jax.device_get(server.aggregator.global_vars)
+    # simulate a recovered server: epoch bumped, clients 1+2 were in flight
+    # at versions 0 and 1 when the old process died
+    server.session_epoch = 1
+    server._prev_epoch_inflight = {1: 0, 2: 1}
+    server.server_version = 2
+
+    # client 1 echoes its pre-crash dispatch (epoch 0, version 0): FOLDED
+    server.handle_message_receive_model(_epoch_upload(1, base, 0, 0))
+    assert server.total_arrivals == 1
+    assert server.rejected_stale == 0
+    assert 1 not in server._prev_epoch_inflight
+
+    # the SAME upload redelivered (at-least-once transport): REJECTED
+    server.handle_message_receive_model(_epoch_upload(1, base, 0, 0))
+    assert server.total_arrivals == 1
+    assert server.rejected_stale == 1
+
+    # client 2 echoes a version that does NOT match its journaled dispatch:
+    # REJECTED (and its slot stays armed for the real reply)
+    server.handle_message_receive_model(_epoch_upload(2, base, 0, 0))
+    assert server.total_arrivals == 1
+    assert server.rejected_stale == 2
+    assert server._prev_epoch_inflight == {2: 1}
+
+    # client 3 was never in flight pre-crash: REJECTED
+    server.handle_message_receive_model(_epoch_upload(3, base, 1, 0))
+    assert server.rejected_stale == 3
+
+    # current-epoch uploads are untouched by the fence
+    server.handle_message_receive_model(_epoch_upload(4, base, 2, 1))
+    assert server.total_arrivals == 2
+    server.finish()
+
+
+# ---------------------------------------------------------------------------
+# sync server: journal resume reproduces the uninterrupted run
+# ---------------------------------------------------------------------------
+
+def _run_sync_group(cfg, ds, model, timeout=180.0):
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+
+    InProcRouter.reset(str(cfg.run_id))
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC")
+               for r in range(1, cfg.client_num_in_total + 1)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    try:
+        history = server.run_until_done(timeout=timeout)
+        for c in clients:
+            c.done.wait(5.0)
+    finally:
+        for c in clients:
+            c.finish()
+    return server, history
+
+
+def test_sync_journal_resume_matches_uninterrupted(tmp_path, eight_devices):
+    """Run 2/4 rounds with the journal, 'crash', restart with the same
+    journal: the resumed server re-enters at round 2 under epoch 1 and the
+    final model matches the uninterrupted 4-round run."""
+    import jax
+
+    jd = str(tmp_path / "journal")
+    base = dict(training_type="cross_silo", client_num_in_total=2,
+                client_num_per_round=2, synthetic_train_size=64,
+                frequency_of_the_test=0)
+
+    # uninterrupted 4-round reference
+    cfg_ref = tiny_config(comm_round=4, run_id="jres_ref", **base)
+    ds, model = _load(cfg_ref)
+    srv_ref, _ = _run_sync_group(cfg_ref, ds, model)
+
+    # first life: 2 rounds, journaled
+    cfg_a = tiny_config(comm_round=2, run_id="jres_a", **base,
+                        extra={"server_journal_dir": jd})
+    srv_a, hist_a = _run_sync_group(cfg_a, ds, model)
+    assert [h["round"] for h in hist_a] == [0, 1]
+    assert srv_a.journal.steps()[-1] == 2
+
+    # second life: same journal, 4 total rounds -> resumes at round 2
+    cfg_b = tiny_config(comm_round=4, run_id="jres_b", **base,
+                        extra={"server_journal_dir": jd})
+    srv_b, hist_b = _run_sync_group(cfg_b, ds, model)
+    assert srv_b.recovered_step == 2
+    assert srv_b.session_epoch == 1
+    assert [h["round"] for h in hist_b] == [2, 3]
+
+    ref_leaves = jax.tree_util.tree_leaves(
+        jax.device_get(srv_ref.aggregator.global_vars))
+    res_leaves = jax.tree_util.tree_leaves(
+        jax.device_get(srv_b.aggregator.global_vars))
+    for x, y in zip(ref_leaves, res_leaves):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# async kill-and-recover soak (the acceptance run, small)
+# ---------------------------------------------------------------------------
+
+def test_kill_recover_soak_invariants(eight_devices):
+    from fedml_tpu.cross_silo.async_soak import run_kill_recover_soak
+
+    res = run_kill_recover_soak(
+        n_clients=64, concurrency=16, buffer_k=8, versions=6,
+        drop_prob=0.05, latency_mean_s=0.002, redispatch_timeout_s=1.0,
+        seed=0, timeout_s=180.0)
+    assert res["versions"] == 6
+    assert res["monotone"], res
+    assert 0 < res["recovered_version"] <= res["versions_at_kill"], res
+    assert res["session_epoch"] == 1, res
+    assert res["unaccounted"] == 0, res
+    assert res["peak_buffered_updates"] <= 2, res
+    # chaos was live on the dispatch leg
+    assert res["chaos_silent_losses"] + res["fleet_drops_injected"] > 0, res
+
+
+# ---------------------------------------------------------------------------
+# default-path regression: journal off + chaos off -> byte-identical wire
+# ---------------------------------------------------------------------------
+
+def test_default_path_wire_and_manager_identical(eight_devices):
+    """Flags unset: no chaos wrapper, no journal object, and NOT ONE dispatch
+    carries the session-epoch key — the control JSON is byte-identical to
+    the pre-ISSUE-10 protocol (same discipline as comm_compression /
+    async_aggregation)."""
+    import json as _json
+
+    from fedml_tpu.comm.inproc import InProcCommManager, InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.cross_silo import message_define as md
+
+    cfg = tiny_config(training_type="cross_silo", client_num_in_total=2,
+                      client_num_per_round=2, comm_round=1,
+                      synthetic_train_size=64, frequency_of_the_test=0,
+                      run_id="default_wire")
+    ds, model = _load(cfg)
+    InProcRouter.reset("default_wire")
+    captured = []
+    router = InProcRouter.get("default_wire")
+    orig_route = router.route
+
+    def tap(msg):
+        if msg.get_type() in (md.MSG_TYPE_S2C_INIT_CONFIG,
+                              md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER):
+            captured.append(msg.encode())
+        orig_route(msg)
+
+    router.route = tap
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC")
+               for r in (1, 2)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    assert server.journal is None
+    assert type(server.com_manager) is InProcCommManager  # no chaos wrapper
+    try:
+        server.run_until_done(timeout=120.0)
+    finally:
+        for c in clients:
+            c.finish()
+    assert captured
+    for data in captured:
+        clen = int.from_bytes(data[:4], "little")
+        control = _json.loads(bytes(data[4:4 + clen]).decode())
+        assert md.MSG_ARG_KEY_SESSION_EPOCH not in control
+
+
+# ---------------------------------------------------------------------------
+# satellites: exp-backoff retry schedule + chunk-sweep flag
+# ---------------------------------------------------------------------------
+
+def test_backoff_delay_schedule():
+    """Capped exponential with deterministic jitter: monotone envelope,
+    hard cap, jitter in [0.5, 1.0) of the raw value, reproducible per seed,
+    de-synchronized across seeds."""
+    from fedml_tpu.comm.base import backoff_delay
+
+    base, cap = 0.2, 2.0
+    raws = [min(cap, base * 2 ** a) for a in range(8)]
+    delays = [backoff_delay(a, base=base, cap=cap, seed=0) for a in range(8)]
+    for d, raw in zip(delays, raws):
+        assert 0.5 * raw <= d < raw
+    # deterministic: same (seed, attempt) -> same delay
+    assert delays == [backoff_delay(a, base=base, cap=cap, seed=0)
+                      for a in range(8)]
+    # seeds de-synchronize
+    other = [backoff_delay(a, base=base, cap=cap, seed=1) for a in range(8)]
+    assert delays != other
+    # capped: late attempts never exceed the ceiling
+    assert backoff_delay(50, base=base, cap=cap, seed=0) < cap
+    # grows past the old linear schedule's early waits
+    assert max(delays) > base * 3
+
+
+def test_chunk_sweep_flag_threads_through_and_evicts(eight_devices):
+    """``comm_chunk_idle_sweep_s`` reaches the receive loop's assembler, and
+    an abandoned chunk stream is swept and metered WITH sender attribution
+    after that timeout."""
+    from fedml_tpu.comm import base as comm_base, wire
+    from fedml_tpu.comm.base import MSG_DROPPED
+    from fedml_tpu.comm.comm_manager import FedMLCommManager
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.comm.message import Message
+
+    run_id = "sweep_flag"
+    InProcRouter.reset(run_id)
+    cfg = tiny_config(run_id=run_id,
+                      extra={"comm_chunk_idle_sweep_s": 0.05})
+
+    class Mgr(FedMLCommManager):
+        def register_message_receive_handlers(self):
+            pass
+
+    mgr = Mgr(cfg, rank=0, backend="INPROC")
+    assert mgr.com_manager._chunk_sweep_s == 0.05
+
+    events = []
+    sink = comm_base.add_comm_event_sink(
+        lambda event, **info: events.append((event, info.get("client"))))
+    try:
+        # first frame of a 2+-chunk stream from sender 9, then silence
+        msg = Message(3, 9, 0)
+        msg.add_params("model_params", np.arange(4096, dtype=np.float32))
+        frames = list(wire.encode_chunk_frames(
+            msg.encode(), stream_id="9.0", sender=9, chunk_bytes=1024))
+        assert len(frames) > 1
+        mgr.com_manager._inbox.put(bytes(frames[0]))
+        base_drops = MSG_DROPPED.value(reason="chunk_stream_timeout")
+        t = threading.Thread(target=mgr.com_manager.handle_receive_message,
+                             daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while MSG_DROPPED.value(reason="chunk_stream_timeout") == base_drops:
+            assert time.monotonic() < deadline, "stale stream never swept"
+            time.sleep(0.01)
+        mgr.com_manager.stop_receive_message()
+        t.join(timeout=5.0)
+    finally:
+        comm_base.remove_comm_event_sink(sink)
+        InProcRouter.reset(run_id)
+    assert ("dropped", 9) in events  # sender-attributed
+
+
+def test_health_ledger_state_roundtrip():
+    from fedml_tpu.obs.health import ClientHealthLedger
+
+    a = ClientHealthLedger()
+    a.observe_rtt(1, 0.5)
+    a.record_deadline_breach(2)
+    a.record_comm_failure(2)
+    state = a.export_state()
+    b = ClientHealthLedger()
+    b.import_state(state)
+    assert b.score(2) == a.score(2)
+    assert b.score(1) == a.score(1)
+    assert b.export_state() == state

@@ -576,6 +576,127 @@ def test_gl007_blocking_ops_under_lock_fire_and_suppress(tmp_path):
     assert not any("fine" in f.symbol for f in gl007)
 
 
+CROSS_OBJECT_CYCLE = """
+    import threading
+
+    class Ledger:
+        def __init__(self, mgr):
+            self._lock = threading.Lock()
+            self.mgr = Manager()
+
+        def note(self):
+            with self._lock:
+                pass
+
+        def flush(self):{flush_suppress}
+            with self._lock:
+                self.mgr.poke()
+
+    class Manager:
+        def __init__(self):
+            self._agg_lock = threading.Lock()
+            self.ledger = Ledger(self)
+
+        def poke(self):
+            with self._agg_lock:
+                pass
+
+        def on_upload(self):{upload_suppress}
+            with self._agg_lock:
+                self.ledger.note()
+"""
+
+
+def test_gl007_cross_object_one_hop_cycle_fires(tmp_path):
+    """The PR-9 follow-on: holding the manager lock, call a LEDGER method
+    that takes the ledger lock — and a ledger method holding its lock calls
+    back into the manager.  Two objects, opposite orders, one deadlock; the
+    one-object-hop resolution must see it at lint time."""
+    r = lint_files(tmp_path, {"mod.py": CROSS_OBJECT_CYCLE.format(
+        flush_suppress="", upload_suppress="")})
+    cyc = [f for f in r.findings if f.rule == "GL007" and f.symbol.startswith("cycle:")]
+    assert len(cyc) == 1, r.render()
+    assert "Manager._agg_lock" in cyc[0].message and "Ledger._lock" in cyc[0].message
+
+
+def test_gl007_cross_object_cycle_suppresses(tmp_path):
+    """def-line suppressions on both edge-recording methods silence the
+    cycle (the anchor line always lands inside one of them)."""
+    sup = "  # graftlint: disable=GL007(fixture: callback ordering is documented lock-free)"
+    r = lint_files(tmp_path, {"mod.py": CROSS_OBJECT_CYCLE.format(
+        flush_suppress=sup, upload_suppress=sup)})
+    assert not [f for f in r.findings if f.rule == "GL007"
+                and f.symbol.startswith("cycle:")], r.render()
+    assert r.suppressed, "the cycle should be recorded as suppressed"
+
+
+def test_gl007_cross_object_one_way_edge_is_clean(tmp_path):
+    """manager lock -> ledger lock with NO reverse path (the real health-
+    ledger shape, and the journal/recovery locks): an edge, not a cycle —
+    must stay clean."""
+    r = lint_files(tmp_path, {"mod.py": """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def note(self):
+                with self._lock:
+                    pass
+
+        class Manager:
+            def __init__(self):
+                self._agg_lock = threading.Lock()
+                self.ledger = Ledger()
+
+            def on_upload(self):
+                with self._agg_lock:
+                    self.ledger.note()
+    """})
+    assert not [f for f in r.findings if f.rule == "GL007"], r.render()
+
+
+def test_gl007_cross_object_fluent_builder_attr_resolves(tmp_path):
+    """``self.ledger = Ledger().attach()`` (the ClientHealthLedger idiom)
+    still resolves the attr's class through the fluent chain — proven by the
+    cycle FIRING through the fluent-assigned attr."""
+    r = lint_files(tmp_path, {"mod.py": """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.mgr = Manager()
+
+            def attach(self):
+                return self
+
+            def note(self):
+                with self._lock:
+                    pass
+
+            def flush(self):
+                with self._lock:
+                    self.mgr.poke()
+
+        class Manager:
+            def __init__(self):
+                self._agg_lock = threading.Lock()
+                self.ledger = Ledger().attach()
+
+            def poke(self):
+                with self._agg_lock:
+                    pass
+
+            def on_upload(self):
+                with self._agg_lock:
+                    self.ledger.note()
+    """})
+    cyc = [f for f in r.findings if f.rule == "GL007" and f.symbol.startswith("cycle:")]
+    assert len(cyc) == 1, r.render()
+
+
 # -- GL008: thread-shared-state races ----------------------------------------
 
 GL008_RACY = """
