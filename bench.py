@@ -37,6 +37,11 @@ Three benches, one JSON line:
    leg, server hard-killed mid-run, restarted against its journal) — the
    recovered run must retain >= 0.5x the clean versions/s (floor-guarded)
    with monotone version, zero unaccounted losses, peak buffered <= 2.
+8. **Continuous serving under live training** (ISSUE 11): an async server
+   publishes a version-stamped model at every virtual-round bump while a
+   continuous-batching worker serves HTTP traffic and hot-swaps each
+   version — QPS (floor-guarded), p50/p99 latency, zero dropped requests
+   across >= 3 hot swaps, final served version == final published version.
 
 The reference publishes no numeric baselines (BASELINE.md) and has no MFU
 accounting at all; the 0.35 target comes from BASELINE.json's north star.
@@ -446,6 +451,157 @@ def bench_chaos():
     }
 
 
+def bench_serving():
+    """Continuous-batching serving fleet under LIVE training (ISSUE 11): a
+    buffered-async server runs a small simulated fleet and publishes a
+    version-stamped model at every virtual-round bump
+    (``extra.model_publish_dir``), while an in-process ServingWorker serves
+    HTTP predict traffic through the micro-batcher and hot-swaps each
+    published version between micro-batches.
+
+    Platform independent (host-side serving path), so it runs on CPU too.
+    The guarded numbers: QPS (floor, exit 3, one-retry policy), zero
+    dropped requests across >= 3 hot swaps (503 backpressure answers are
+    retried by the load generator and counted separately — a 503 is
+    explicit flow control, not a drop), and the final served version must
+    equal the final published version."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from fedml_tpu.cross_silo.async_soak import run_soak
+    from fedml_tpu.serving.batcher import (
+        EXECUTE_TIME, QUEUE_TIME, percentile_from_histogram,
+    )
+    from fedml_tpu.serving.publisher import ManifestWatcher
+    from fedml_tpu.serving.worker import ServingWorker
+
+    versions = int(os.environ.get("BENCH_SERVING_VERSIONS", "6"))
+    load_threads = int(os.environ.get("BENCH_SERVING_THREADS", "4"))
+    rows_per_request = int(os.environ.get("BENCH_SERVING_ROWS", "2"))
+    publish_dir = tempfile.mkdtemp(prefix="bench_serving_pub_")
+    try:
+        # -- live training: async server publishing at every version bump.
+        # buffer_k == concurrency + a real per-client latency means each
+        # virtual round waits one full dispatch wave (~latency_mean), so
+        # version bumps are spaced far enough apart for the worker's poll
+        # to hot-swap most of them individually.
+        soak_out: dict = {}
+        soak_err: list = []
+
+        def _train():
+            try:
+                soak_out.update(run_soak(
+                    n_clients=64, concurrency=16, buffer_k=16,
+                    versions=versions, drop_prob=0.0, latency_mean_s=0.25,
+                    latency_sigma=0.25, redispatch_timeout_s=5.0, seed=0,
+                    timeout_s=300.0,
+                    extra_flags={"model_publish_dir": publish_dir}))
+            except Exception as e:  # surfaced after the load stops
+                soak_err.append(e)
+
+        trainer = threading.Thread(target=_train, daemon=True)
+        trainer.start()
+
+        # -- the serving worker bootstraps from the manifest (version 0 is
+        # published at send_init) and polls fast enough to swap per bump
+        worker = ServingWorker(
+            "lr", 10, publish_dir=publish_dir, max_batch=32, max_queue=256,
+            flush_ms=1.0, poll_s=0.02, bootstrap_timeout_s=60.0)
+        port = worker.start(block=False)
+        feat = worker.predictor.feature_shape[0]
+
+        # -- load generation while training publishes versions
+        stop_load = threading.Event()
+        lock = threading.Lock()
+        latencies: list = []
+        counts = {"ok": 0, "dropped": 0, "backpressure": 0}
+        body = _json.dumps(
+            {"inputs": np.zeros((rows_per_request, feat)).tolist()}).encode()
+
+        def _load():
+            while not stop_load.is_set():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                t0 = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(req, timeout=30.0) as r:
+                        _json.loads(r.read())
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        counts["ok"] += 1
+                        latencies.append(dt)
+                except urllib.error.HTTPError as e:
+                    if e.code == 503:
+                        # explicit backpressure: honor Retry-After, retry
+                        retry = float(e.headers.get("Retry-After", "1") or 1)
+                        with lock:
+                            counts["backpressure"] += 1
+                        time.sleep(min(retry, 1.0))
+                    else:
+                        with lock:
+                            counts["dropped"] += 1
+                except Exception:
+                    with lock:
+                        counts["dropped"] += 1
+
+        threads = [threading.Thread(target=_load, daemon=True)
+                   for _ in range(load_threads)]
+        t_load0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        trainer.join(timeout=360.0)
+        # settle: let the worker's poll adopt the final published version
+        watcher = ManifestWatcher(publish_dir)
+        manifest = watcher.read_manifest() or {}
+        deadline = time.monotonic() + 10.0
+        while (worker.served_version < int(manifest.get("version", 0))
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        load_wall = time.perf_counter() - t_load0
+        stats = worker.stats()
+        worker.stop()
+        if soak_err:
+            raise soak_err[0]
+
+        lat = np.asarray(sorted(latencies)) if latencies else np.zeros(1)
+        return {
+            "versions_published": int(manifest.get("version", -1)),
+            "served_version_final": int(stats["served_version"]),
+            "hot_swaps": int(stats["swaps"]),
+            "rollbacks": int(stats["rollbacks"]),
+            "requests_ok": counts["ok"],
+            "requests_backpressure_503": counts["backpressure"],
+            "dropped_requests": counts["dropped"] + int(stats["errored"]),
+            "qps": round(counts["ok"] / max(load_wall, 1e-9), 2),
+            "latency_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "latency_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "batch_fill_ewma": stats["batch_fill_ewma"],
+            "batches": int(stats["batches"]),
+            "queue_p50_s": percentile_from_histogram(QUEUE_TIME, 0.50),
+            "execute_p50_s": percentile_from_histogram(EXECUTE_TIME, 0.50),
+            "load_threads": load_threads,
+            "rows_per_request": rows_per_request,
+            "load_wall_s": round(load_wall, 3),
+            "training": {
+                "versions": soak_out.get("versions"),
+                "versions_per_sec": soak_out.get("versions_per_sec"),
+                "arrivals": soak_out.get("arrivals"),
+            },
+        }
+    finally:
+        shutil.rmtree(publish_dir, ignore_errors=True)
+
+
 def bench_llm(peak):
     import jax
     import jax.numpy as jnp
@@ -524,6 +680,8 @@ def _run_one(mode):
         result = bench_async_soak()
     elif mode == "chaos":
         result = bench_chaos()
+    elif mode == "serving":
+        result = bench_serving()
     else:
         result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
@@ -598,6 +756,12 @@ ASYNC_VERSIONS_PER_SEC_FLOOR = 2.0
 #: dispatches) must retain at least half the clean throughput, or server
 #: restarts are not production-viable.
 CHAOS_RECOVERY_RATIO_FLOOR = 0.5
+#: Serving QPS the continuous-batching worker must sustain WHILE an async
+#: training run publishes versions (ISSUE 11) — platform independent
+#: (host-side serving path; CPU measures hundreds of QPS at the default
+#: 4-thread load, so 20 catches order-of-magnitude regressions while
+#: tolerating a loaded box running training concurrently).
+SERVING_QPS_FLOOR = 20.0
 #: Warm start-to-first-round as a fraction of cold (ISSUE 7) — platform
 #: independent (the AOT store removes re-tracing everywhere; on CPU the
 #: deserialized program's compile additionally rides the persistent
@@ -661,6 +825,11 @@ def main():
     # under seeded chaos — floor on recovered/clean versions/s plus the
     # recovery correctness invariants
     chaos = _subprocess_bench("chaos")
+    # ISSUE-11 serving: continuous-batching worker hot-swapping model
+    # versions WHILE an async training run publishes them — QPS floor +
+    # zero dropped requests across >= 3 hot swaps + final served version
+    # == final published version
+    serving = _subprocess_bench("serving")
     # ISSUE-7 cold_start: two fresh processes share one AOT program store +
     # compilation cache root; the first populates it, the second must
     # deserialize every program (misses == 0) and start in <= 0.5x the time
@@ -743,6 +912,26 @@ def main():
     if rec.get("peak_buffered_updates", 0) > 2:
         violations.append(
             f"chaos recovered run peak buffered {rec['peak_buffered_updates']} > 2")
+    serving_qps = serving.get("qps")
+    if serving_qps is not None and serving_qps < SERVING_QPS_FLOOR:
+        # same one-retry policy as the other wall-clock floors
+        serving = _subprocess_bench("serving")
+        serving_qps = serving.get("qps")
+    if serving_qps is not None and serving_qps < SERVING_QPS_FLOOR:
+        violations.append(
+            f"serving qps {serving_qps} < floor {SERVING_QPS_FLOOR}")
+    if serving.get("dropped_requests", 0) != 0:
+        violations.append(
+            f"serving dropped {serving['dropped_requests']} requests "
+            "(hot swaps must drop zero in-flight work)")
+    if serving.get("hot_swaps", 0) < 3:
+        violations.append(
+            f"serving saw only {serving.get('hot_swaps')} hot swaps "
+            "(>= 3 required to prove the version-swap gap)")
+    if serving.get("served_version_final") != serving.get("versions_published"):
+        violations.append(
+            f"serving final served version {serving.get('served_version_final')} "
+            f"!= final published version {serving.get('versions_published')}")
     pop_rss = population.get("rss_multiple")
     if pop_rss is not None and pop_rss > POPULATION_RSS_MULTIPLE_FLOOR:
         violations.append(
@@ -781,6 +970,7 @@ def main():
             "population": population,
             "async": async_soak,
             "chaos": chaos,
+            "serving": serving,
             "aot": aot,
             "lint": lint_section,
         },

@@ -91,7 +91,10 @@ class FedMLCommManager(Observer):
         if b == C.COMM_BACKEND_INPROC:
             from .inproc import InProcCommManager
 
-            return InProcCommManager(getattr(self.cfg, "run_id", "0"), self.rank)
+            return InProcCommManager(
+                getattr(self.cfg, "run_id", "0"), self.rank,
+                chunk_bytes=int(cfg_extra(self.cfg, "comm_chunk_bytes") or 0),
+            )
         if b == C.COMM_BACKEND_GRPC:
             from .grpc_backend import GRPCCommManager
 

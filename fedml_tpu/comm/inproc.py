@@ -14,11 +14,13 @@ fake exercises exactly the bytes a remote backend would.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 from collections import defaultdict
 from typing import Callable, Optional
 
+from . import wire
 from .base import BaseCommunicationManager, ObserverLoopMixin
 from .message import Message
 
@@ -33,6 +35,7 @@ class InProcRouter:
         self.queues: dict[int, queue.Queue] = defaultdict(queue.Queue)
         self.drop_rule: Optional[Callable[[Message], bool]] = None
         self.delay_rule: Optional[Callable[[Message], float]] = None
+        self._stream_seq = itertools.count()
 
     @classmethod
     def get(cls, run_id: str) -> "InProcRouter":
@@ -46,28 +49,56 @@ class InProcRouter:
         with cls._lock:
             cls._routers.pop(run_id, None)
 
-    def route(self, msg: Message) -> None:
+    def route(self, msg: Message, chunk_bytes: int = 0) -> None:
+        """Deliver one message.  ``chunk_bytes`` > 0 and an encoded frame
+        past the bound ships as transport chunk frames (ISSUE 11 satellite:
+        the in-proc fabric exercises BOTH legs — server->client broadcast
+        and client uploads — through the same chunk-frame envelope the
+        gRPC/TCP senders already produce); 0 = one whole frame per message,
+        byte-identical to the pre-chunk protocol."""
         if self.drop_rule is not None and self.drop_rule(msg):
             return
         data = msg.encode()  # force the wire round-trip
+        if chunk_bytes and len(data) > chunk_bytes:
+            stream_id = f"{msg.get_sender_id()}.{next(self._stream_seq)}"
+            frames = list(wire.encode_chunk_frames(
+                data, stream_id=stream_id, sender=msg.get_sender_id(),
+                chunk_bytes=chunk_bytes))
+        else:
+            frames = [data]
+        target = self.queues[msg.get_receiver_id()]
+
+        def deliver() -> None:
+            for frame in frames:
+                target.put(frame)
+
         delay = self.delay_rule(msg) if self.delay_rule is not None else 0.0
         if delay > 0:
-            t = threading.Timer(delay, lambda: self.queues[msg.get_receiver_id()].put(data))
+            t = threading.Timer(delay, deliver)
             t.daemon = True
             t.start()
         else:
-            self.queues[msg.get_receiver_id()].put(data)
+            deliver()
 
 
 class InProcCommManager(ObserverLoopMixin, BaseCommunicationManager):
-    def __init__(self, run_id: str, rank: int):
+    def __init__(self, run_id: str, rank: int, chunk_bytes: int = 0):
         self.run_id = str(run_id)
         self.rank = rank
+        # extra.comm_chunk_bytes (ISSUE 11 satellite): the in-proc fabric
+        # honors the same chunk bound as the gRPC/TCP backends so broadcast
+        # AND upload legs reassemble through the receive loop's assembler
+        self.chunk_bytes = int(chunk_bytes or 0)
         self.router = InProcRouter.get(self.run_id)
         self._init_observer_loop(inbox=self.router.queues[rank])
 
     def send_message(self, msg: Message) -> None:
-        self.router.route(msg)
+        if self.chunk_bytes:
+            self.router.route(msg, chunk_bytes=self.chunk_bytes)
+        else:
+            # positional call, exactly the pre-chunk signature: route() taps
+            # (tests, tooling) that wrap the unchunked fabric keep working
+            self.router.route(msg)
 
     def send_raw(self, receiver_id: int, payload: bytes) -> None:
         """Deliver raw frame bytes to a peer's inbox, bypassing the Message

@@ -134,10 +134,12 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "Fold arriving client updates into a running weighted sum even "
              "without a codec (peak buffered updates <= 2)."),
     FlagSpec("comm_chunk_bytes", "int", 0,
-             "Split gRPC/TCP sends larger than this into bounded chunk "
-             "frames that interleave at the socket level (receivers "
-             "reassemble + decode incrementally per peer); 0 = one frame "
-             "per message, byte-identical to the unchunked protocol."),
+             "Split gRPC/TCP/in-proc sends larger than this into bounded "
+             "chunk frames that interleave at the socket level — BOTH legs: "
+             "client uploads and the server->client model broadcast "
+             "(receivers reassemble + decode incrementally per peer); 0 = "
+             "one frame per message, byte-identical to the unchunked "
+             "protocol."),
     FlagSpec("comm_chunk_idle_sweep_s", "float", 120.0,
              "Idle timeout for a partially assembled chunk stream: a sender "
              "that dies mid-upload has its stream evicted (a metered, "
@@ -273,6 +275,16 @@ FLAGS: dict[str, FlagSpec] = _specs(
     FlagSpec("process_id", "int", None,
              "jax.distributed process id ($JAX_PROCESS_ID fallback)."),
     # -- serving -------------------------------------------------------------
+    FlagSpec("model_publish_dir", "str", None,
+             "Continuous model publication directory: the cross-silo servers "
+             "(sync + buffered-async) atomically write a version-stamped "
+             "params file + MANIFEST.json at every (virtual-)round version "
+             "bump so serving workers can hot-swap the live model (unset = "
+             "no publish writes, serving-free runs bit-identical to before "
+             "the flag existed)."),
+    FlagSpec("model_publish_keep", "int", 5,
+             "Published param-file versions retained on disk (older versions "
+             "are pruned; the manifest-referenced file is never pruned)."),
     FlagSpec("end_point_name", "str", None,
              "Serving endpoint name; derived: 'ep-<run_id>'."),
     FlagSpec("serving_model_name", "str", None,

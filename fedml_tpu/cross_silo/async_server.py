@@ -185,6 +185,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
             warm = self.aggregator.warm_programs()
             if warm is not None:
                 log.info("async server: program store warm %s", warm)
+            # bootstrap publication (ISSUE 11): serving workers come up on
+            # the initial (or journal-recovered) global before the first
+            # virtual round closes
+            self._publish_model()
             self._round_span = obstrace.Span(
                 "round", round_idx=self.server_version, async_mode=True)
             self.first_dispatch_monotonic = time.monotonic()
@@ -301,8 +305,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
         self._arrivals_in_round = 0
         self._round_staleness = []
         # virtual-round boundary: the accumulator is freshly reset and the
-        # dispatch ledger is consistent — the journal's commit point
+        # dispatch ledger is consistent — the journal's commit point, and
+        # (behind extra.model_publish_dir) the serving fleet's version bump
         self._journal_snapshot()
+        self._publish_model()
         if self.server_version >= self.comm_round:
             self._finished = True
             self.finished_monotonic = time.monotonic()

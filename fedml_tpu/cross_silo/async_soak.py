@@ -246,13 +246,17 @@ def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64
              drop_prob: float = 0.02, latency_mean_s: float = 0.005,
              latency_sigma: float = 1.0, redispatch_timeout_s: float = 2.0,
              seed: int = 0, workers: int = 4, timeout_s: float = 600.0,
-             journal_dir: Optional[str] = None) -> dict:
+             journal_dir: Optional[str] = None,
+             extra_flags: Optional[dict] = None) -> dict:
     """Drive one buffered-async server to ``versions`` virtual rounds under
     ``n_clients`` simulated clients; returns the accounting dict (versions/s,
     staleness stats, fold-lag p50/p95, peak buffered updates, drop/retry
     accounting).  ``journal_dir`` turns on the recovery journal WITHOUT any
     kill — the bench's clean leg uses it so the recovery ratio isolates the
-    crash/chaos cost from the journal's per-round snapshot cost."""
+    crash/chaos cost from the journal's per-round snapshot cost.
+    ``extra_flags`` merges additional ``cfg.extra`` flags into the server's
+    config — the serving bench points ``model_publish_dir`` here so the
+    async server publishes versions while a worker fleet serves."""
     import jax
 
     import fedml_tpu
@@ -266,8 +270,11 @@ def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64
     run_id = f"soak_async_{seed}_{n_clients}_{versions}"
     cfg = _soak_config(run_id, n_clients, concurrency, buffer_k, versions,
                        staleness_exponent, redispatch_timeout_s,
-                       extra_flags=({"server_journal_dir": journal_dir}
-                                    if journal_dir else None))
+                       extra_flags={
+                           **({"server_journal_dir": journal_dir}
+                              if journal_dir else {}),
+                           **(extra_flags or {}),
+                       })
     fedml_tpu.init(cfg)
     # the server only needs the dataset for its eval arrays + sample batch;
     # load it with a small client count so the partitioner never has to

@@ -538,6 +538,14 @@ class FedMLServerManager(FedMLCommManager):
         from .journal import journal_from_config
 
         self.journal = journal_from_config(cfg)
+        # continuous model publication for the serving fleet (ISSUE 11),
+        # gated on extra.model_publish_dir: every version bump atomically
+        # writes a version-stamped params file + manifest that serving
+        # workers hot-swap from.  Unset -> None, zero publish writes,
+        # serving-free runs bit-identical to before the flag existed.
+        from ..serving.publisher import publisher_from_config
+
+        self.publisher = publisher_from_config(cfg)
         self.session_epoch = 0
         #: step the journal resumed from (None = fresh start) — the chaos
         #: harness asserts version continuity through it
@@ -643,6 +651,9 @@ class FedMLServerManager(FedMLCommManager):
                 # the FINISH broadcast: nothing left to train
                 self.send_finish()
                 return
+            # bootstrap publication: serving workers can come up on the
+            # initial (or journal-recovered) global before round 1 closes
+            self._publish_model()
             self._broadcast_model(md.MSG_TYPE_S2C_INIT_CONFIG)  # graftlint: disable=GL007(round-boundary broadcast: every client is idle until the new global arrives, so the host fetch under _agg_lock serializes nothing that could otherwise progress)
 
     def _candidate_ids(self) -> list[int]:
@@ -753,6 +764,7 @@ class FedMLServerManager(FedMLCommManager):
         self.history.append(metrics)
         self.round_idx += 1
         self._journal_snapshot()
+        self._publish_model()
         if self.round_idx >= self.comm_round:
             self.send_finish()
             return
@@ -826,6 +838,21 @@ class FedMLServerManager(FedMLCommManager):
                 self.health.record_comm_failure(cid)
                 log.warning("broadcast to client %d failed; continuing", cid, exc_info=True)
         self._arm_straggler_timer()
+
+    # -- model publication (ISSUE 11) -----------------------------------------
+    def _publish_model(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: send_init_msg and the round-boundary finalizers)
+        """Atomically publish the current global as version ``round_idx``
+        (the async subclass's version counter mirrors into ``round_idx`` at
+        every bump, so one site serves both servers).  Publication is
+        best-effort by construction — ``ModelPublisher.publish`` logs and
+        skips on failure, never costing the round."""
+        if self.publisher is None:
+            return
+        self.publisher.publish(
+            self.round_idx, self.aggregator._host_global(),
+            meta={"model": self.cfg.model,
+                  "run_id": str(getattr(self.cfg, "run_id", "0")),
+                  "session_epoch": self.session_epoch})
 
     # -- recovery journal -----------------------------------------------------
     def _journal_recover(self) -> None:  # graftlint: disable=GL004(construction-time: runs from __init__ before the receive loop or any timer thread exists)

@@ -53,13 +53,20 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 class ModelCard:
     """Reference ``device_model_cards.py``: a deployable (name, version,
     artifact) triple.  The artifact is a pytree-wire params file + the
-    model-hub model name that interprets it."""
+    model-hub model name that interprets it.
+
+    ``publish_dir`` (ISSUE 11): a training server's continuous-publication
+    directory — replicas deployed from this card poll its manifest and
+    hot-swap new versions live.  ``feature_dim`` names the input feature
+    shape (comma-separated) for pre-serve warmup of conv models."""
 
     name: str
     version: str
     model: str          # model_hub name, e.g. "lr", "resnet20"
     classes: int
     params_path: str
+    publish_dir: Optional[str] = None
+    feature_dim: Optional[str] = None
 
 
 class ModelCardRepo:
@@ -286,10 +293,15 @@ class ProcessReplicaRuntime(ReplicaRuntime):
 
     def start(self, card: ModelCard) -> tuple[subprocess.Popen, int]:
         port = _free_port()
+        cmd = [sys.executable, "-m", "fedml_tpu.serving.worker",
+               "--model", card.model, "--classes", str(card.classes),
+               "--params", card.params_path, "--port", str(port)]
+        if card.publish_dir:
+            cmd += ["--publish-dir", card.publish_dir]
+        if card.feature_dim:
+            cmd += ["--feature-dim", str(card.feature_dim)]
         proc = subprocess.Popen(
-            [sys.executable, "-m", "fedml_tpu.serving.worker",
-             "--model", card.model, "--classes", str(card.classes),
-             "--params", card.params_path, "--port", str(port)],
+            cmd,
             cwd=_REPO_ROOT,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},

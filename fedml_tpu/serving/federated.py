@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..core.flags import cfg_extra
 from ..cross_silo import build_client, build_server
 from .deploy import ModelCard, ModelDeployScheduler, save_params_card
 
@@ -44,9 +45,13 @@ class FedMLModelServingServer:
         if self.scheduler is not None:
             path = f"{artifact_dir}/{self.model_name}-{self.model_version}.wire"
             save_params_card(self.server.aggregator.global_vars, path)
+            # extra.model_publish_dir rides into the card (ISSUE 11): the
+            # deployed replicas watch the training server's manifest and
+            # hot-swap versions live instead of serving a frozen artifact
             card = ModelCard(
                 name=self.model_name, version=self.model_version,
                 model=self.cfg.model, classes=self.dataset.class_num, params_path=path,
+                publish_dir=cfg_extra(self.cfg, "model_publish_dir") or None,
             )
             self.scheduler.cards.register(card)
             self.scheduler.deploy(self.end_point_name, self.model_name,

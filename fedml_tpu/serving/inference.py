@@ -53,28 +53,91 @@ class JaxPredictor(FedMLPredictor):
 
     Pads every batch to ``max_batch`` so one compiled program serves all
     request sizes (no per-shape retrace).
+
+    **AOT-warm restarts** (ISSUE 11): with ``aot_store`` (a
+    ``core.aot.ProgramStore``) and a known ``feature_shape``, the apply is
+    resolved through the program store — a restarted worker DESERIALIZES
+    the exported StableHLO in milliseconds instead of re-tracing, and the
+    eager bind compiles it at construction, so ``/ready`` means "compiled
+    and warm", not "process up".  Store miss/unavailable falls back to the
+    plain ``jax.jit`` path (never a crash).
+
+    **Hot swap**: :meth:`clone_with` builds a predictor for a NEW parameter
+    tree that SHARES this one's compiled apply (the program is keyed by
+    tree structure, not values), so a version swap pays one warm execution,
+    zero compiles.
     """
 
-    def __init__(self, model, variables, max_batch: int = 32):
+    def __init__(self, model, variables, max_batch: int = 32,
+                 aot_store=None, feature_shape=None, model_name: str = ""):
         import jax
         import jax.numpy as jnp
 
         self.model = model
         self.variables = variables
-        self.max_batch = max_batch
-        self._apply = jax.jit(lambda v, x: model.apply(v, x, train=False))
+        self.max_batch = int(max_batch)
+        self.model_name = model_name
+        self.feature_shape = (tuple(feature_shape)
+                              if feature_shape is not None else None)
+        apply_fn = lambda v, x: model.apply(v, x, train=False)  # noqa: E731
+        self._apply = None
+        if aot_store is not None and self.feature_shape is not None:
+            from ..core import aot as aotlib
+
+            example = (variables,
+                       jnp.zeros((self.max_batch,) + self.feature_shape,
+                                 jnp.float32))
+            key = aotlib.program_key(
+                "serving.predict",
+                trees={"args": example},
+                hparams={"max_batch": self.max_batch},
+                extra={"model": model_name or type(model).__name__})
+            # eager=True: the bind AOT-compiles now, so readiness == warm
+            self._apply = aot_store.cached_jit(
+                apply_fn, example, key=key, eager=True)
+        if self._apply is None:
+            self._apply = jax.jit(apply_fn)
         self._jnp = jnp
 
-    def predict(self, request: dict) -> dict:
-        x = np.asarray(request["inputs"], dtype=np.float32)
+    def clone_with(self, variables) -> "JaxPredictor":
+        """A predictor over ``variables`` sharing this one's compiled apply
+        (the hot-swap path: no store lookup, no re-trace, no compile)."""
+        clone = type(self).__new__(type(self))
+        clone.model = self.model
+        clone.variables = variables
+        clone.max_batch = self.max_batch
+        clone.model_name = self.model_name
+        clone.feature_shape = self.feature_shape
+        clone._apply = self._apply
+        clone._jnp = self._jnp
+        return clone
+
+    def warm(self) -> None:
+        """One padded execution so the first real request never pays the
+        compile (and a swapped-in tree never serves cold)."""
+        if self.feature_shape is None:
+            return  # input shape unknown (conv model without --feature-dim)
+        self.predict_rows(
+            np.zeros((1,) + self.feature_shape, dtype=np.float32))
+
+    def predict_rows(self, x: np.ndarray) -> np.ndarray:
+        """Rows in, logits out — the micro-batcher's execution surface (and
+        the one padded-apply implementation ``predict`` wraps)."""
+        x = np.asarray(x, dtype=np.float32)
         n = x.shape[0]
         if n > self.max_batch:
             raise ValueError(f"batch {n} exceeds max_batch {self.max_batch}")
+        if self.feature_shape is None:
+            self.feature_shape = tuple(x.shape[1:])
         pad = self.max_batch - n
         if pad:
             x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
         logits = self._apply(self.variables, self._jnp.asarray(x))
-        return {"outputs": np.asarray(logits)[:n].tolist()}
+        return np.asarray(logits)[:n]
+
+    def predict(self, request: dict) -> dict:
+        x = np.asarray(request["inputs"], dtype=np.float32)
+        return {"outputs": self.predict_rows(x).tolist()}
 
     def predict_stream(self, request: dict):
         """One chunk per input row — the batched compute runs once, rows
@@ -85,17 +148,31 @@ class JaxPredictor(FedMLPredictor):
 
 
 class FedMLInferenceRunner:
-    """HTTP runner (``fedml_inference_runner.py``): POST /predict, GET /ready."""
+    """HTTP runner (``fedml_inference_runner.py``): POST /predict, GET /ready.
 
-    def __init__(self, predictor: FedMLPredictor, host: str = "127.0.0.1", port: int = 2345):
+    With a ``batcher`` (ISSUE 11), plain JSON predicts route through the
+    continuous micro-batcher: coalesced execution, bounded admission (queue
+    overflow answers 503 + ``Retry-After``), and the response carries the
+    model ``version`` that served it; ``GET /stats`` exposes the batcher +
+    hot-swap accounting.  Streaming/file requests keep the direct path.
+    """
+
+    def __init__(self, predictor: FedMLPredictor, host: str = "127.0.0.1", port: int = 2345,
+                 batcher=None, stats_fn=None, result_timeout_s: float = 30.0):
         self.predictor = predictor
         self.host = host
         self.port = port
+        self.batcher = batcher
+        self.stats_fn = stats_fn
+        self.result_timeout_s = float(result_timeout_s)
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def _make_handler(self):
         predictor = self.predictor
+        batcher = self.batcher
+        stats_fn = self.stats_fn
+        result_timeout_s = self.result_timeout_s
 
         class Handler(BaseHTTPRequestHandler):
             # chunked transfer is an HTTP/1.1 feature; the default HTTP/1.0
@@ -120,6 +197,8 @@ class FedMLInferenceRunner:
                         self._json(200, {"status": "ready"})
                     else:
                         self._json(503, {"status": "not ready"})
+                elif self.path == "/stats" and stats_fn is not None:
+                    self._json(200, stats_fn())
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -148,10 +227,41 @@ class FedMLInferenceRunner:
                     if request.get("stream", False):
                         self._stream(predictor.predict_stream(request))
                         return
+                    if batcher is not None:
+                        self._batched(request)
+                        return
                     result = predictor.predict(request)
                     self._json(200, result)
                 except Exception as e:  # surface the error to the caller
                     self._json(400, {"error": f"{type(e).__name__}: {e}"})
+
+            def _batched(self, request: dict) -> None:
+                """Continuous-batching predict: admission-bounded, answered
+                with the serving model version; a full queue is explicit
+                backpressure (503 + Retry-After), never silent queueing."""
+                from .batcher import QueueOverflow
+
+                try:
+                    fut = batcher.submit(np.asarray(request["inputs"],
+                                                    dtype=np.float32))
+                except QueueOverflow as e:
+                    body = json.dumps({"error": "overloaded",
+                                       "retry_after_s": round(e.retry_after_s, 3)}).encode()
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    # RFC 7231 delta-seconds (integer); the JSON body carries
+                    # the precise estimate for richer clients
+                    self.send_header("Retry-After",
+                                     str(max(1, int(e.retry_after_s + 0.999))))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                out = fut.wait(timeout=result_timeout_s)
+                result = {"outputs": np.asarray(out).tolist()}
+                if fut.version is not None:
+                    result["version"] = int(fut.version)
+                self._json(200, result)
 
             def _file(self, path: str, content_type: str) -> None:
                 import os as _os

@@ -1,19 +1,41 @@
-"""Inference replica worker — one process per replica.
+"""Inference replica worker — one process (or in-process object) per replica.
 
 The process the deploy scheduler spawns (reference: the per-replica inference
 container started by ``device_model_deployment.py:start_deployment``; here a
 plain process, container-free by design).  Loads a model-hub model + a
 pytree-wire parameter file and serves predict/ready over HTTP
-(``serving/inference.py``).
+(``serving/inference.py``) through the continuous micro-batcher
+(``serving/batcher.py``).
+
+ISSUE 11 makes the worker a **continuous-serving** replica:
+
+- requests coalesce into the fixed padded batch lanes (bounded admission,
+  503 + Retry-After on overflow);
+- with ``--publish-dir`` the worker polls the training server's publication
+  manifest (``serving/publisher.py``) and hot-swaps the parameter tree
+  between micro-batches — zero dropped in-flight requests, optional
+  canary-fraction routing with auto-rollback on a health regression;
+- with ``--aot-dir`` the inference apply resolves through the AOT program
+  store, so a restarted worker deserializes in milliseconds and ``/ready``
+  means "compiled and warm";
+- ``--feature-dim`` names the input feature shape (e.g. ``32`` for LR/MLP,
+  ``32,32,3`` for conv models) so warmup/AOT work even when the shape is
+  not inferable from the parameter tree.
 
 Usage: python -m fedml_tpu.serving.worker --model lr --classes 10 \
-           --params /path/params.wire --port 2500 [--feature-dim 32]
+           --params /path/params.wire --port 2500 [--feature-dim 32] \
+           [--publish-dir /path/pub] [--canary-fraction 0.1] [--aot-dir D]
 """
 
 from __future__ import annotations
 
 import argparse
+import logging
 import sys
+import threading
+from typing import Optional
+
+log = logging.getLogger("fedml_tpu.serving.worker")
 
 
 def load_params(path: str):
@@ -23,38 +45,173 @@ def load_params(path: str):
         return wire.decode_pytree(f.read())
 
 
+def parse_feature_dim(spec: Optional[str]):
+    """``"32"`` -> ``(32,)``; ``"32,32,3"`` -> ``(32, 32, 3)``; None/""
+    -> None (fall back to :func:`_infer_feature_shape`)."""
+    if not spec:
+        return None
+    return tuple(int(d) for d in str(spec).split(",") if str(d).strip())
+
+
+class ServingWorker:
+    """One serving replica as a library object: model + batcher + HTTP
+    runner + (optional) manifest watcher/hot-swap/canary.  The CLI ``main``
+    below and the serving bench/dryrun both drive this class; tests use it
+    in-process."""
+
+    def __init__(self, model_name: str, classes: int, *,
+                 params=None, params_path: Optional[str] = None,
+                 publish_dir: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int = 32, max_queue: int = 256,
+                 flush_ms: float = 2.0, canary_fraction: float = 0.0,
+                 canary_min_batches: int = 8, poll_s: float = 0.05,
+                 feature_shape=None, aot_dir: Optional[str] = None,
+                 bootstrap_timeout_s: float = 60.0):
+        from ..arguments import Config
+        from ..models import model_hub
+        from .batcher import MicroBatcher
+        from .inference import FedMLInferenceRunner, JaxPredictor
+        from .publisher import HotSwapController, ManifestWatcher, watch_and_swap
+
+        cfg = Config(model=model_name, dataset="synthetic")
+        self.model = model_hub.create(cfg, int(classes))
+        self.publish_dir = publish_dir
+        self._watcher: Optional[ManifestWatcher] = None
+        self._stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+
+        version = 0
+        if params is None and params_path:
+            params = load_params(params_path)
+        if params is None:
+            if not publish_dir:
+                raise ValueError(
+                    "worker needs --params or --publish-dir (manifest bootstrap)")
+            # bootstrap from the publication manifest: serve the first
+            # published version without any local artifact
+            boot = ManifestWatcher(publish_dir)
+            got = boot.wait_for_version(0, timeout_s=bootstrap_timeout_s,
+                                        poll_s=min(0.05, poll_s))
+            if got is None:
+                raise TimeoutError(
+                    f"no model published under {publish_dir} within "
+                    f"{bootstrap_timeout_s}s")
+            version, path, _manifest = got
+            params = load_params(path)
+            self._watcher = boot
+        elif publish_dir:
+            self._watcher = ManifestWatcher(publish_dir, last_version=version)
+
+        aot_store = None
+        if aot_dir:
+            from ..core.aot import ProgramStore
+
+            aot_store = ProgramStore(str(aot_dir))
+        if feature_shape is None:
+            feature_shape = _infer_feature_shape(params)
+        self.predictor = JaxPredictor(
+            self.model, params, max_batch=max_batch, aot_store=aot_store,
+            feature_shape=feature_shape, model_name=model_name)
+        # Warm up BEFORE serving: readiness must mean "can answer within
+        # SLO", and the first jit compile can take tens of seconds on a
+        # loaded host — a /ready that predates compilation makes the
+        # gateway time out.  (With --aot-dir the warm is a deserialized
+        # program's first execution: milliseconds.)
+        self.predictor.warm()
+        self.swap = HotSwapController(
+            self.predictor, version=version,
+            canary_fraction=canary_fraction,
+            canary_min_batches=canary_min_batches)
+        self.batcher = MicroBatcher(
+            self.predictor, controller=self.swap, max_batch=max_batch,
+            max_queue=max_queue, flush_ms=flush_ms)
+        self.runner = FedMLInferenceRunner(
+            self.predictor, host=host, port=port, batcher=self.batcher,
+            stats_fn=self.stats)
+        if self._watcher is not None:
+            self._watch_thread = watch_and_swap(
+                self._watcher, self.swap, self._load_version, self._stop,
+                poll_s=poll_s)
+
+    # -- hot swap -------------------------------------------------------------
+    def _load_version(self, version: int, path: str, _manifest: dict):
+        """Decode + warm a published tree OFF the serving path (the old tree
+        serves until this returns): the zero-drop half of the hot swap."""
+        params = load_params(path)
+        pred = self.predictor.clone_with(params)
+        pred.warm()
+        return pred
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self, block: bool = False) -> int:
+        """Serve; returns the bound port (non-blocking mode)."""
+        return self.runner.run(block=block)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5.0)
+        self.batcher.stop()
+        self.runner.stop()
+
+    def stats(self) -> dict:
+        return {**self.batcher.stats(), **self.swap.stats()}
+
+    @property
+    def served_version(self) -> int:
+        return self.swap.version
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", required=True)
     ap.add_argument("--classes", type=int, default=10)
-    ap.add_argument("--params", required=True)
+    ap.add_argument("--params", default=None,
+                    help="pytree-wire params file (optional with --publish-dir)")
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-queue", type=int, default=256,
+                    help="admission queue bound (full queue -> 503 + Retry-After)")
+    ap.add_argument("--flush-ms", type=float, default=2.0,
+                    help="partial micro-batch flush deadline (0 = immediate)")
+    ap.add_argument("--feature-dim", default=None,
+                    help="input feature shape, comma-separated (e.g. 32 or "
+                         "32,32,3) — overrides inference from the parameter "
+                         "tree so conv models warm up before /ready too")
+    ap.add_argument("--publish-dir", default=None,
+                    help="training server's model publication dir: poll the "
+                         "manifest and hot-swap new versions with zero "
+                         "dropped requests")
+    ap.add_argument("--poll-s", type=float, default=0.25,
+                    help="manifest poll interval")
+    ap.add_argument("--canary-fraction", type=float, default=0.0,
+                    help="fraction of micro-batches routed to a freshly "
+                         "published version before promotion (0 = direct "
+                         "swap); regressions auto-roll-back")
+    ap.add_argument("--canary-min-batches", type=int, default=8)
+    ap.add_argument("--aot-dir", default=None,
+                    help="AOT program store dir: deserialize the exported "
+                         "inference apply instead of re-tracing on restart")
     args = ap.parse_args(argv)
 
-    from ..arguments import Config
-    from ..models import model_hub
-    from .inference import FedMLInferenceRunner, JaxPredictor
-
-    cfg = Config(model=args.model, dataset="synthetic")
-    model = model_hub.create(cfg, args.classes)
-    variables = load_params(args.params)
-    predictor = JaxPredictor(model, variables, max_batch=args.max_batch)
-    # Warm up BEFORE serving: readiness must mean "can answer within SLO",
-    # and the first jit compile can take tens of seconds on a loaded host —
-    # a /ready that predates compilation makes the gateway time out.
-    feat_shape = _infer_feature_shape(variables)
-    if feat_shape is not None:
-        predictor.predict({"inputs": [[0.0] * feat_shape[0]]})
-    runner = FedMLInferenceRunner(predictor, host=args.host, port=args.port)
-    runner.run(block=True)
+    worker = ServingWorker(
+        args.model, args.classes, params_path=args.params,
+        publish_dir=args.publish_dir, host=args.host, port=args.port,
+        max_batch=args.max_batch, max_queue=args.max_queue,
+        flush_ms=args.flush_ms, canary_fraction=args.canary_fraction,
+        canary_min_batches=args.canary_min_batches, poll_s=args.poll_s,
+        feature_shape=parse_feature_dim(args.feature_dim),
+        aot_dir=args.aot_dir)
+    worker.start(block=True)
     return 0
 
 
 def _infer_feature_shape(variables):
     """Best-effort input shape from the first kernel leaf (LR/MLP: (d, c) ->
-    (d,)); None when unknown (conv models warm up on first request)."""
+    (d,)); None when unknown (conv models need ``--feature-dim`` to warm up
+    before serving)."""
     import numpy as np
 
     def walk(tree):

@@ -231,6 +231,7 @@ def test_health_gated_admission_throttles_not_drops(eight_devices):
         server.finish()
 
 
+@pytest.mark.locksan
 def test_async_e2e_inproc_real_clients(eight_devices):
     """Full protocol with REAL training clients over the in-proc fabric:
     virtual rounds close, eval runs, peak buffered stays <= 2."""
@@ -615,6 +616,7 @@ def test_get_control_never_materializes(eight_devices):
 # soak harness (small), AOT satellites
 # ---------------------------------------------------------------------------
 
+@pytest.mark.locksan
 def test_soak_small(eight_devices):
     from fedml_tpu.cross_silo.async_soak import run_soak
 

@@ -29,6 +29,8 @@ INSTRUMENTED_MODULES = [
     "fedml_tpu.obs.otlp",
     "fedml_tpu.obs.remote",
     "fedml_tpu.ops.pallas.timing",
+    "fedml_tpu.serving.batcher",
+    "fedml_tpu.serving.publisher",
     "fedml_tpu.sim.engine",
 ]
 
