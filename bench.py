@@ -42,6 +42,13 @@ Three benches, one JSON line:
    continuous-batching worker serves HTTP traffic and hot-swaps each
    version — QPS (floor-guarded), p50/p99 latency, zero dropped requests
    across >= 3 hot swaps, final served version == final published version.
+9. **Federated LoRA rounds** (ISSUE 12): 2 LLM silos exchange rank-8
+   adapter deltas through the streaming cross-silo protocol, raw vs qsgd8 —
+   bytes/round (adapter wire ratio floor >= 3.5x), rounds/s, peak buffered
+   updates <= 2, MFU during local LoRA steps, the dense-model-vs-adapter
+   wire ratio (~100x, floor >= 50x), and a streaming-vs-exact bitwise
+   equality proof at staleness 0.  CPU-runnable; `--mode federated_lora`
+   runs just this section with the same exit-3 / one-retry floor policy.
 
 The reference publishes no numeric baselines (BASELINE.md) and has no MFU
 accounting at all; the 0.35 target comes from BASELINE.json's north star.
@@ -602,6 +609,166 @@ def bench_serving():
         shutil.rmtree(publish_dir, ignore_errors=True)
 
 
+def bench_federated_lora():
+    """Federated LoRA rounds on the fast path (ISSUE 12): 2 LLM silos fine-
+    tune a shared tiny transformer and exchange ONLY rank-8 adapter deltas
+    through the cross-silo streaming protocol, raw vs qsgd8.
+
+    Four measurements: (1) the qsgd8 wire ratio on the adapter tree (floor
+    >= 3.5x, platform independent — per-tree low-rank compression floor);
+    (2) the dense-model-vs-adapter wire ratio (the ~100x saving the
+    unitedllm module docstring promises; floor >= 50x); (3) an e2e in-proc
+    raw-vs-qsgd8 A/B — bytes/round, rounds/s, peak buffered updates (<= 2);
+    (4) MFU during the silo's local LoRA steps.  Plus the bitwise proof:
+    streaming LoRA aggregation == exact buffer-all at staleness 0."""
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.comm import codecs, wire
+    from fedml_tpu.comm.base import BYTES_RECEIVED
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+    from fedml_tpu.data import loader
+    from fedml_tpu.llm.unitedllm import (
+        LoRAAggregator, LoRASiloTrainer, run_unitedllm_process_group,
+    )
+    from fedml_tpu.ops import flops as flopslib
+
+    rounds = int(os.environ.get("BENCH_LORA_ROUNDS", "2"))
+    silos = int(os.environ.get("BENCH_LORA_SILOS", "2"))
+    lora_r = 8
+    # q/k/v projections only: every rank-8 factor is exactly one qsgd8 block
+    # (1024 elements), so the compressed tree carries zero padding waste
+    targets = r".*attn/w[qkv]/kernel"
+
+    def make_cfg(run_id, extra=None):
+        e = {"unitedllm": True, "lora_r": lora_r, "lora_targets": targets,
+             "streaming_aggregation": True}
+        e.update(extra or {})
+        return Config(
+            training_type="cross_cloud", dataset="shakespeare",
+            model="transformer", client_num_in_total=silos,
+            client_num_per_round=silos, comm_round=rounds, epochs=1,
+            batch_size=4, learning_rate=0.01,
+            synthetic_train_size=64 * silos, synthetic_test_size=32,
+            frequency_of_the_test=0, compute_dtype="float32",
+            metrics_jsonl_path="", run_id=run_id, extra=e,
+        )
+
+    # ---- 1) static wire ratios on the adapter tree (the floors) ----
+    cfg0 = make_cfg("bench_lora_static")
+    fedml_tpu.init(cfg0)
+    ds = loader.load(cfg0)
+    agg = LoRAAggregator(cfg0, ds)
+    r_state = np.random.RandomState(0)
+    adapters = jax.tree_util.tree_map(
+        lambda x: r_state.randn(*np.shape(x)).astype(np.float32),
+        jax.device_get(agg.global_vars))
+    raw_wire = len(wire.encode_pytree({"model_params": adapters}))
+    comp, _, _ = codecs.compress_pytree(
+        adapters, "qsgd8", key=jax.random.PRNGKey(1),
+        min_elems=codecs.LOW_RANK_MIN_COMPRESS_ELEMS)
+    comp_wire = len(wire.encode_pytree({"model_params": comp}))
+    dense_wire = len(wire.encode_pytree(
+        {"model_params": jax.device_get(agg.base_params)}))
+    qsgd8_ratio = raw_wire / max(comp_wire, 1)
+    dense_ratio = dense_wire / max(comp_wire, 1)
+
+    # ---- 2) streaming == exact, bitwise at staleness 0 ----
+    exact = LoRAAggregator(make_cfg("bench_lora_ex", {"streaming_aggregation": False}), ds)
+    stream = LoRAAggregator(make_cfg("bench_lora_st"), ds)
+    base = jax.device_get(exact.global_vars)
+    for cid in (1, 2):
+        rs = np.random.RandomState(cid)
+        params = jax.tree_util.tree_map(
+            lambda x: np.asarray(x, np.float32)
+            + rs.randn(*np.shape(x)).astype(np.float32), base)
+        exact.add_local_trained_result(cid, params, 64.0)
+        msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, cid, 0)
+        msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+        assert stream.ingest_streaming(cid, Message.decode(msg.encode()), 64.0,
+                                       is_delta=False)
+    bitwise = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(exact.aggregate(0))),
+                        jax.tree_util.tree_leaves(jax.device_get(stream.aggregate(0)))))
+
+    # ---- 3) MFU during local LoRA steps ----
+    trainer = LoRASiloTrainer(cfg0, ds, ds.train_x[ds.client_idx[0]],
+                              ds.train_y[ds.client_idx[0]])
+    lora0 = jax.tree_util.tree_map(np.asarray, adapters)
+    from fedml_tpu.core import rng as rnglib
+
+    seed_key = rnglib.root_key(cfg0.random_seed)
+    trainer.train(lora0, 0, seed_key, 0)  # compile + warm
+    t0 = time.perf_counter()
+    trainer.train(lora0, 1, seed_key, 0)
+    dt_local = time.perf_counter() - t0
+    seq = int(ds.train_x.shape[1])
+    tokens = int(trainer._steps) * cfg0.batch_size * seq
+    n_params = sum(int(np.asarray(l).size) for l in jax.tree_util.tree_leaves(
+        jax.device_get(trainer.base_params))) + sum(
+        int(np.asarray(l).size) for l in jax.tree_util.tree_leaves(lora0))
+    tcfg = trainer.model.cfg
+    flops_tok = flopslib.transformer_train_flops_per_token(
+        n_params, tcfg.vocab_size * tcfg.d_model, tcfg.n_layers,
+        tcfg.d_model, seq)
+    peak = flopslib.device_peak_flops(jax.devices()[0])
+    tps_chip = tokens / dt_local / len(jax.devices())
+    local = {
+        "tokens_per_sec_chip": round(tps_chip, 1),
+        "mfu": round(tps_chip * flops_tok / peak, 4) if peak else None,
+        "n_params_m": round(n_params / 1e6, 3),
+        "seq_len": seq,
+        "local_steps": int(trainer._steps),
+    }
+
+    # ---- 4) e2e in-proc rounds, raw vs qsgd8 ----
+    def run(codec):
+        extra = {"comm_compression": codec} if codec else {}
+        cfg = make_cfg(f"bench_lora_{codec or 'raw'}", extra)
+        fedml_tpu.init(cfg)
+        run_ds = loader.load(cfg)
+        bytes0 = BYTES_RECEIVED.value()
+        t0 = time.perf_counter()
+        _, server = run_unitedllm_process_group(cfg, run_ds, backend="INPROC",
+                                                timeout=600.0)
+        dt = time.perf_counter() - t0
+        return {
+            "wall_s": round(dt, 3),
+            "rounds_per_sec": round(rounds / dt, 3),
+            "wire_bytes_received": int(BYTES_RECEIVED.value() - bytes0),
+            "bytes_per_round": int((BYTES_RECEIVED.value() - bytes0) / rounds),
+            "peak_buffered_updates": int(server.aggregator.peak_buffered_updates),
+            "streaming": bool(server.aggregator.stream_mode),
+        }
+
+    raw = run(None)
+    qsgd8 = run("qsgd8")
+    return {
+        "rounds": rounds,
+        "silos": silos,
+        "lora_r": lora_r,
+        "qsgd8_ratio_lora": round(qsgd8_ratio, 3),
+        "adapter_wire_bytes_raw": int(raw_wire),
+        "adapter_wire_bytes_qsgd8": int(comp_wire),
+        "dense_model_bytes": int(dense_wire),
+        "dense_vs_adapter_ratio": round(dense_ratio, 1),
+        "stream_exact_bitwise": bool(bitwise),
+        "peak_buffered_updates": max(raw["peak_buffered_updates"],
+                                     qsgd8["peak_buffered_updates"]),
+        "raw": raw,
+        "qsgd8": qsgd8,
+        "e2e_bytes_reduction": round(
+            raw["wire_bytes_received"] / max(qsgd8["wire_bytes_received"], 1), 3),
+        "local_lora": local,
+        "payload_counters": codecs.payload_counters(),
+    }
+
+
 def bench_llm(peak):
     import jax
     import jax.numpy as jnp
@@ -682,6 +849,8 @@ def _run_one(mode):
         result = bench_chaos()
     elif mode == "serving":
         result = bench_serving()
+    elif mode == "federated_lora":
+        result = bench_federated_lora()
     else:
         result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
@@ -762,6 +931,15 @@ CHAOS_RECOVERY_RATIO_FLOOR = 0.5
 #: 4-thread load, so 20 catches order-of-magnitude regressions while
 #: tolerating a loaded box running training concurrently).
 SERVING_QPS_FLOOR = 20.0
+#: qsgd8 wire ratio on the rank-8 LoRA adapter tree (ISSUE 12) — platform
+#: independent (int8 + per-block scales vs f32; the q/k/v factors are exact
+#: 1024-element blocks), so it is asserted on CPU too.
+LORA_QSGD8_RATIO_FLOOR = 3.5
+#: Dense-model-vs-compressed-adapter wire ratio (ISSUE 12): the federated
+#: LLM scenario exists because the adapter exchange is ~100x cheaper than
+#: shipping the model; 50x catches a broken floor without flaking on vocab-
+#: dependent model size.
+LORA_DENSE_ADAPTER_RATIO_FLOOR = 50.0
 #: Warm start-to-first-round as a fraction of cold (ISSUE 7) — platform
 #: independent (the AOT store removes re-tracing everywhere; on CPU the
 #: deserialized program's compile additionally rides the persistent
@@ -770,7 +948,57 @@ SERVING_QPS_FLOOR = 20.0
 AOT_WARM_RATIO_CEILING = 0.5
 
 
+def _federated_lora_violations(res) -> list:
+    """Floor checks for the federated_lora section (shared by the full bench
+    and `--mode federated_lora`)."""
+    v = []
+    ratio = res.get("qsgd8_ratio_lora")
+    if ratio is not None and ratio < LORA_QSGD8_RATIO_FLOOR:
+        v.append(f"federated_lora qsgd8 ratio {ratio} < floor "
+                 f"{LORA_QSGD8_RATIO_FLOOR}")
+    dense = res.get("dense_vs_adapter_ratio")
+    if dense is not None and dense < LORA_DENSE_ADAPTER_RATIO_FLOOR:
+        v.append(f"federated_lora dense/adapter wire ratio {dense} < floor "
+                 f"{LORA_DENSE_ADAPTER_RATIO_FLOOR}")
+    if res.get("peak_buffered_updates", 0) > 2:
+        v.append(f"federated_lora peak buffered updates "
+                 f"{res['peak_buffered_updates']} > 2 (streaming fold not "
+                 "engaged)")
+    if not res.get("stream_exact_bitwise", False):
+        v.append("federated_lora streaming aggregation != exact (bitwise "
+                 "proof at staleness 0 failed)")
+    for leg in ("raw", "qsgd8"):
+        if not res.get(leg, {}).get("streaming", False):
+            v.append(f"federated_lora {leg} leg did not engage the streaming "
+                     "accumulator")
+    return v
+
+
+def _mode_violations(mode, result) -> list:
+    if mode == "federated_lora":
+        return _federated_lora_violations(result)
+    return []
+
+
 def main():
+    argv = sys.argv[1:]
+    if "--mode" in argv:
+        # single-section run (`bench.py --mode federated_lora`): same
+        # exit-3 / one-retry floor policy as the full bench
+        mode = argv[argv.index("--mode") + 1]
+        result = _subprocess_bench(mode)
+        violations = _mode_violations(mode, result)
+        if violations:
+            result = _subprocess_bench(mode)
+            violations = _mode_violations(mode, result)
+        print(json.dumps({"metric": f"bench_{mode}", "detail": result,
+                          "floor_violations": violations}))
+        if violations:
+            sys.stdout.flush()
+            print("BENCH FLOOR VIOLATION: " + "; ".join(violations),
+                  file=sys.stderr)
+            sys.exit(3)
+        return
     if os.environ.get("BENCH_MODE"):
         _run_one(os.environ["BENCH_MODE"])
         return
@@ -830,6 +1058,13 @@ def main():
     # zero dropped requests across >= 3 hot swaps + final served version
     # == final published version
     serving = _subprocess_bench("serving")
+    # ISSUE-12 federated LoRA: adapter deltas over the compressed streaming
+    # wire — qsgd8 adapter ratio floor, dense-vs-adapter ~100x, peak
+    # buffered <= 2, streaming==exact bitwise at staleness 0
+    federated_lora = _subprocess_bench("federated_lora")
+    if _federated_lora_violations(federated_lora):
+        # same one-retry policy as the other floors
+        federated_lora = _subprocess_bench("federated_lora")
     # ISSUE-7 cold_start: two fresh processes share one AOT program store +
     # compilation cache root; the first populates it, the second must
     # deserialize every program (misses == 0) and start in <= 0.5x the time
@@ -932,6 +1167,7 @@ def main():
         violations.append(
             f"serving final served version {serving.get('served_version_final')} "
             f"!= final published version {serving.get('versions_published')}")
+    violations += _federated_lora_violations(federated_lora)
     pop_rss = population.get("rss_multiple")
     if pop_rss is not None and pop_rss > POPULATION_RSS_MULTIPLE_FLOOR:
         violations.append(
@@ -971,6 +1207,7 @@ def main():
             "async": async_soak,
             "chaos": chaos,
             "serving": serving,
+            "federated_lora": federated_lora,
             "aot": aot,
             "lint": lint_section,
         },

@@ -8,6 +8,7 @@ semantics-preserving mechanical form — the ``.get`` call::
     (getattr(cfg, "extra", {}) or {}).get("k", 3)     -> cfg_extra(cfg, 'k', 3)
     extra = cfg.extra; ... extra.get("silo_dp", True) -> cfg_extra(cfg, 'silo_dp', True)
     x = extra.setdefault("k", 3)                      -> x = cfg_extra(cfg, 'k', 3)
+    x = cfg.extra["k"]                                -> x = cfg_extra(cfg, 'k', None)
 
 The original default expression is carried verbatim (``.get`` with no default
 becomes an explicit ``None``), so the rewrite never swaps in the registry
@@ -22,10 +23,20 @@ dead weight.  A *statement*-position ``extra.setdefault(...)`` exists ONLY
 for that side effect (someone downstream reads the dict raw), so it is
 still reported for manual migration rather than silently deleted.
 
-Sites the fixer cannot prove out — statement-position ``setdefault``,
-subscripts (KeyError semantics), ``in`` membership tests, non-literal flag
-names, and receivers whose owning config expression cannot be recovered —
-are reported for manual migration, never guessed at.
+Value-position ``extra["k"]`` subscript READS are rewritten to
+``cfg_extra(cfg, 'k', None)`` (ISSUE 12 satellite).  This is the one rewrite
+that intentionally changes missing-key behavior: the subscript raised
+``KeyError`` where ``cfg_extra`` returns ``None`` — but a flag read that
+crashes on an unset flag is exactly the misconfiguration failure mode the
+registry exists to kill, and every rewritten name becomes a declared,
+GL001-checked read.  Set keys behave identically (proven by test).
+Statement-position subscripts, Store/Del/augmented targets, and write sites
+are left alone.
+
+Sites the fixer cannot prove out — statement-position ``setdefault`` and
+subscripts, ``in`` membership tests, non-literal flag names, and receivers
+whose owning config expression cannot be recovered — are reported for
+manual migration, never guessed at.
 
 ``fix_source`` loops to a fixpoint (a ``.get`` nested inside another's
 default argument is rewritten on the next pass), which is also what makes
@@ -112,11 +123,13 @@ def _one_pass(source: str, relpath: str,
     caught by the fixpoint loop in :func:`fix_source`)."""
     tree = ast.parse(source)
     offsets = _line_offsets(source)
-    # calls whose value is discarded (bare expression statements): a
-    # setdefault here exists only for its dict-seeding side effect
-    stmt_position_calls = {
+    # expressions whose value is discarded (bare expression statements): a
+    # setdefault here exists only for its dict-seeding side effect, and a
+    # bare subscript read has no value consumer to migrate
+    stmt_position = {
         id(stmt.value) for stmt in ast.walk(tree)
-        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+        if isinstance(stmt, ast.Expr)
+        and isinstance(stmt.value, (ast.Call, ast.Subscript))
     }
     extra_vars: set[str] = set()
     assigned: dict[str, Optional[str]] = {}
@@ -146,7 +159,7 @@ def _one_pass(source: str, relpath: str,
             continue
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
                 and node.args and _is_extra_expr(node.func.value, extra_vars):
-            if node.func.attr == "setdefault" and id(node) in stmt_position_calls:
+            if node.func.attr == "setdefault" and id(node) in stmt_position:
                 skip(node, "statement-position extra.setdefault(...) exists only "
                            "to seed the dict for a raw downstream read — "
                            "migrate that read to cfg_extra by hand")
@@ -173,9 +186,25 @@ def _one_pass(source: str, relpath: str,
             candidates.append((_span(node, offsets), replacement))
         elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load) \
                 and _is_extra_expr(node.value, extra_vars):
-            skip(node, f"extra[{ast.unparse(node.slice)}]: subscript raises on a "
-                       "missing key where cfg_extra returns the default — "
-                       "migrate by hand")
+            if id(node) in stmt_position:
+                skip(node, "statement-position extra[...] has no value use — "
+                           "migrate (or delete) the site by hand")
+                continue
+            name = str_const(node.slice)
+            if name is None:
+                skip(node, f"extra[{ast.unparse(node.slice)}] — GL001 needs a "
+                           "literal flag name; migrate by hand")
+                continue
+            cfg_src = _cfg_expr_of(node.value, assigned)
+            if cfg_src is None:
+                skip(node, f"extra[{name!r}]: owning config object not "
+                           "recoverable — migrate by hand")
+                continue
+            # value-position subscript read: becomes the registry-checked
+            # read with default None (missing key: KeyError -> None — the
+            # deliberate semantics change documented in the module docstring)
+            candidates.append(
+                (_span(node, offsets), f"cfg_extra({cfg_src}, {name!r}, None)"))
         elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
                 and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
                 and _is_extra_expr(node.comparators[0], extra_vars):

@@ -53,6 +53,15 @@ CODECS = ("raw", "qsgd8", "topk")
 #: elements) would expand them, and their bytes are noise at model scale
 DEFAULT_MIN_COMPRESS_ELEMS = 1024
 
+#: per-tree floor for LOW-RANK exchanged trees (LoRA adapter factors): the
+#: smallest leaf size at which qsgd8 cannot expand.  A leaf of n f32 elements
+#: is 4n raw bytes and ceil(n/1024)*(1024 + 4) compressed bytes, so for
+#: n <= 1024 compression shrinks iff n > 257 — 260 adds a small margin.
+#: Trainers whose whole payload is rank-r factors (``LoRASiloTrainer``)
+#: declare this as their ``comm_compress_min_elems`` so adapter leaves ride
+#: the compressed wire where the model-scale default would leave them raw.
+LOW_RANK_MIN_COMPRESS_ELEMS = 260
+
 
 def codec_from_config(cfg) -> Optional[str]:
     """``extra.comm_compression`` -> validated codec name, or None when
@@ -104,7 +113,10 @@ def compress_pytree(tree, codec: Optional[str], *, key=None, residuals=None,
     Returns ``(compressed_tree, new_residuals, stats)``.  ``residuals`` /
     ``new_residuals`` are leaf-aligned lists (jax flatten order) carrying the
     top-k error-feedback state across rounds; qsgd8 is unbiased and carries
-    none.  ``stats`` = {"raw_bytes", "wire_bytes", "ratio"}.
+    none.  ``stats`` = {"raw_bytes", "wire_bytes", "ratio"}.  ``min_elems``
+    is the per-tree floor: callers whose whole tree is low-rank (LoRA
+    adapters) pass :data:`LOW_RANK_MIN_COMPRESS_ELEMS` instead of the
+    model-scale default.
     """
     import jax
     import jax.numpy as jnp

@@ -133,6 +133,13 @@ FLAGS: dict[str, FlagSpec] = _specs(
     FlagSpec("streaming_aggregation", "bool", False,
              "Fold arriving client updates into a running weighted sum even "
              "without a codec (peak buffered updates <= 2)."),
+    FlagSpec("server_shard_fold", "bool", False,
+             "Place the server's streaming-fold accumulator (and the "
+             "finalized global it produces) under parallel/mesh "
+             "NamedShardings: each arriving leaf is device_put to its shard "
+             "owners and folded there under jit instead of host-gathered — "
+             "bitwise the host fold (unset = the host numpy fold, "
+             "bit-identical to before the flag existed)."),
     FlagSpec("comm_chunk_bytes", "int", 0,
              "Split gRPC/TCP/in-proc sends larger than this into bounded "
              "chunk frames that interleave at the socket level — BOTH legs: "

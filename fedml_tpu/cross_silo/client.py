@@ -191,8 +191,15 @@ class ClientMasterManager(FedMLCommManager):
         self._comm_residuals = None
         self._comm_ratio = float(cfg_extra(
             cfg, "comm_topk_ratio", getattr(cfg, "compression_ratio", 0.01) or 0.01))
-        self._comm_min_elems = int(cfg_extra(
-            cfg, "comm_compress_min_size", codecs.DEFAULT_MIN_COMPRESS_ELEMS))
+        # compression floor resolution: an EXPLICIT comm_compress_min_size
+        # flag wins; otherwise a trainer that knows its exchanged tree is
+        # small (LoRA adapters: rank-r factors) may declare a per-tree
+        # comm_compress_min_elems override; otherwise the model-scale default
+        min_elems = cfg_extra(cfg, "comm_compress_min_size", None)
+        if min_elems is None:
+            min_elems = getattr(trainer, "comm_compress_min_elems", None)
+        self._comm_min_elems = int(
+            min_elems if min_elems is not None else codecs.DEFAULT_MIN_COMPRESS_ELEMS)
         # remote observability: per-round events (+ anything the caller
         # ships via self.obs — perf samples, RuntimeLogDaemon batches) ride
         # the FL transport to the server's ObsCollector.  The train events

@@ -76,6 +76,10 @@ class FHEAggregator(FedMLAggregator):
     def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
         check_fhe_compatible(cfg)
         super().__init__(cfg, model, sample_x, test_arrays, trust=None)
+        # ciphertext block stacks are not foldable f32 trees: the associative
+        # streaming path must NEVER engage here, whatever the comm flags say
+        self.stream_mode = False
+        self._shard_fold = False
         self.cipher = fhe_cipher(cfg)
         flat, self._unravel = jax.flatten_util.ravel_pytree(self.global_vars)
         self.model_dim = int(flat.size)

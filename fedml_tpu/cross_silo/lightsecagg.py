@@ -106,6 +106,10 @@ class LSAAggregator(FedMLAggregator):
 
     def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
         super().__init__(cfg, model, sample_x, test_arrays, trust=trust)
+        # masked field vectors are not foldable f32 trees: the associative
+        # streaming path must NEVER engage here, whatever the comm flags say
+        self.stream_mode = False
+        self._shard_fold = False
         t, u, self.q_bits = secagg_params(cfg)
         self.protocol = LightSecAggProtocol(cfg.client_num_in_total, t, u)
         flat, self._unravel = jax.flatten_util.ravel_pytree(self.global_vars)

@@ -162,6 +162,11 @@ class SAAggregator(FedMLAggregator):
 
     def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
         super().__init__(cfg, model, sample_x, test_arrays, trust=trust)
+        # masked field vectors are not foldable f32 trees: the associative
+        # streaming path must NEVER engage here, whatever the comm flags say
+        # (regression-tested — the LoRA opt-in must not bypass this)
+        self.stream_mode = False
+        self._shard_fold = False
         self.t, self.q_bits = shamir_secagg_params(cfg)
         flat, self._unravel = jax.flatten_util.ravel_pytree(self.global_vars)
         self.model_dim = int(flat.size)

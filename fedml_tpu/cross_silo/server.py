@@ -96,11 +96,13 @@ class FedMLAggregator:
 
     # class-level defaults for the streaming-aggregation machinery so that
     # subclasses which deliberately skip __init__ (LoRAAggregator builds its
-    # own adapter-tree state) inherit the safe exact-mode behavior
+    # own adapter-tree state, then opts back in via _init_stream_mode)
+    # inherit the safe exact-mode behavior
     stream_mode = False
+    _shard_fold = False
     _np_global = None
     _stream_tmpl = None
-    _stream_sum = None
+    _stream_acc = None
     _stream_w = 0.0
     _stream_w_delta = 0.0
     _stream_folded = 0
@@ -145,23 +147,33 @@ class FedMLAggregator:
                 jax.jit(eval_fn), (self.global_vars, *self._test))))
         else:
             self._eval_fn = jax.jit(eval_fn)
-        # streaming aggregation: fold each arriving update into a running
-        # weighted sum as it lands (overlapping aggregation with the network
-        # tail; peak host memory ~2x model instead of N x model).  Engaged
-        # only when compression / extra.streaming_aggregation / the
-        # buffered-async server asks for it AND the algorithm declares its
-        # aggregate a weight-associative fold AND no trust pipeline needs the
-        # stacked client models — otherwise the exact buffer-all path below
-        # stays reference-bit-exact.
+        self._init_stream_mode(cfg)
+
+    def _init_stream_mode(self, cfg) -> None:
+        """Engage the streaming accumulator: fold each arriving update into a
+        running weighted sum as it lands (overlapping aggregation with the
+        network tail; peak host memory ~2x model instead of N x model).
+        Engaged only when compression / extra.streaming_aggregation / the
+        buffered-async server asks for it AND the algorithm declares its
+        aggregate a weight-associative fold AND no trust pipeline needs the
+        stacked client models — otherwise the exact buffer-all path stays
+        reference-bit-exact.  Shared by the base __init__ and subclasses that
+        skip it (LoRAAggregator); requires ``self.algorithm``/``self.trust``
+        to be set."""
         self.stream_mode = bool(
             (codecs.codec_from_config(cfg) or cfg_extra(cfg, "streaming_aggregation")
              or cfg_extra(cfg, "async_aggregation"))
-            and trust is None
+            and self.trust is None
             and self.algorithm.supports_associative_fold()
         )
+        # sharded fold (extra.server_shard_fold): the accumulator (and the
+        # finalized global) live under parallel/mesh NamedShardings — each
+        # arriving leaf folds on its shard-owning devices under jit
+        self._shard_fold = self.stream_mode and bool(
+            cfg_extra(cfg, "server_shard_fold"))
         self._np_global = None      # host copy of global_vars, per round
         self._stream_tmpl = None    # (template leaves, wire skeleton), per round
-        self._stream_sum: Optional[list] = None
+        self._stream_acc = None     # parallel.stream_fold accumulator, per round
         self._stream_w = 0.0
         self._stream_w_delta = 0.0
         self._stream_folded = 0
@@ -184,7 +196,7 @@ class FedMLAggregator:
         return self._stream_tmpl
 
     def _note_buffered(self, inflight: int = 0) -> None:
-        n = len(self.model_dict) + inflight + (1 if self._stream_sum is not None else 0)
+        n = len(self.model_dict) + inflight + (1 if self._stream_acc is not None else 0)
         if n > self.peak_buffered_updates:
             self.peak_buffered_updates = n
 
@@ -230,14 +242,17 @@ class FedMLAggregator:
             if tuple(spec["shape"]) != t.shape:
                 log.warning("client %d leaf shape mismatch; buffering densely", client_idx)
                 return False
-        if self._stream_sum is None:
-            self._stream_sum = [np.zeros(t.shape, np.float32) for t in tmpl]
+        if self._stream_acc is None:
+            from ..parallel.stream_fold import make_stream_accumulator
+
+            self._stream_acc = make_stream_accumulator(
+                tmpl, sharded=self._shard_fold)
         # buffered right now: the accumulator + this in-flight decode (+ any
         # dense fallbacks) — the quantity the <=2 acceptance bound tracks
         self._note_buffered(inflight=1)
         w = float(sample_num) * float(scale)
         for i, _spec, arr in leaf_iter:
-            self._stream_sum[i] += w * np.asarray(arr, dtype=np.float32)
+            self._stream_acc.fold_leaf(i, w, arr)
         self._stream_w += w
         if is_delta:
             self._stream_w_delta += w
@@ -335,17 +350,13 @@ class FedMLAggregator:
                 {md.MSG_ARG_KEY_MODEL_PARAMS: self.model_dict[cid]}
             )
             for i, leaf in enumerate(leaves):
-                self._stream_sum[i] += w * np.asarray(leaf, dtype=np.float32)
+                self._stream_acc.fold_leaf(i, w, leaf)
             self._stream_w += w
         tot = max(self._stream_w, 1e-12)
-        out = []
-        for i, t in enumerate(tmpl):
-            acc = self._stream_sum[i]
-            if self._stream_w_delta:
-                # delta senders contributed w*(model - global): add their
-                # share of the base model back before normalizing
-                acc = acc + self._stream_w_delta * np.asarray(t, dtype=np.float32)
-            out.append((acc / tot).astype(t.dtype))
+        # normalize (+ delta-sender base add-back) on the accumulator's home:
+        # host numpy by default, the shard-owning devices under jit when
+        # server_shard_fold placed the sums there — bitwise-identical math
+        out = self._stream_acc.finalize(tmpl, self._stream_w_delta, tot)
         agg_np = wire.restore_skeleton(skel, out)[md.MSG_ARG_KEY_MODEL_PARAMS]
         agg = jax.tree_util.tree_map(jnp.asarray, agg_np)
         new_global, self.server_state = self.algorithm.server_update(
@@ -359,7 +370,7 @@ class FedMLAggregator:
         self.model_dict.clear()
         self.sample_num_dict.clear()
         self.flag_client_model_uploaded.clear()
-        self._stream_sum = None
+        self._stream_acc = None
         self._stream_w = 0.0
         self._stream_w_delta = 0.0
         self._stream_folded = 0
@@ -393,7 +404,8 @@ class FedMLAggregator:
             "stream_samples": {str(k): float(v)
                                for k, v in sorted(self.sample_num_dict.items())},
         }
-        arrays = {f"stream_sum_{i}": a for i, a in enumerate(self._stream_sum or [])}
+        sums = self._stream_acc.host_sums() if self._stream_acc is not None else []
+        arrays = {f"stream_sum_{i}": a for i, a in enumerate(sums)}
         return proto, arrays
 
     def restore_stream_state(self, proto: dict, arrays: dict) -> None:
@@ -404,12 +416,16 @@ class FedMLAggregator:
             return
         tmpl, _ = self._stream_template()
         try:
-            self._stream_sum = [np.asarray(arrays[f"stream_sum_{i}"], np.float32)
-                                for i in range(len(tmpl))]
+            sums = [np.asarray(arrays[f"stream_sum_{i}"], np.float32)
+                    for i in range(len(tmpl))]
         except KeyError:
             log.warning("journal: streaming partials incomplete — restarting "
                         "the fold buffer empty")
             return
+        from ..parallel.stream_fold import make_stream_accumulator
+
+        self._stream_acc = make_stream_accumulator(
+            tmpl, sharded=self._shard_fold, sums=sums)
         self._stream_w = float(proto.get("stream_w", 0.0))
         self._stream_w_delta = float(proto.get("stream_w_delta", 0.0))
         self._stream_folded = int(proto.get("stream_folded", 0))

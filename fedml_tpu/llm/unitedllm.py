@@ -18,6 +18,25 @@ same public checkpoint; what matters is only the adapters ride the wire).
 
 A round moves O(rank * d * layers) floats per silo.  For the default tiny
 config that is ~100x smaller than the base model — asserted by test.
+
+The exchange rides every cross-silo fast path (ISSUE 12):
+
+- **Streaming/associative folds**: ``LoRAAggregator`` opts into the
+  ``supports_associative_fold`` protocol via ``_init_stream_mode``, so
+  adapter uploads fold leaf-by-leaf into the streaming accumulator (peak
+  buffered <= 2) on both the sync server and the buffered-async server
+  (staleness-decayed LoRA folding, FedBuff-style).  A configured trust
+  pipeline still forces the exact buffer-all path — the PR-4 gate.
+- **Compressed delta uploads**: behind ``extra.comm_compression`` the silo
+  ships the qsgd8/topk-compressed DELTA vs the received global adapters.
+  Rank-r factors are small, so the trainer declares a per-tree
+  ``comm_compress_min_elems`` override (``codecs.LOW_RANK_MIN_COMPRESS_
+  ELEMS``) that lets adapter-sized leaves compress where the model-scale
+  default would leave them raw; an explicit ``comm_compress_min_size`` flag
+  still wins.
+- **Sharded server folds**: behind ``extra.server_shard_fold`` the fold and
+  the finalized adapter tree go through ``parallel/mesh`` NamedShardings —
+  folded on the shard-owning devices under jit, never host-gathered.
 """
 
 from __future__ import annotations
@@ -70,6 +89,14 @@ class LoRASiloTrainer:
         self.count = jnp.int32(x.shape[0])
         self._steps = cfg.epochs * max(1, math.ceil(x.shape[0] / cfg.batch_size))
         self._train = jax.jit(self._make_step())
+        # per-tree compression floor: rank-r adapter factors are far below
+        # the model-scale comm_compress_min_size default, so the exchanged
+        # tree would ship raw; this override (picked up by the client
+        # manager unless the flag is set explicitly) lets every
+        # non-expanding adapter leaf ride the qsgd8/topk wire
+        from ..comm.codecs import LOW_RANK_MIN_COMPRESS_ELEMS
+
+        self.comm_compress_min_elems = LOW_RANK_MIN_COMPRESS_ELEMS
 
     def _make_step(self):
         cfg = self.cfg
@@ -111,9 +138,16 @@ class LoRASiloTrainer:
 
 class LoRAAggregator(FedMLAggregator):
     """Cross-silo aggregator whose global state is the LoRA tree; evaluation
-    merges base+adapters and reports LM loss/perplexity."""
+    merges base+adapters and reports LM loss/perplexity.
 
-    def __init__(self, cfg, dataset):
+    On the associative-fold protocol: adapter aggregation is the stock
+    sample-weighted mean, so with compression/streaming/async flags set and
+    no trust pipeline configured, uploads fold leaf-by-leaf into the
+    streaming accumulator exactly like vision models (``_init_stream_mode``
+    applies the same gate — ``trust is None`` included, so secure-agg/FHE/DP
+    trust configurations still force the exact buffer-all path)."""
+
+    def __init__(self, cfg, dataset, trust=None):
         # deliberately NOT calling super().__init__: the base class builds a
         # classifier + eval pipeline from a flax vision model; here global
         # state is the adapter tree and eval is LM loss
@@ -125,7 +159,11 @@ class LoRAAggregator(FedMLAggregator):
         self.hp = hparams_from_config(cfg, steps_per_epoch=provisional_steps_per_epoch(cfg))
         self.algorithm = create_algorithm(cfg, self.hp)  # aggregate/server_update only
         self.server_state = self.algorithm.init_server_state(self.global_vars)
-        self.trust = None
+        if trust is None:
+            from ..trust.pipeline import build_trust_pipeline
+
+            trust = build_trust_pipeline(cfg)
+        self.trust = trust
         self._schedule_calibrated = True  # adapters carry no schedule state
         self.root_key = rng.root_key(cfg.random_seed)
         self.model_dict: dict[int, object] = {}
@@ -135,6 +173,12 @@ class LoRAAggregator(FedMLAggregator):
         self._eval_x = jnp.asarray(dataset.test_x[:n_eval])
         self._eval_y = jnp.asarray(dataset.test_y[:n_eval])
         self._eval_jit = jax.jit(self._eval_loss)
+        # no AOT-stored programs for the adapter eval (tiny trees, cheap jit)
+        self._aot = None
+        self._program_items: list = []
+        # the PR-4 streaming gate, shared with the base class: folds engage
+        # only behind the flags AND with no trust pipeline configured
+        self._init_stream_mode(cfg)
 
     def _calibrate_schedule(self) -> None:  # adapters: nothing to calibrate
         return
@@ -153,7 +197,15 @@ class LoRAAggregator(FedMLAggregator):
 
 
 def build_unitedllm_server(cfg, dataset, backend: Optional[str] = None) -> FedMLServerManager:
-    return FedMLServerManager(cfg, LoRAAggregator(cfg, dataset), backend=backend)
+    aggregator = LoRAAggregator(cfg, dataset)
+    if cfg_extra(cfg, "async_aggregation"):
+        # buffered-async LoRA: silo uploads fold with staleness-decayed
+        # weights, virtual rounds close at async_buffer_k arrivals — the
+        # same manager the vision path uses, adapter tree as global state
+        from ..cross_silo.async_server import AsyncFedMLServerManager
+
+        return AsyncFedMLServerManager(cfg, aggregator, backend=backend)
+    return FedMLServerManager(cfg, aggregator, backend=backend)
 
 
 def build_unitedllm_client(cfg, dataset, rank: int, backend: Optional[str] = None) -> ClientMasterManager:
@@ -182,6 +234,11 @@ def run_unitedllm_process_group(cfg, dataset, backend: str = "INPROC", timeout: 
         c.run_in_thread()
     try:
         history = server.run_until_done(timeout=timeout)
+        # graceful drain: a buffered-async silo may still be mid-train on its
+        # daemon thread when the server finishes — give each a bounded window
+        # to process FINISH, so interpreter exit never lands mid-XLA-call
+        for c in clients:
+            c.done.wait(5.0)
     finally:
         for c in clients:
             c.finish()

@@ -1,0 +1,183 @@
+"""Streaming-fold accumulators — host numpy and pjit-sharded device forms.
+
+The cross-silo streaming accumulator (``cross_silo/server.py``) folds each
+arriving model-reply leaf into a running weighted sum.  The historical form
+is a list of host f32 numpy arrays — fine while the exchanged tree fits one
+host, wrong once it doesn't (the 1810.11112 observation: at scale the server
+fold must shard, not gather).  This module gives the fold two interchangeable
+backends behind one interface:
+
+- :class:`HostStreamAccumulator` — the exact historical numpy math, kept
+  bit-identical (the default; also the journal's restore form).
+- :class:`ShardedStreamAccumulator` — every per-leaf sum lives as a jax
+  array under a :class:`~jax.sharding.NamedSharding` on a 1-D device mesh
+  (``parallel.mesh``); each arriving leaf is ``device_put`` to its shard
+  owners and folded there under jit, so no device ever materializes a whole
+  leaf it doesn't own, and the finalized global inherits the shardings.
+
+Both compute ``sum_i w_i * x_i`` in f32 and finalize as
+``((sum + w_delta * base) / total).astype(dtype)``.  Because every step is an
+IEEE elementwise f32 op (the weights are cast to f32 before the multiply on
+both paths), the sharded fold is **bitwise** the host fold — asserted by
+test and by the ``federated_lora`` bench.
+
+Engaged behind ``extra.server_shard_fold``; unset keeps the host path and
+its bytes untouched.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "HostStreamAccumulator",
+    "ShardedStreamAccumulator",
+    "make_stream_accumulator",
+]
+
+
+# Bitwise discipline: XLA contracts `a + w * x` inside one fused executable
+# into an FMA, which rounds ONCE where the host numpy fold rounds the
+# multiply and the add separately — so the device fold would drift from the
+# host fold by 1 ulp on ~half the elements.  Each step therefore runs as its
+# own single-op executable (mul, add, div+cast): nothing to contract, and
+# every op is the same IEEE f32 operation numpy performs.
+
+@functools.lru_cache(maxsize=None)
+def _mul_add_fns():
+    import jax
+
+    mul = jax.jit(lambda x, w: w * x)
+    add = jax.jit(lambda a, b: a + b)
+    return mul, add
+
+
+@functools.lru_cache(maxsize=None)
+def _div_cast_fn(dtype_str: str):
+    import jax
+
+    dt = np.dtype(dtype_str)
+    return jax.jit(lambda a, tot: (a / tot).astype(dt))
+
+
+class HostStreamAccumulator:
+    """The historical host-side fold: one f32 numpy array per leaf."""
+
+    kind = "host"
+
+    def __init__(self, templates: Sequence[np.ndarray],
+                 sums: Optional[Sequence[np.ndarray]] = None):
+        if sums is not None:
+            self._sums = [np.asarray(s, np.float32) for s in sums]
+        else:
+            self._sums = [np.zeros(np.shape(t), np.float32) for t in templates]
+
+    def fold_leaf(self, i: int, w: float, arr) -> None:
+        self._sums[i] += np.float32(w) * np.asarray(arr, dtype=np.float32)
+
+    def host_sums(self) -> list:
+        """The per-leaf f32 sums as host arrays (journal snapshot form)."""
+        return [np.asarray(s) for s in self._sums]
+
+    def finalize(self, templates: Sequence[np.ndarray], w_delta: float,
+                 total: float) -> list:
+        out = []
+        for i, t in enumerate(templates):
+            acc = self._sums[i]
+            if w_delta:
+                # delta senders contributed w*(model - global): add their
+                # share of the base model back before normalizing
+                acc = acc + np.float32(w_delta) * np.asarray(t, dtype=np.float32)
+            out.append((acc / np.float32(total)).astype(np.asarray(t).dtype))
+        return out
+
+
+class ShardedStreamAccumulator:
+    """Per-leaf f32 sums as NamedSharding'd jax arrays on a 1-D mesh.
+
+    Each leaf is sharded along its first axis divisible by the mesh size
+    (replicated otherwise — small norms/scales are noise at fold scale);
+    ``fold_leaf`` places the arriving leaf with the accumulator's sharding
+    and runs the add under jit, so the fold executes on the shard-owning
+    devices.  No donation: XLA:CPU buffer donation is unsupported (and has
+    corrupted the heap for scanned programs — see ROADMAP), and the fold
+    arrays are small relative to the model programs.
+    """
+
+    kind = "sharded"
+
+    def __init__(self, templates: Sequence[np.ndarray], mesh=None,
+                 sums: Optional[Sequence[np.ndarray]] = None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from . import mesh as meshlib
+
+        if mesh is None:
+            mesh = meshlib.make_mesh((meshlib.AXIS_DATA,))
+        self.mesh = mesh
+        size = int(np.prod(list(mesh.shape.values())))
+
+        def leaf_sharding(t):
+            shape = np.shape(t)
+            for ax, dim in enumerate(shape):
+                if dim >= size and dim % size == 0:
+                    spec = [None] * len(shape)
+                    spec[ax] = mesh.axis_names[0]
+                    return NamedSharding(mesh, P(*spec))
+            return NamedSharding(mesh, P())
+
+        self._shardings = [leaf_sharding(t) for t in templates]
+        init = (sums if sums is not None
+                else [np.zeros(np.shape(t), np.float32) for t in templates])
+        self._sums = [
+            jax.device_put(jnp.asarray(np.asarray(s), jnp.float32), sh)
+            for s, sh in zip(init, self._shardings)
+        ]
+        # process-wide single-op jits (see the bitwise-discipline note above)
+        self._mul, self._add = _mul_add_fns()
+
+    def fold_leaf(self, i: int, w: float, arr) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        x = jax.device_put(jnp.asarray(np.asarray(arr), jnp.float32),
+                           self._shardings[i])
+        self._sums[i] = self._add(self._sums[i], self._mul(x, jnp.float32(w)))
+
+    def host_sums(self) -> list:
+        import jax
+
+        return [np.asarray(jax.device_get(s)) for s in self._sums]
+
+    def finalize(self, templates: Sequence[np.ndarray], w_delta: float,
+                 total: float) -> list:
+        """Normalize ON DEVICE under jit: the output leaves keep their
+        NamedShardings, so the updated global state stays sharded."""
+        import jax
+        import jax.numpy as jnp
+
+        out = []
+        for i, t in enumerate(templates):
+            div_cast = _div_cast_fn(np.asarray(t).dtype.str)
+            acc = self._sums[i]
+            if w_delta:
+                base = jax.device_put(
+                    jnp.asarray(np.asarray(t), jnp.float32), self._shardings[i])
+                acc = self._add(acc, self._mul(base, jnp.float32(w_delta)))
+            out.append(div_cast(acc, jnp.float32(total)))
+        return out
+
+
+def make_stream_accumulator(templates: Sequence[np.ndarray], *,
+                            sharded: bool = False, mesh=None,
+                            sums: Optional[Sequence[np.ndarray]] = None):
+    """Accumulator factory: ``sharded`` selects the NamedSharding fold
+    (``extra.server_shard_fold``); default is the bit-identical host form."""
+    if sharded:
+        return ShardedStreamAccumulator(templates, mesh=mesh, sums=sums)
+    return HostStreamAccumulator(templates, sums=sums)

@@ -318,14 +318,17 @@ def test_custom_aggregate_refuses_stream_mode(eight_devices):
     assert not agg.fold(1, _upload_msg(1, {}), 1.0, False)
 
 
-def test_lora_aggregator_keeps_exact_mode(eight_devices):
-    """LoRAAggregator (skips __init__) must stay on the exact buffered path:
-    class-level defaults keep stream_mode False and fold() refusing."""
+def test_lora_aggregator_defaults_stay_exact(eight_devices):
+    """LoRAAggregator opts into the associative fold via _init_stream_mode
+    (ISSUE 12), but the CLASS defaults must stay exact-mode-safe: a subclass
+    that skips every __init__ still refuses the fold, and the one fold entry
+    point stays the base class's (tests/test_federated_lora.py covers the
+    instance-level opt-in and the trust gate)."""
     from fedml_tpu.llm.unitedllm import LoRAAggregator
 
     assert LoRAAggregator.stream_mode is False
-    # fold() consults stream_mode first, so an instance that never ran the
-    # base __init__ refuses the associative path outright
+    # fold() consults stream_mode first, so an instance that never ran
+    # _init_stream_mode refuses the associative path outright
     assert "fold" not in LoRAAggregator.__dict__  # inherits the one entry point
 
 

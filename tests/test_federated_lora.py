@@ -1,0 +1,415 @@
+"""Federated LoRA on the fast path (ISSUE 12).
+
+The LoRA exchange must ride every cross-silo fast path: streaming/associative
+folds (bitwise-equal to exact at staleness 0), compressed delta uploads with
+the per-tree low-rank compression floor and EF residual carry, the trust gate
+(secure-agg/FHE/defense configurations force exact buffer-all mode), the
+pjit-sharded server fold (bitwise-equal to the host fold on the 8-device CPU
+mesh), and the buffered-async server end to end with real silo trainers.
+"""
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _lora_cfg(**kw):
+    base = dict(
+        training_type="cross_cloud",
+        dataset="shakespeare",
+        model="transformer",
+        client_num_in_total=2,
+        client_num_per_round=2,
+        comm_round=2,
+        epochs=1,
+        batch_size=4,
+        learning_rate=0.01,
+        synthetic_train_size=128,
+        synthetic_test_size=32,
+        frequency_of_the_test=1,
+        extra={"unitedllm": True, "lora_r": 4},
+    )
+    extra = kw.pop("extra", {})
+    base.update(kw)
+    merged = dict(base["extra"])
+    merged.update(extra)
+    base["extra"] = merged
+    return tiny_config(**base)
+
+
+def _make_lora_agg(extra=None, **kw):
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.llm.unitedllm import LoRAAggregator
+
+    cfg = _lora_cfg(extra=extra or {}, **kw)
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    return cfg, LoRAAggregator(cfg, ds)
+
+
+def _upload_msg(cid, params):
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+
+    msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, cid, 0)
+    msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+    # decode(encode) produces the lazy tensor frame the fold path consumes
+    return Message.decode(msg.encode())
+
+
+def _perturbed(tree, seed):
+    import jax
+
+    r = np.random.RandomState(seed)
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float32) + r.randn(*np.shape(x)).astype(np.float32),
+        jax.device_get(tree))
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(jax.device_get(tree))
+
+
+# ---------------------------------------------------------------------------
+# streaming == exact, bitwise at staleness 0
+# ---------------------------------------------------------------------------
+
+def test_lora_streaming_matches_exact_bitwise(eight_devices):
+    """LoRA adapter folds (streaming accumulator) vs the exact buffer-all
+    aggregate, BITWISE: 2 silos with equal power-of-two sample counts make
+    every weighted-mean step an exact f32 scaling, so any accumulator
+    deviation shows up as a bit flip."""
+    _, exact = _make_lora_agg()
+    _, stream = _make_lora_agg(extra={"streaming_aggregation": True})
+    assert not exact.stream_mode  # flags unset: exact path, unchanged default
+    assert stream.stream_mode     # the LoRA opt-in (ISSUE 12 tentpole)
+
+    base = exact.global_vars
+    for cid in (1, 2):
+        params = _perturbed(base, cid)
+        exact.add_local_trained_result(cid, params, 64.0)
+        assert stream.ingest_streaming(cid, _upload_msg(cid, params), 64.0,
+                                       is_delta=False)
+    assert stream.peak_buffered_updates <= 2
+    a = exact.aggregate(0)
+    b = stream.aggregate(0)
+    for x, y in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_lora_async_tau0_fold_matches_sync_bitwise(eight_devices):
+    """The async fold at staleness 0 (scale = literal 1.0) is bitwise the
+    synchronous streaming fold on the adapter tree."""
+    from fedml_tpu.cross_silo.async_server import staleness_scale
+
+    _, sync = _make_lora_agg(extra={"streaming_aggregation": True})
+    _, asy = _make_lora_agg(extra={"streaming_aggregation": True})
+    base = sync.global_vars
+    for cid in (1, 2, 3):
+        params = _perturbed(base, cid)
+        assert sync.ingest_streaming(cid, _upload_msg(cid, params),
+                                     16.0 + cid, is_delta=False)
+        assert asy.fold(cid, _upload_msg(cid, params), 16.0 + cid,
+                        is_delta=False, scale=staleness_scale(0, 0.5))
+    for x, y in zip(_leaves(sync.aggregate(0)), _leaves(asy.aggregate(0))):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# delta uploads + compression on low-rank factors
+# ---------------------------------------------------------------------------
+
+def test_lora_delta_uploads_match_full(eight_devices):
+    """Adapter DELTA folds (is_delta=True, the compressed-upload shape)
+    reconstruct the same aggregate as full-adapter folds across rounds."""
+    import jax
+
+    _, full = _make_lora_agg(extra={"streaming_aggregation": True})
+    _, delt = _make_lora_agg(extra={"streaming_aggregation": True})
+    for rnd in range(2):
+        base = jax.device_get(full.global_vars)
+        for cid in (1, 2):
+            params = _perturbed(base, 10 * rnd + cid)
+            delta = jax.tree_util.tree_map(
+                lambda n, g: (np.asarray(n, np.float32)
+                              - np.asarray(g, np.float32)), params, base)
+            assert full.ingest_streaming(cid, _upload_msg(cid, params), 64.0,
+                                         is_delta=False)
+            assert delt.ingest_streaming(cid, _upload_msg(cid, delta), 64.0,
+                                         is_delta=True)
+        for x, y in zip(_leaves(full.aggregate(rnd)), _leaves(delt.aggregate(rnd))):
+            np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-5)
+
+
+def test_lora_qsgd8_quantize_then_fold_error_bound(eight_devices):
+    """Quantize-then-fold on low-rank factors: with the per-tree compression
+    floor every adapter leaf rides qsgd8, and the folded aggregate stays
+    within one quantization step (block amax / 127) of the uncompressed
+    fold — the error bound that makes compressed deltas usable."""
+    import jax
+
+    from fedml_tpu.comm import codecs, wire
+
+    # q/k/v targets only: every rank-8 factor is exactly (128, 8)/(8, 128) —
+    # 1024 elements, one qsgd8 block, BELOW the model-scale floor but above
+    # the low-rank floor (the per-tree override is what makes them compress)
+    lr_extra = {"streaming_aggregation": True, "lora_r": 8,
+                "lora_targets": r".*attn/w[qkv]/kernel"}
+    cfg, plain = _make_lora_agg(extra=dict(lr_extra))
+    _, quant = _make_lora_agg(extra=dict(lr_extra))
+    base = jax.device_get(plain.global_vars)
+    leaf_sizes = [np.asarray(l).size for l in jax.tree_util.tree_leaves(base)]
+    assert all(s >= codecs.LOW_RANK_MIN_COMPRESS_ELEMS for s in leaf_sizes)
+    assert all(s < codecs.DEFAULT_MIN_COMPRESS_ELEMS + 1 for s in leaf_sizes)
+
+    max_step = 0.0
+    for cid in (1, 2):
+        delta = jax.tree_util.tree_map(
+            lambda g: np.random.RandomState(cid).randn(*np.shape(g)).astype(np.float32),
+            base)
+        comp, _, stats = codecs.compress_pytree(
+            delta, "qsgd8", key=jax.random.PRNGKey(cid),
+            min_elems=codecs.LOW_RANK_MIN_COMPRESS_ELEMS)
+        n_comp = sum(isinstance(l, wire.CompressedLeaf)
+                     for l in jax.tree_util.tree_leaves(
+                         comp, is_leaf=lambda x: isinstance(x, wire.CompressedLeaf)))
+        assert n_comp == len(leaf_sizes)  # EVERY rank-r factor compressed
+        assert stats["ratio"] >= 3.5, stats
+        max_step = max(max_step, max(
+            np.abs(np.asarray(l)).max() / 127.0
+            for l in jax.tree_util.tree_leaves(delta)))
+        assert plain.ingest_streaming(cid, _upload_msg(cid, delta), 64.0,
+                                      is_delta=True)
+        assert quant.ingest_streaming(cid, _upload_msg(cid, comp), 64.0,
+                                      is_delta=True)
+    for x, y in zip(_leaves(plain.aggregate(0)), _leaves(quant.aggregate(0))):
+        np.testing.assert_allclose(x, y, atol=max_step + 1e-6)
+
+
+def test_lora_topk_ef_residual_carries_across_rounds(eight_devices):
+    """top-k with error feedback on the adapter tree: each round's decoded
+    upload plus its residual equals the residual-corrected delta, leaf-
+    aligned across rounds (the invariant that makes EF converge)."""
+    import jax
+
+    from fedml_tpu.comm import codecs, wire
+
+    cfg, agg = _make_lora_agg(extra={"lora_r": 8})
+    base = jax.device_get(agg.global_vars)
+    residuals = None
+    prev_residuals = None
+    for rnd in range(3):
+        delta = jax.tree_util.tree_map(
+            lambda g: np.random.RandomState(100 + rnd).randn(*np.shape(g)).astype(np.float32),
+            base)
+        comp, residuals, _ = codecs.compress_pytree(
+            delta, "topk", key=jax.random.PRNGKey(rnd), residuals=residuals,
+            ratio=0.05, min_elems=codecs.LOW_RANK_MIN_COMPRESS_ELEMS)
+        decoded = wire.decode_pytree(wire.encode_pytree(comp))
+        d_leaves = jax.tree_util.tree_leaves(delta)
+        out_leaves = jax.tree_util.tree_leaves(decoded)
+        for i, (d, o) in enumerate(zip(d_leaves, out_leaves)):
+            corrected = d.reshape(-1)
+            if prev_residuals is not None and prev_residuals[i] is not None:
+                corrected = corrected + prev_residuals[i]
+            if residuals[i] is None:
+                # below-floor leaf rode raw: exact, no EF state
+                np.testing.assert_array_equal(np.asarray(o).reshape(-1),
+                                              corrected)
+                continue
+            np.testing.assert_allclose(
+                np.asarray(o).reshape(-1) + residuals[i], corrected,
+                rtol=1e-6, atol=1e-6)
+        prev_residuals = residuals
+
+
+def test_lora_client_low_rank_compression_floor(eight_devices):
+    """The client manager picks up the trainer's per-tree
+    comm_compress_min_elems (adapters compress under the model-scale
+    default), and an EXPLICIT comm_compress_min_size flag still wins."""
+    import fedml_tpu
+    import jax
+
+    from fedml_tpu.comm import codecs, wire
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.data import loader
+    from fedml_tpu.llm.unitedllm import build_unitedllm_client
+
+    cfg = _lora_cfg(run_id="lora_minsz", extra={"comm_compression": "qsgd8",
+                                                "lora_r": 8})
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    InProcRouter.reset("lora_minsz")
+    client = build_unitedllm_client(cfg, ds, rank=1, backend="INPROC")
+    try:
+        from fedml_tpu.llm import lora as lora_lib
+
+        assert client._comm_min_elems == codecs.LOW_RANK_MIN_COMPRESS_ELEMS
+        lora0 = _perturbed(lora_lib.init_lora(
+            client.trainer.base_params, 8, jax.random.PRNGKey(0)), 1)
+        new = _perturbed(lora0, 2)
+        payload, is_delta = client._maybe_compress(new, lora0, 0)
+        assert is_delta
+        comp_leaves = [l for l in jax.tree_util.tree_leaves(
+            payload, is_leaf=lambda x: isinstance(x, wire.CompressedLeaf))
+            if isinstance(l, wire.CompressedLeaf)]
+        assert comp_leaves, "no adapter leaf compressed under the per-tree floor"
+    finally:
+        client.finish()
+
+    # explicit flag beats the trainer override
+    cfg2 = _lora_cfg(run_id="lora_minsz2",
+                     extra={"comm_compression": "qsgd8", "lora_r": 8,
+                            "comm_compress_min_size": 10 ** 9})
+    fedml_tpu.init(cfg2)
+    InProcRouter.reset("lora_minsz2")
+    client2 = build_unitedllm_client(cfg2, ds, rank=1, backend="INPROC")
+    try:
+        assert client2._comm_min_elems == 10 ** 9
+    finally:
+        client2.finish()
+
+
+# ---------------------------------------------------------------------------
+# trust gate: secure-agg/FHE/defense configurations force exact mode
+# ---------------------------------------------------------------------------
+
+def test_lora_trust_pipeline_forces_exact_mode(eight_devices):
+    """The PR-4 gate regression (ISSUE 12 satellite): a configured trust
+    pipeline must pin LoRA aggregation to the exact buffer-all path even
+    when compression/streaming flags ask for the associative fold."""
+    cfg, agg = _make_lora_agg(
+        extra={"comm_compression": "qsgd8", "streaming_aggregation": True},
+        enable_defense=True, defense_type="norm_diff_clipping",
+        norm_bound=5.0)
+    assert agg.trust is not None and agg.trust.active
+    assert not agg.stream_mode
+    assert not agg.fold(1, _upload_msg(1, _perturbed(agg.global_vars, 1)),
+                        64.0, False)
+
+
+def test_secure_aggregators_never_stream(eight_devices):
+    """Secure-agg/FHE aggregators carry masked/ciphertext uploads that are
+    not foldable f32 trees: stream_mode must stay off whatever the comm
+    flags say (the explicit hardening the LoRA opt-in must not bypass)."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo.lightsecagg import LSAAggregator
+    from fedml_tpu.cross_silo.secagg_shamir import SAAggregator
+    from fedml_tpu.data import loader
+    from fedml_tpu.data.dataset import pad_eval_set
+    from fedml_tpu.models import model_hub
+
+    cfg = tiny_config(client_num_in_total=4, client_num_per_round=4,
+                      extra={"comm_compression": "qsgd8",
+                             "streaming_aggregation": True})
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    test_arrays = pad_eval_set(ds.test_x, ds.test_y, 32)
+    for cls in (SAAggregator, LSAAggregator):
+        agg = cls(cfg, model, ds.train_x[: cfg.batch_size], test_arrays)
+        assert not agg.stream_mode, cls.__name__
+        assert not agg._shard_fold, cls.__name__
+
+
+# ---------------------------------------------------------------------------
+# sharded fold == host fold, bitwise
+# ---------------------------------------------------------------------------
+
+def test_sharded_fold_matches_host_fold_bitwise(eight_devices):
+    """extra.server_shard_fold: the NamedSharding'd device fold (including a
+    delta contribution and the on-device finalize) is BITWISE the host numpy
+    fold on the 8-device CPU mesh."""
+    import jax
+
+    _, host = _make_lora_agg(extra={"streaming_aggregation": True,
+                                    "lora_r": 8})
+    _, shard = _make_lora_agg(extra={"streaming_aggregation": True,
+                                     "lora_r": 8, "server_shard_fold": True})
+    assert not host._shard_fold and shard._shard_fold
+    base = jax.device_get(host.global_vars)
+    for cid, w in ((1, 16.0), (2, 32.0), (3, 37.0)):
+        params = _perturbed(base, cid)
+        is_delta = cid == 3  # exercise the finalize add-back on both paths
+        payload = params if not is_delta else jax.tree_util.tree_map(
+            lambda n, g: np.asarray(n, np.float32) - np.asarray(g, np.float32),
+            params, base)
+        assert host.ingest_streaming(cid, _upload_msg(cid, payload), w,
+                                     is_delta=is_delta)
+        assert shard.ingest_streaming(cid, _upload_msg(cid, payload), w,
+                                      is_delta=is_delta)
+    assert shard._stream_acc.kind == "sharded"
+    # the accumulator leaves really live under NamedShardings on the mesh
+    sharded_any = any(
+        not s.sharding.is_fully_replicated for s in shard._stream_acc._sums)
+    assert sharded_any, "no accumulator leaf actually sharded"
+    a = host.aggregate(0)
+    b = shard.aggregate(0)
+    for x, y in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_array_equal(x, y)
+    # the finalized global inherits the shardings (stays device-resident)
+    assert any(
+        hasattr(l, "sharding") and not l.sharding.is_fully_replicated
+        for l in jax.tree_util.tree_leaves(b))
+
+
+def test_sharded_fold_journal_roundtrip(eight_devices):
+    """export/restore_stream_state round-trips the sharded accumulator's
+    partial sums through the journal's host-array form."""
+    import jax
+
+    _, shard = _make_lora_agg(extra={"streaming_aggregation": True,
+                                     "server_shard_fold": True})
+    base = jax.device_get(shard.global_vars)
+    assert shard.ingest_streaming(1, _upload_msg(1, _perturbed(base, 1)),
+                                  64.0, is_delta=False)
+    proto, arrays = shard.export_stream_state()
+    assert proto["stream_folded"] == 1 and arrays
+
+    _, restored = _make_lora_agg(extra={"streaming_aggregation": True,
+                                        "server_shard_fold": True})
+    restored.restore_stream_state(proto, arrays)
+    assert restored._stream_acc is not None
+    assert restored._stream_acc.kind == "sharded"
+    assert restored.ingest_streaming(2, _upload_msg(2, _perturbed(base, 2)),
+                                     64.0, is_delta=False)
+    assert shard.ingest_streaming(2, _upload_msg(2, _perturbed(base, 2)),
+                                  64.0, is_delta=False)
+    for x, y in zip(_leaves(shard.aggregate(0)), _leaves(restored.aggregate(0))):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# e2e: async LoRA with real silo trainers
+# ---------------------------------------------------------------------------
+
+def test_async_lora_e2e_inproc(eight_devices):
+    """Buffered-async LoRA end to end: real silo trainers over the in-proc
+    fabric, compressed delta uploads folding with staleness decay, virtual
+    rounds closing at K arrivals, peak buffered <= 2."""
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.llm.unitedllm import run_unitedllm_process_group
+
+    cfg = _lora_cfg(
+        run_id="lora_async", comm_round=2, batch_size=2,
+        synthetic_train_size=64, synthetic_test_size=16,
+        extra={"comm_compression": "qsgd8", "lora_r": 8,
+               "async_aggregation": True, "async_buffer_k": 2,
+               "async_staleness_exponent": 0.5,
+               "async_redispatch_timeout_s": 10.0})
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    history, server = run_unitedllm_process_group(cfg, ds, backend="INPROC",
+                                                  timeout=240.0)
+    assert len(history) == 2
+    assert server.aggregator.stream_mode
+    assert server.aggregator.peak_buffered_updates <= 2
+    assert np.isfinite(history[-1]["test_loss"])
+    summary = server.async_summary()
+    assert summary["server_version"] == 2
+    assert summary["arrivals"] >= 4

@@ -83,11 +83,12 @@ def test_fix_preserves_runtime_semantics():
 def test_fix_skips_manual_sites_and_suppressions(tmp_path):
     (tmp_path / "mod.py").write_text(textwrap.dedent('''
         def f(cfg, name):
-            a = cfg.extra["seg_base"]
             cfg.extra.setdefault("gan_z_dim", 3)  # statement: seeds the dict
+            cfg.extra["seg_base"]  # statement-position subscript: no value use
             c = "silo_dp" in cfg.extra
             d = cfg.extra.get(name)
-            return a, c, d
+            e = cfg.extra[name]
+            return c, d, e
 
 
         def g(cfg):  # graftlint: disable=GL001(deliberate raw read)
@@ -98,9 +99,59 @@ def test_fix_skips_manual_sites_and_suppressions(tmp_path):
     assert res.rewrites == 0
     assert (tmp_path / "mod.py").read_text() == before  # untouched
     notes = "\n".join(res.skipped)
-    assert "seg_base" in notes and "setdefault" in notes
-    assert "membership test" in notes and "non-literal" in notes
+    assert "setdefault" in notes and "statement-position extra[...]" in notes
+    assert "membership test" in notes
+    assert notes.count("literal flag name") == 2  # .get(name) + extra[name]
     assert "fused_blocks" not in notes  # suppressed site: no nag either
+
+
+def test_fix_rewrites_value_position_subscript(tmp_path):
+    """ISSUE 12 satellite: value-position ``extra["k"]`` reads become
+    ``cfg_extra(cfg, 'k', None)``; statement-position reads and write
+    targets stay untouched."""
+    src = textwrap.dedent('''
+        def f(cfg):
+            a = cfg.extra["mlp_hidden"]
+            extra = cfg.extra
+            b = extra["silo_dp"]
+            if cfg.extra["fused_blocks"]:
+                a += 1
+            cfg.extra["comm_topk_ratio"]  # statement position: report-only
+            cfg.extra["mlp_hidden"] = 3   # write target: untouched
+            return a, b
+    ''')
+    fixed, n, skipped = fix_source(src, "mod.py")
+    assert n == 3, fixed
+    assert "cfg_extra(cfg, 'mlp_hidden', None)" in fixed
+    assert "cfg_extra(cfg, 'silo_dp', None)" in fixed
+    assert "cfg_extra(cfg, 'fused_blocks', None)" in fixed
+    assert 'cfg.extra["comm_topk_ratio"]' in fixed  # statement form survives
+    assert 'cfg.extra["mlp_hidden"] = 3' in fixed   # store ctx survives
+    assert any("statement-position extra[...]" in s for s in skipped)
+    compile(fixed, "mod.py", "exec")
+    again, n2, _ = fix_source(fixed, "mod.py")
+    assert n2 == 0 and again == fixed  # idempotent
+
+
+def test_fix_subscript_semantics():
+    """Set keys: identical values.  Missing key: the documented trade —
+    the subscript's KeyError becomes cfg_extra's None default."""
+    import pytest
+
+    from fedml_tpu.arguments import Config
+
+    src = "def f(cfg):\n    return cfg.extra['mlp_hidden']\n"
+    fixed, n, _ = fix_source(src, "mod.py")
+    assert n == 1
+    orig_ns, fixed_ns = {}, {}
+    exec(compile(src, "o.py", "exec"), orig_ns)
+    exec(compile(fixed, "f.py", "exec"), fixed_ns)
+    cfg = Config(dataset="synthetic", model="lr", extra={"mlp_hidden": 256})
+    assert orig_ns["f"](cfg) == fixed_ns["f"](cfg) == 256
+    empty = Config(dataset="synthetic", model="lr", extra={})
+    with pytest.raises(KeyError):
+        orig_ns["f"](empty)
+    assert fixed_ns["f"](empty) is None
 
 
 def test_fix_rewrites_value_position_setdefault(tmp_path):
