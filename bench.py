@@ -429,11 +429,19 @@ def bench_chaos():
     costs.  Both runs also re-assert the correctness invariants (completion,
     monotone version, zero unaccounted losses, peak buffered <= 2) as floor
     violations — a recovery that loses work silently is a regression, not a
-    statistic."""
+    statistic.
+
+    ISSUE-13 adds the CLIENT-side mirror: ``client_kill_recover`` runs REAL
+    in-proc clients with two of them hard-killed mid-run and journal-resumed,
+    guarded by ``client_kill_ratio`` (recovered/clean versions/s, floor
+    CLIENT_KILL_RECOVERY_RATIO_FLOOR) plus the client accounting identity
+    (kills == journal resumes, zero unaccounted restarts)."""
     import shutil
     import tempfile
 
-    from fedml_tpu.cross_silo.async_soak import run_kill_recover_soak, run_soak
+    from fedml_tpu.cross_silo.async_soak import (
+        run_client_kill_soak, run_kill_recover_soak, run_soak,
+    )
 
     clients = int(os.environ.get("BENCH_CHAOS_CLIENTS", "2000"))
     concurrency = int(os.environ.get("BENCH_CHAOS_CONCURRENCY", "256"))
@@ -451,10 +459,25 @@ def bench_chaos():
     recovered = run_kill_recover_soak(**common)
     ratio = (recovered["versions_per_sec"] / clean["versions_per_sec"]
              if clean["versions_per_sec"] else None)
+    # ISSUE-13 leg: REAL in-proc clients, two of them hard-killed mid-run
+    # and journal-resumed — same shape run clean (zero kills) for the ratio
+    # denominator, so the guarded number isolates what client churn costs
+    ck_kwargs = dict(
+        n_clients=int(os.environ.get("BENCH_CLIENTKILL_CLIENTS", "6")),
+        versions=int(os.environ.get("BENCH_CLIENTKILL_VERSIONS", "6")),
+        buffer_k=3, concurrency=3, redispatch_timeout_s=1.0, seed=0,
+        timeout_s=300.0)
+    ck_clean = run_client_kill_soak(kill_marks=(), **ck_kwargs)
+    ck_recovered = run_client_kill_soak(kill_marks=((2, 1), (4, 2)), **ck_kwargs)
+    ck_ratio = (ck_recovered["versions_per_sec"] / ck_clean["versions_per_sec"]
+                if ck_clean["versions_per_sec"] else None)
     return {
         "clean": clean,
         "recovered": recovered,
         "recovery_ratio": round(ratio, 4) if ratio is not None else None,
+        "client_kill_clean": ck_clean,
+        "client_kill_recover": ck_recovered,
+        "client_kill_ratio": round(ck_ratio, 4) if ck_ratio is not None else None,
     }
 
 
@@ -925,6 +948,14 @@ ASYNC_VERSIONS_PER_SEC_FLOOR = 2.0
 #: dispatches) must retain at least half the clean throughput, or server
 #: restarts are not production-viable.
 CHAOS_RECOVERY_RATIO_FLOOR = 0.5
+#: Client-kill soak throughput as a fraction of the clean run's versions/s
+#: (ISSUE 13) — platform independent.  Mid-run client SIGKILLs + journal
+#: resumes (redispatch of the dead slots, replacement construction, EF
+#: restore) must retain at least half the clean throughput, or client churn
+#: is not survivable at production rates (CPU measures ~0.97: the wall is
+#: dominated by real client training, and kills cost one redispatch
+#: timeout each).
+CLIENT_KILL_RECOVERY_RATIO_FLOOR = 0.5
 #: Serving QPS the continuous-batching worker must sustain WHILE an async
 #: training run publishes versions (ISSUE 11) — platform independent
 #: (host-side serving path; CPU measures hundreds of QPS at the default
@@ -1130,10 +1161,14 @@ def main():
         violations.append(
             f"async soak lost {async_soak['unaccounted_drops']} drops unaccounted")
     chaos_ratio = chaos.get("recovery_ratio")
-    if chaos_ratio is not None and chaos_ratio < CHAOS_RECOVERY_RATIO_FLOOR:
+    ck_ratio = chaos.get("client_kill_ratio")
+    if ((chaos_ratio is not None and chaos_ratio < CHAOS_RECOVERY_RATIO_FLOOR)
+            or (ck_ratio is not None
+                and ck_ratio < CLIENT_KILL_RECOVERY_RATIO_FLOOR)):
         # same one-retry policy as the other wall-clock floors
         chaos = _subprocess_bench("chaos")
         chaos_ratio = chaos.get("recovery_ratio")
+        ck_ratio = chaos.get("client_kill_ratio")
     if chaos_ratio is not None and chaos_ratio < CHAOS_RECOVERY_RATIO_FLOOR:
         violations.append(
             f"chaos recovery ratio {chaos_ratio} < floor "
@@ -1147,6 +1182,21 @@ def main():
     if rec.get("peak_buffered_updates", 0) > 2:
         violations.append(
             f"chaos recovered run peak buffered {rec['peak_buffered_updates']} > 2")
+    # ISSUE-13 client-kill leg: throughput floor + the client-side identity
+    if ck_ratio is not None and ck_ratio < CLIENT_KILL_RECOVERY_RATIO_FLOOR:
+        violations.append(
+            f"client-kill recovery ratio {ck_ratio} < floor "
+            f"{CLIENT_KILL_RECOVERY_RATIO_FLOOR} (client churn cost too much "
+            "throughput)")
+    ck_rec = chaos.get("client_kill_recover", {})
+    if ck_rec and ck_rec.get("unaccounted", 0) != 0:
+        violations.append(
+            f"client-kill run left {ck_rec['unaccounted']} restarts unaccounted")
+    if ck_rec and ck_rec.get("kills", 0) != ck_rec.get("resumed_from_journal", 0):
+        violations.append(
+            f"client-kill run: {ck_rec.get('kills')} kills but only "
+            f"{ck_rec.get('resumed_from_journal')} journal resumes (clients "
+            "rejoining cold lose their EF residual carry)")
     serving_qps = serving.get("qps")
     if serving_qps is not None and serving_qps < SERVING_QPS_FLOOR:
         # same one-retry policy as the other wall-clock floors

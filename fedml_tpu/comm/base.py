@@ -76,22 +76,39 @@ DECODE_RETRY_CAP_S = 2.0       # ceiling of the exponential schedule
 CHUNK_STREAM_TIMEOUT_S = 120.0
 
 
+#: purpose constants namespacing the :func:`backoff_delay` jitter streams.
+#: Two retry schedules that happen to share a numeric ``seed`` (a decode
+#: retry's default 0 and a client whose derived seed lands on 0, say) would
+#: otherwise draw IDENTICAL jitter at every attempt and re-fire in lockstep —
+#: exactly the correlated-retry stampede the jitter exists to prevent.  Each
+#: call site folds its purpose constant into the rng seed so colocated
+#: schedules decorrelate while every single schedule stays reproducible.
+BACKOFF_PURPOSE_DECODE_RETRY = 0x44454352    # "DECR": receive-loop decode retry
+BACKOFF_PURPOSE_RECONNECT = 0x52434E54       # "RCNT": client upload reconnect
+BACKOFF_PURPOSE_STATUS_PROBE = 0x53545052    # "STPR": server status re-probe
+
+
 def backoff_delay(attempt: int, *, base: float = DECODE_RETRY_BACKOFF_S,
-                  cap: float = DECODE_RETRY_CAP_S, seed: int = 0) -> float:
+                  cap: float = DECODE_RETRY_CAP_S, seed: int = 0,
+                  purpose: int = 0) -> float:
     """Capped exponential backoff with DETERMINISTIC jitter.
 
     ``base * 2**attempt`` clipped at ``cap``, scaled by a jitter factor in
-    ``[0.5, 1.0)`` drawn from ``default_rng([seed, attempt])`` — so N peers
-    retrying the same flaky dependency de-synchronize (different seeds)
-    while any single schedule is exactly reproducible (same seed, same
-    attempt → same delay, the property the chaos soak's determinism
-    assertions rely on).  Replaces the old linear ``base * (attempt+1)``
-    schedule, whose waits grew too slowly to ride out a multi-second
-    object-store brownout within DECODE_RETRY_LIMIT attempts."""
+    ``[0.5, 1.0)`` drawn from ``default_rng([purpose, seed, attempt])`` — so
+    N peers retrying the same flaky dependency de-synchronize (different
+    seeds), colocated retry loops with coinciding seeds de-synchronize too
+    (different ``purpose`` constants — see the ``BACKOFF_PURPOSE_*`` block
+    above), while any single schedule is exactly reproducible (same purpose,
+    seed, and attempt → same delay, the property the chaos soak's
+    determinism assertions rely on).  Replaces the old linear
+    ``base * (attempt+1)`` schedule, whose waits grew too slowly to ride out
+    a multi-second object-store brownout within DECODE_RETRY_LIMIT
+    attempts."""
     import numpy as np
 
     raw = min(float(cap), float(base) * (2.0 ** int(attempt)))
-    frac = float(np.random.default_rng([int(seed), int(attempt)]).random())
+    frac = float(np.random.default_rng(
+        [int(purpose), int(seed), int(attempt)]).random())
     return raw * (0.5 + 0.5 * frac)
 
 #: process-wide comm event sinks ``fn(event, **info)`` for the drop/retry
@@ -236,7 +253,8 @@ class ObserverLoopMixin:
                         attempts + 1, exc_info=True,
                     )
                     retry_pending.append((
-                        time.monotonic() + backoff_delay(attempts),
+                        time.monotonic() + backoff_delay(
+                            attempts, purpose=BACKOFF_PURPOSE_DECODE_RETRY),
                         data, attempts + 1,
                     ))
                 else:

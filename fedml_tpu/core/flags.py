@@ -220,10 +220,29 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "restart with a bumped session epoch (unset = no journal, "
              "wire + aggregation bit-identical to before the flag existed)."),
     FlagSpec("server_journal_keep", "int", 3,
-             "Journal snapshots retained on disk (older steps are pruned)."),
+             "Journal snapshots retained on disk (older steps are pruned; "
+             "the newest intact step is never pruned)."),
     FlagSpec("server_journal_every_rounds", "int", 1,
              "Snapshot cadence in (virtual) rounds; the final round is "
              "always journaled."),
+    FlagSpec("server_journal_every_folds", "int", 0,
+             "MID-ROUND snapshot cadence on the synchronous server: with the "
+             "streaming fold engaged, journal the partial accumulator every "
+             "N folds so a crash between folds resumes the round's partial "
+             "sum instead of redoing it (0 = round-boundary snapshots only; "
+             "requires server_journal_dir)."),
+    FlagSpec("client_journal_dir", "str", None,
+             "Durable CLIENT recovery journal root: each cross-silo client "
+             "atomically snapshots its protocol state (error-feedback "
+             "residuals, last-received version + session epoch, upload "
+             "idempotence attempts, optional trainer local state) before "
+             "every upload and resumes mid-conversation from it on restart; "
+             "uploads carry an idempotence key the servers dedup on (unset "
+             "= no journal, no key header, wire byte-identical to before "
+             "the flag existed)."),
+    FlagSpec("client_journal_keep", "int", 2,
+             "Client-journal snapshots retained per client (older steps are "
+             "pruned)."),
     FlagSpec("straggler_timeout_s", "float", 0.0,
              "Bounded-wait straggler deadline per round; 0 = wait forever."),
     FlagSpec("straggler_quorum_frac", "float", 0.5,

@@ -54,8 +54,8 @@ from ..obs import registry as obsreg, trace as obstrace
 from ..obs.metrics import MetricsLogger
 from . import message_define as md
 from .server import (
-    AGGREGATE_TIME, BUFFERED_PEAK, CLIENT_ROUND_TRIP, FedMLAggregator,
-    FedMLServerManager, REJECTED_STALE,
+    AGGREGATE_TIME, BUFFERED_PEAK, CLIENT_ROUND_TRIP, DEDUPED_UPLOADS,
+    FedMLAggregator, FedMLServerManager, REJECTED_STALE,
 )
 
 log = logging.getLogger("fedml_tpu.cross_silo.async_server")
@@ -206,6 +206,16 @@ class AsyncFedMLServerManager(FedMLServerManager):
             if self._finished:
                 return  # post-finish stragglers: the run is already closed
             sender = int(msg.get_sender_id())
+            # exactly-once (ISSUE 13): an idempotence key the server already
+            # folded is a redelivery of the same bytes — dropped and counted
+            # FIRST, before the epoch fence, because the journaled key table
+            # outlives a crash (a pre-crash fold's duplicate still dedups
+            # after recovery instead of re-entering the in-flight check)
+            upload_key = msg.get_control(md.MSG_ARG_KEY_UPLOAD_KEY)
+            if upload_key is not None and self._is_duplicate_upload(sender, upload_key):
+                self.deduped_uploads += 1
+                DEDUPED_UPLOADS.inc()
+                return
             # control-only reads: a plain get() of a missing key would
             # materialize the tensor section and defeat the streaming fold
             client_version = int(msg.get_control(md.MSG_ARG_KEY_ROUND_INDEX,
@@ -251,6 +261,7 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 self.aggregator.add_local_trained_result(
                     sender, params, n_samples * scale, is_delta=is_delta)
                 ARRIVALS.inc(path="buffered")
+            self._note_upload_key(sender, upload_key)
             self.total_arrivals += 1
             self._arrivals_in_round += 1
             self._round_staleness.append(int(staleness))
@@ -440,6 +451,7 @@ class AsyncFedMLServerManager(FedMLServerManager):
         if snap["model"] is not None:
             self.aggregator.restore_model_state(snap["model"])
         self.aggregator.restore_stream_state(p, snap["arrays"])
+        self._restore_folded_keys(p)
         self.health.import_state(p.get("health") or {})
         log.info("recovered from journal step %d (version %d, session epoch "
                  "%d, %d in-flight re-armed)", self.recovered_step,
@@ -458,6 +470,8 @@ class AsyncFedMLServerManager(FedMLServerManager):
             "total_arrivals": int(self.total_arrivals),
             "timeout_redispatches": int(self.timeout_redispatches),
             "rejected_stale": int(self.rejected_stale),
+            "deduped": int(self.deduped_uploads),
+            "folded_keys": self._export_folded_keys(),
             "staleness_sum": int(self.staleness_sum),
             "staleness_max": int(self.staleness_max),
             "health": self.health.export_state(),
@@ -503,6 +517,7 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 "staleness_max": self.staleness_max,
                 "timeout_redispatches": self.timeout_redispatches,
                 "rejected_stale": self.rejected_stale,
+                "deduped": self.deduped_uploads,
                 "recovered_step": self.recovered_step,
                 "session_epoch": self.session_epoch,
                 "outstanding_at_end": len(self._outstanding),

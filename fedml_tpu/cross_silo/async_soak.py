@@ -18,8 +18,10 @@ section (floor-guarded versions/s), and the ``__graft_entry__``
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import heapq
+import os
 import queue
 import threading
 import time
@@ -54,6 +56,64 @@ def _percentile_from_hist(hist, q: float, base_counts: Optional[list] = None) ->
 def _hist_counts(hist) -> list:
     snap = hist._snapshot()
     return list(snap["samples"][0]["counts"]) if snap["samples"] else []
+
+
+class _FleetSender:
+    """``BaseCommunicationManager``-shaped adapter over the in-proc router,
+    so the simulated fleet's UPLOAD leg can ride :class:`~fedml_tpu.comm.
+    chaos.ChaosCommManager` like a real client's sends do (ISSUE 13
+    satellite: the soak fleet used to bypass the chaos wrapper entirely, so
+    drop/duplicate/corrupt never hit uploads).  ``route()`` is called with
+    the single positional message argument, exactly the pre-chunk signature,
+    so router taps (tests, tooling) that wrap the unchunked fabric keep
+    working; ``send_raw`` is the chaos wrapper's corrupt-frame injection
+    point."""
+
+    def __init__(self, router):
+        self.router = router
+
+    def send_message(self, msg) -> None:
+        self.router.route(msg)
+
+    def send_raw(self, receiver_id: int, payload: bytes) -> None:
+        self.router.queues[receiver_id].put(payload)
+
+    def add_observer(self, observer) -> None:
+        pass
+
+    def remove_observer(self, observer) -> None:
+        pass
+
+    def handle_receive_message(self) -> None:
+        pass
+
+    def stop_receive_message(self) -> None:
+        pass
+
+
+def _upload_chaos_sender(router, chaos_flags: Optional[dict], seed: int):
+    """(sender, chaos_wrapper_or_None) for the fleet's upload leg: flags set
+    → the seeded :class:`ChaosCommManager` over the router adapter (its own
+    wrapper rank so the schedule is independent of the server's dispatch-leg
+    wrapper); unset → the bare adapter, bytes untouched."""
+    sender = _FleetSender(router)
+    if not chaos_flags:
+        return sender, None
+    from ..comm.chaos import ChaosCommManager, ChaosConfig
+
+    cfg = ChaosConfig(
+        seed=int(chaos_flags.get("chaos_seed", seed)) + 1,
+        drop=float(chaos_flags.get("chaos_drop_prob", 0.0)),
+        delay=float(chaos_flags.get("chaos_delay_prob", 0.0)),
+        delay_max_s=float(chaos_flags.get("chaos_delay_max_s", 0.05)),
+        duplicate=float(chaos_flags.get("chaos_duplicate_prob", 0.0)),
+        reorder=float(chaos_flags.get("chaos_reorder_prob", 0.0)),
+        corrupt=float(chaos_flags.get("chaos_corrupt_prob", 0.0)),
+    )
+    if not cfg.active():
+        return sender, None
+    wrapper = ChaosCommManager(sender, cfg, rank=1)
+    return wrapper, wrapper
 
 
 class _TaggedQueue:
@@ -97,8 +157,17 @@ class _SimulatedFleet:
 
     def __init__(self, router, md, template_params, *, drop_prob: float,
                  latency_mean_s: float, latency_sigma: float, seed: int,
-                 workers: int = 4):
+                 workers: int = 4, sender=None, upload_keys: bool = False):
         self.router = router
+        # upload-leg send path (ISSUE 13 satellite): model replies go through
+        # ``sender`` — the chaos wrapper when the soak enables upload chaos —
+        # while status replies stay on the bare router (a dropped status
+        # reply only delays discovery; it must not enter the loss identity)
+        self.sender = sender if sender is not None else _FleetSender(router)
+        #: stamp idempotence keys on uploads (the kill-recover legs): the
+        #: nonce is the per-dispatch ordinal, so a chaos-DUPLICATED frame
+        #: reuses its key and the server's dedup reconciles it
+        self.upload_keys = bool(upload_keys)
         self.md = md
         self.template = template_params
         self.drop_prob = float(drop_prob)
@@ -210,8 +279,12 @@ class _SimulatedFleet:
         reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, version)
         if epoch is not None:
             reply.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, int(epoch))
+        if self.upload_keys:
+            reply.add_params(
+                md.MSG_ARG_KEY_UPLOAD_KEY,
+                f"{rid}:{version}:{-1 if epoch is None else int(epoch)}:{nonce}")
         try:
-            self.router.route(reply)
+            self.sender.send_message(reply)
         except Exception:
             return
         with self._lock:
@@ -374,6 +447,7 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
                           redispatch_timeout_s: float = 1.0, seed: int = 0,
                           workers: int = 4, journal_dir: Optional[str] = None,
                           chaos: Optional[dict] = None,
+                          client_chaos: Optional[dict] = None,
                           timeout_s: float = 300.0) -> dict:
     """Kill-and-recover soak (ISSUE 10): run the buffered-async server under
     seeded chaos with the recovery journal on, HARD-KILL it mid-run (abrupt
@@ -384,11 +458,16 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
     The returned accounting proves the recovery invariants the dryrun/bench
     assert: the restarted server resumes at the journaled version
     (``recovered_version``, monotone continuity), completes all ``versions``,
-    and every silent loss (fleet upload drops + chaos drop/corrupt on the
-    dispatch leg) is accounted as a watchdog redispatch, a deterministic
-    stale-epoch rejection, a tracked in-flight slot, or a slot that was
-    in flight at the kill but past the last snapshot (``unaccounted`` == 0 —
-    nothing vanishes without a trail)."""
+    and every silent loss (fleet upload drops + chaos drop/corrupt on BOTH
+    legs — the dispatch leg through the server's wrapper AND the upload leg
+    through the fleet's, ISSUE 13 satellite) is accounted as a watchdog
+    redispatch, a deterministic stale-epoch rejection, a tracked in-flight
+    slot, or a slot that was in flight at the kill but past the last
+    snapshot (``unaccounted`` == 0 — nothing vanishes without a trail).
+    Chaos-DUPLICATED uploads carry their original's idempotence key and must
+    come back as server-side dedups, never as double folds
+    (``client_chaos`` defaults to the same fault mix as the dispatch leg;
+    pass ``{}`` to disable upload-leg chaos)."""
     import shutil
     import tempfile
 
@@ -427,10 +506,15 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
         router.queues = _FanInQueues(shared, router.queues[0])
 
         template = jax.device_get(server_a.aggregator.global_vars)
+        # upload-leg chaos (ISSUE 13 satellite): the fleet's model replies go
+        # through their own seeded ChaosCommManager, so drop/duplicate/
+        # corrupt hit uploads exactly like they hit dispatches
+        upload_flags = dict(chaos_flags if client_chaos is None else client_chaos)
+        sender, upload_chaos = _upload_chaos_sender(router, upload_flags, seed)
         fleet = _SimulatedFleet(
             router, md, template, drop_prob=drop_prob,
             latency_mean_s=latency_mean_s, latency_sigma=latency_sigma,
-            seed=seed, workers=workers)
+            seed=seed, workers=workers, sender=sender, upload_keys=True)
         fleet.start(shared)
 
         t0 = time.monotonic()
@@ -482,8 +566,14 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
 
         # -- the accounting identity ------------------------------------------
         # silent losses: fleet-injected upload drops + chaos drop/corrupt on
-        # the dispatch leg (both lifetimes)
-        losses = fleet.drops_injected + a_chaos + b_chaos
+        # the dispatch leg (both lifetimes) + chaos drop/corrupt on the
+        # UPLOAD leg (the fleet's wrapper, one lifetime spanning the kill) —
+        # a lost upload and a lost dispatch look identical to the server (an
+        # unanswered slot), so one identity covers both legs
+        upload_losses = upload_chaos.silent_losses() if upload_chaos else 0
+        upload_dups = (upload_chaos.injected.get("duplicate", 0)
+                       if upload_chaos else 0)
+        losses = fleet.drops_injected + a_chaos + b_chaos + upload_losses
         # accounted: redispatches observed in BOTH lifetimes (A's kill-time
         # truth + B's post-recovery delta over the journaled carry-over),
         # stale-epoch rejections, still-tracked slots, and slots that were in
@@ -515,6 +605,9 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
             "versions_per_sec": round(b_summary["server_version"] / max(wall, 1e-9), 4),
             "fleet_drops_injected": fleet.drops_injected,
             "chaos_silent_losses": a_chaos + b_chaos,
+            "upload_chaos_losses": upload_losses,
+            "upload_duplicates_injected": upload_dups,
+            "deduped": b_summary["deduped"],
             "timeout_redispatches": total_redisp,
             "rejected_stale": b_summary["rejected_stale"],
             "outstanding_at_end": b_summary["outstanding_at_end"],
@@ -528,3 +621,473 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
     finally:
         if owns_journal:
             shutil.rmtree(journal_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# client-side survivability harnesses (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def run_client_kill_soak(n_clients: int = 6, versions: int = 6,
+                         buffer_k: int = 3, concurrency: int = 3,
+                         kill_marks: tuple = ((2, 1), (4, 2)),
+                         codec: Optional[str] = "topk",
+                         redispatch_timeout_s: float = 1.0, seed: int = 0,
+                         timeout_s: float = 240.0) -> dict:
+    """REAL in-proc clients under the buffered-async server, with seeded
+    client kills mid-run (ISSUE 13): each ``(rank, at_version)`` in
+    ``kill_marks`` hard-kills that client manager the first time the server
+    version reaches the mark, then rebuilds it against the same client
+    journal — the replacement resumes mid-conversation (EF residuals,
+    epoch, attempt counters) and the run is driven to completion.
+
+    The client-side accounting identity: every kill comes back as exactly
+    one restart, and every restart is either a journal resume or a cold
+    rejoin (``unaccounted`` = kills − resumed − cold == 0); any duplicate
+    upload a crashed client re-sent is visible as a server-side dedup, never
+    a double fold.  ``kill_marks=()`` is the clean leg the bench ratio
+    divides by (same real-client shape, zero kills)."""
+    import shutil
+    import tempfile
+
+    import fedml_tpu
+
+    from ..comm.inproc import InProcRouter
+    from ..data import loader
+    from ..models import model_hub
+    from . import build_client, build_server
+
+    workdir = tempfile.mkdtemp(prefix="soak_clientkill_")
+    run_id = f"soak_clientkill_{seed}_{n_clients}_{versions}_{len(kill_marks)}"
+    try:
+        cfg = _soak_config(
+            run_id, n_clients, concurrency, buffer_k, versions,
+            staleness_exponent=0.5,
+            redispatch_timeout_s=redispatch_timeout_s,
+            extra_flags={
+                "server_journal_dir": f"{workdir}/server_journal",
+                "client_journal_dir": f"{workdir}/client_journal",
+                # lr-model leaves are small; lower the floor so the topk/qsgd8
+                # EF contract is actually exercised across the kills
+                **({"comm_compression": codec,
+                    "comm_compress_min_size": 64} if codec else {}),
+            })
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        model = model_hub.create(cfg, ds.class_num)
+
+        InProcRouter.reset(run_id)
+        clients = {r: build_client(cfg, ds, model, rank=r, backend="INPROC")
+                   for r in range(1, n_clients + 1)}
+        for c in clients.values():
+            c.run_in_thread()
+        server = build_server(cfg, ds, model, backend="INPROC")
+        t0 = time.monotonic()
+        server.run_in_thread()
+        server.start()
+
+        pending = sorted(kill_marks, key=lambda m: m[1])
+        kills = resumed = cold = 0
+        deadline = time.monotonic() + timeout_s
+        while not server.done.wait(0.002):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"client-kill soak did not reach {versions} versions in "
+                    f"{timeout_s}s: {server.async_summary()}")
+            # bare version read: an intentionally racy poll, same discipline
+            # as the server-kill soak — the journals provide the consistency
+            while pending and server.server_version >= pending[0][1]:
+                rank, _mark = pending.pop(0)
+                clients[rank].hard_kill()
+                kills += 1
+                time.sleep(0.05)  # let the dead receive loop drain out
+                replacement = build_client(cfg, ds, model, rank=rank,
+                                           backend="INPROC")
+                if replacement.resumed_from_journal:
+                    resumed += 1
+                else:
+                    cold += 1
+                replacement.run_in_thread()
+                clients[rank] = replacement
+        wall = time.monotonic() - t0
+        summary = server.async_summary()
+        peak = int(server.aggregator.peak_buffered_updates)
+        server.finish()
+        for c in clients.values():
+            c.done.wait(5.0)
+        finished = sum(1 for c in clients.values() if c.done.is_set())
+        for c in clients.values():
+            c.finish()
+        InProcRouter.reset(run_id)
+        return {
+            "clients": n_clients,
+            "versions": summary["server_version"],
+            "wall_s": round(wall, 4),
+            "versions_per_sec": round(summary["server_version"] / max(wall, 1e-9), 4),
+            "arrivals": summary["arrivals"],
+            "kills": kills,
+            "resumed_from_journal": resumed,
+            "cold_rejoins": cold,
+            "unaccounted": kills - resumed - cold,
+            "deduped": summary["deduped"],
+            "rejected_stale": summary["rejected_stale"],
+            "timeout_redispatches": summary["timeout_redispatches"],
+            "peak_buffered_updates": peak,
+            "clients_finished": finished,
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def run_client_crash_parity(codec: str = "topk", rounds: int = 3,
+                            kill_before_round: int = 2,
+                            seed: int = 0) -> dict:
+    """EF-residual durability proof (ISSUE 13 acceptance): the same 1-client
+    compressed run twice — REFERENCE (never crashed) and CRASHED (the client
+    hard-killed just before receiving ``kill_before_round``'s dispatch, then
+    rebuilt from its journal mid-run).  One client makes every fold order
+    deterministic, so the comparison is BITWISE: the resumed client must
+    carry the exact error-feedback residuals (topk) / produce the exact
+    stochastic-rounding stream (qsgd8) of its uncrashed twin, and the final
+    global models must match bit for bit.
+
+    The kill is injected at the router (single-arg ``route()`` tap, the
+    fabric's documented tap shape): the dispatch that would start
+    ``kill_before_round`` is held, the client killed, a replacement built
+    against the same journal, and the held dispatch delivered to it —
+    deterministic, no polling race."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import fedml_tpu
+
+    from ..comm.inproc import InProcRouter
+    from ..data import loader
+    from ..models import model_hub
+    from . import build_client, build_server, message_define as md
+
+    workdir = tempfile.mkdtemp(prefix="soak_parity_")
+
+    def _cfg(run_id, extra):
+        from fedml_tpu.arguments import Config
+
+        return Config(
+            training_type="cross_silo", dataset="synthetic", model="lr",
+            client_num_in_total=1, client_num_per_round=1, comm_round=rounds,
+            epochs=1, batch_size=16, learning_rate=0.1,
+            partition_method="homo", synthetic_train_size=64,
+            synthetic_test_size=64, frequency_of_the_test=0,
+            compute_dtype="float32", metrics_jsonl_path="", run_id=run_id,
+            random_seed=seed,
+            extra={"comm_compression": codec, "comm_compress_min_size": 64,
+                   **extra},
+        )
+
+    def _run(run_id, extra, tap_factory=None):
+        cfg = _cfg(run_id, extra)
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        model = model_hub.create(cfg, ds.class_num)
+        InProcRouter.reset(run_id)
+        router = InProcRouter.get(run_id)
+        holder = {"client": build_client(cfg, ds, model, rank=1,
+                                         backend="INPROC")}
+        if tap_factory is not None:
+            router.route = tap_factory(router, router.route, cfg, ds, model,
+                                       holder)
+        holder["thread"] = holder["client"].run_in_thread()
+        server = build_server(cfg, ds, model, backend="INPROC")
+        try:
+            server.run_until_done(timeout=120.0)
+            holder["client"].done.wait(5.0)
+        finally:
+            holder["client"].finish()
+        InProcRouter.reset(run_id)
+        return server, holder["client"]
+
+    try:
+        ref_server, ref_client = _run(f"parity_ref_{codec}_{seed}", {})
+
+        swapped = {"n": 0}
+
+        def tap_factory(router, orig_route, cfg, ds, model, holder):
+            def tap(msg):
+                if (msg.get_type() == md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
+                        and int(msg.get_control(md.MSG_ARG_KEY_ROUND_INDEX, -1))
+                        == kill_before_round
+                        and swapped["n"] == 0):
+                    swapped["n"] = 1
+                    holder["client"].hard_kill()
+                    # join the dead receive loop BEFORE delivering: a loop
+                    # blocked in its inbox poll could otherwise still grab
+                    # this dispatch and (being killed) drop it — and the
+                    # sync protocol has no redispatch to recover that
+                    holder["thread"].join(timeout=5.0)
+                    holder["client"] = build_client(cfg, ds, model, rank=1,
+                                                    backend="INPROC")
+                    holder["thread"] = holder["client"].run_in_thread()
+                orig_route(msg)
+            return tap
+
+        crash_server, crash_client = _run(
+            f"parity_crash_{codec}_{seed}",
+            {"client_journal_dir": f"{workdir}/client_journal"},
+            tap_factory)
+
+        ref_res = ref_client._comm_residuals or []
+        crash_res = crash_client._comm_residuals or []
+        bitwise_residuals = len(ref_res) == len(crash_res) and all(
+            (a is None and b is None)
+            or (a is not None and b is not None
+                and np.array_equal(np.asarray(a), np.asarray(b)))
+            for a, b in zip(ref_res, crash_res))
+        ref_leaves = jax.tree_util.tree_leaves(
+            jax.device_get(ref_server.aggregator.global_vars))
+        crash_leaves = jax.tree_util.tree_leaves(
+            jax.device_get(crash_server.aggregator.global_vars))
+        bitwise_global = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(ref_leaves, crash_leaves))
+        return {
+            "codec": codec,
+            "rounds": rounds,
+            "killed_before_round": kill_before_round,
+            "swapped": swapped["n"],
+            "resumed": bool(crash_client.resumed_from_journal),
+            "residual_leaves": sum(1 for r in ref_res if r is not None),
+            "bitwise_residuals": bool(bitwise_residuals),
+            "bitwise_global": bool(bitwise_global),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# real-process SIGKILL soak (ISSUE 13 tentpole d)
+# ---------------------------------------------------------------------------
+
+def _free_port_block(n: int, attempts: int = 64) -> int:
+    """Find a base port such that base..base+n-1 are all bindable right now
+    (the TCP transport derives each rank's listener as base+rank)."""
+    import socket
+
+    rng = np.random.default_rng([os.getpid(), int(time.time())])
+    for _ in range(attempts):
+        base = int(rng.integers(20000, 60000))
+        socks = []
+        try:
+            for off in range(n):
+                s = socket.socket()
+                s.bind(("0.0.0.0", base + off))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port block found")
+
+
+def _tail(path: str, nbytes: int = 4000) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - nbytes))
+            return f.read().decode("utf-8", "replace")
+    except OSError:
+        return "<no log>"
+
+
+def run_multiproc_kill_soak(n_clients: int = 3, versions: int = 160,
+                            buffer_k: int = 3, concurrency: int = 3,
+                            kill_server_at: int = 80,
+                            client_kills: tuple = ((1, 20), (2, 45)),
+                            journal_every_rounds: int = 5,
+                            redispatch_timeout_s: float = 1.0, seed: int = 0,
+                            timeout_s: float = 420.0) -> dict:
+    """REAL OS processes, REAL SIGKILLs (ISSUE 13): one buffered-async
+    server process + ``n_clients`` real client processes over the TCP
+    backend, each party journaling (server recovery journal + per-client
+    journals).  The supervisor watches round progress through the server's
+    journal steps (read-only — atomic replace makes concurrent reads safe),
+    SIGKILLs the server at ``kill_server_at`` and each ``(rank,
+    at_version)`` client at its mark, restarts every victim against its
+    journal, and drives the run to completion.
+
+    Unlike the in-process ``hard_kill`` soaks (which share journal semantics
+    but not OS teardown), this exercises the whole real surface: process
+    death mid-flock, listener teardown and port rebinding, connection
+    refusals from dead peers, reconnect backoff against a listener that is
+    genuinely gone, and cold interpreter restarts.
+
+    The accounting identity, extended with client-side terms: the run
+    completes all ``versions`` with MONOTONE continuity (journal steps never
+    regress; the recovered server resumes at the last committed step); every
+    client kill comes back as exactly one restart, each either a journal
+    resume or a cold rejoin (``unaccounted`` == 0); and no upload folds
+    twice — crash-resent duplicates reconcile as the server's ``deduped``
+    counter, enforced by the journaled idempotence-key table.
+
+    Sizing note: rounds are CHEAP (tiny lr model, warm compile cache) while
+    a SIGKILL restart costs a full interpreter boot (~5-10s), so the run
+    needs enough versions that rounds are still left when victims come back
+    — the defaults (160 versions at a 5-round journal cadence, kills spread
+    across the first half) keep every restart mid-run."""
+    import glob
+    import json
+    import shutil
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    from .journal import ServerJournal
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    workdir = tempfile.mkdtemp(prefix="soak_multiproc_")
+    summary_path = os.path.join(workdir, "server_summary.json")
+    journal_dir = os.path.join(workdir, "server_journal")
+    base_port = _free_port_block(n_clients + 1)
+    cfg_path = os.path.join(workdir, "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump({
+            "training_type": "cross_silo", "dataset": "synthetic",
+            "model": "lr", "client_num_in_total": n_clients,
+            "client_num_per_round": concurrency, "comm_round": versions,
+            "epochs": 1, "batch_size": 16, "learning_rate": 0.1,
+            "partition_method": "homo",
+            "synthetic_train_size": 64 * n_clients, "synthetic_test_size": 64,
+            "frequency_of_the_test": 0, "compute_dtype": "float32",
+            "metrics_jsonl_path": "", "random_seed": seed,
+            "run_id": f"mpsoak_{seed}", "backend": "TCP",
+            "extra": {
+                "async_aggregation": True, "async_buffer_k": buffer_k,
+                "async_concurrency": concurrency,
+                "async_redispatch_timeout_s": redispatch_timeout_s,
+                "server_journal_dir": journal_dir,
+                "server_journal_every_rounds": journal_every_rounds,
+                "client_journal_dir": os.path.join(workdir, "client_journal"),
+                "comm_compression": "topk", "comm_compress_min_size": 64,
+                "tcp_base_port": base_port,
+            },
+        }, f)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["SOAK_WORKER_TIMEOUT_S"] = str(timeout_s)
+    boots: dict[int, int] = {}
+
+    def spawn(role: str, rank: int):
+        boots[rank] = boots.get(rank, 0) + 1
+        log_path = os.path.join(
+            workdir, f"{role}_{rank}_boot{boots[rank]}.log")
+        with open(log_path, "wb") as lf:
+            return subprocess.Popen(
+                [sys.executable, "-m", "fedml_tpu.cross_silo.soak_worker",
+                 cfg_path, role, str(rank), workdir],
+                stdout=lf, stderr=subprocess.STDOUT, env=env, cwd=repo_root)
+
+    def logs() -> str:
+        return "\n".join(
+            f"--- {p} ---\n{_tail(p)}"
+            for p in sorted(glob.glob(os.path.join(workdir, "*.log"))))
+
+    journal_reader = ServerJournal(journal_dir)
+    procs: dict[int, subprocess.Popen] = {
+        r: spawn("client", r) for r in range(1, n_clients + 1)}
+    procs[0] = spawn("server", 0)
+    pending_client_kills = sorted(client_kills, key=lambda m: m[1])
+    server_killed = False
+    versions_at_kill = None
+    max_step_seen = 0
+    monotone = True
+    server_restarts = 0
+    client_restarts = 0
+    try:
+        deadline = time.monotonic() + timeout_s
+        while not os.path.exists(summary_path):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"multiproc soak did not complete in {timeout_s}s "
+                    f"(journal step {max_step_seen}/{versions})\n{logs()}")
+            steps = journal_reader.steps()
+            step = max(steps) if steps else 0
+            if step < max_step_seen:
+                monotone = False  # journal regressed: recovery broke continuity
+            max_step_seen = max(max_step_seen, step)
+            if not server_killed and max_step_seen >= kill_server_at:
+                server_killed = True
+                versions_at_kill = max_step_seen
+                procs[0].send_signal(signal.SIGKILL)
+                procs[0].wait(timeout=30)
+                time.sleep(0.2)
+                procs[0] = spawn("server", 0)
+                server_restarts += 1
+            while (pending_client_kills
+                   and max_step_seen >= pending_client_kills[0][1]):
+                rank, _mark = pending_client_kills.pop(0)
+                procs[rank].send_signal(signal.SIGKILL)
+                procs[rank].wait(timeout=30)
+                time.sleep(0.2)
+                procs[rank] = spawn("client", rank)
+                client_restarts += 1
+            # a worker that died on its own (not our SIGKILL) is a failure
+            for rank, p in procs.items():
+                if p.poll() not in (None, 0):
+                    raise RuntimeError(
+                        f"worker rank {rank} exited rc={p.poll()} "
+                        f"unexpectedly\n{logs()}")
+            time.sleep(0.02)
+        with open(summary_path) as f:
+            summary = json.load(f)
+        # FINISH reached the fleet: give clients a bounded drain window
+        drain = time.monotonic() + 30.0
+        while (time.monotonic() < drain
+               and any(procs[r].poll() is None
+                       for r in range(1, n_clients + 1))):
+            time.sleep(0.2)
+        clients_finished = sum(
+            1 for r in range(1, n_clients + 1) if procs[r].poll() == 0)
+        resumed = cold = 0
+        for bp in glob.glob(os.path.join(workdir, "boot_r*.json")):
+            with open(bp) as f:
+                boot = json.load(f)
+            if boot.get("restart"):
+                if boot.get("resumed"):
+                    resumed += 1
+                else:
+                    cold += 1
+        return {
+            "clients": n_clients,
+            "versions": summary["server_version"],
+            "versions_at_kill": versions_at_kill,
+            "recovered_step": summary.get("recovered_step"),
+            "session_epoch": summary["session_epoch"],
+            "monotone": bool(
+                monotone and summary["server_version"] >= max_step_seen
+                and (summary.get("recovered_step") or 0) <= (versions_at_kill
+                                                             or versions)),
+            "completed": bool(summary.get("completed")),
+            "arrivals": summary["arrivals"],
+            "server_kills": server_restarts,
+            "client_kills": client_restarts,
+            "resumed_from_journal": resumed,
+            "cold_rejoins": cold,
+            "unaccounted": client_restarts - resumed - cold,
+            "deduped": summary["deduped"],
+            "rejected_stale": summary["rejected_stale"],
+            "timeout_redispatches": summary["timeout_redispatches"],
+            "clients_finished": clients_finished,
+        }
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            with contextlib.suppress(Exception):
+                p.wait(timeout=10)
+        shutil.rmtree(workdir, ignore_errors=True)

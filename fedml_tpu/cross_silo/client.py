@@ -180,6 +180,20 @@ class ClientMasterManager(FedMLCommManager):
         # the reply (acceptance is about which dispatch produced the work)
         self._last_epoch: Optional[int] = None
         self.server_restarts_seen = 0
+        # crash-recovery journal (ISSUE 13, extra.client_journal_dir): the
+        # client snapshots its protocol state (EF residuals, last version +
+        # epoch, upload attempts, optional trainer local state) BEFORE every
+        # upload and resumes from it on restart; uploads then carry the
+        # idempotence key the servers dedup on.  None = off, wire unchanged.
+        from .client_journal import client_journal_from_config
+
+        self.client_journal = client_journal_from_config(cfg, rank)
+        self.resumed_from_journal = False
+        #: "<round>:<epoch>" -> attempts sent so far (bounded, journaled)
+        self._upload_attempts: dict[str, int] = {}
+        #: crash-simulation latch (the soak harnesses' in-process SIGKILL):
+        #: once set, this client makes no further sends or journal writes
+        self._killed = False
         # compressed uploads (extra.comm_compression: qsgd8 | topk): the
         # reply carries the DELTA vs the received global model, compressed
         # per-leaf on the wire-v2 format; the top-k error-feedback residual
@@ -200,6 +214,10 @@ class ClientMasterManager(FedMLCommManager):
             min_elems = getattr(trainer, "comm_compress_min_elems", None)
         self._comm_min_elems = int(
             min_elems if min_elems is not None else codecs.DEFAULT_MIN_COMPRESS_ELEMS)
+        # resume mid-conversation: restore residuals/epoch/attempts from the
+        # newest intact journal snapshot (after the codec state above exists)
+        if self.client_journal is not None:
+            self._client_journal_recover()
         # remote observability: per-round events (+ anything the caller
         # ships via self.obs — perf samples, RuntimeLogDaemon batches) ride
         # the FL transport to the server's ObsCollector.  The train events
@@ -266,6 +284,8 @@ class ClientMasterManager(FedMLCommManager):
         self._train_and_send(msg)
 
     def _train_and_send(self, msg: Message) -> None:
+        if self._killed:
+            return  # crash simulation: a dead client trains and sends nothing
         round_idx = int(msg.get(md.MSG_ARG_KEY_ROUND_INDEX))
         # session epoch (control-only read: absent on a journal-less server,
         # and materializing tensors here would be wasted work) — echoed back
@@ -291,7 +311,96 @@ class ClientMasterManager(FedMLCommManager):
         reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
         if epoch is not None:
             reply.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, int(epoch))
+        if self.client_journal is not None:
+            # exactly-once: journal (residuals + attempt) BEFORE the send, so
+            # every distinct piece of work ships under a distinct key and any
+            # redelivery of these bytes is server-deduplicable.  A crash here
+            # burns an attempt number; a crash before this line re-trains
+            # deterministically and re-sends under the same key.
+            attempt = self._next_upload_attempt(round_idx, epoch)
+            self._client_journal_snapshot(round_idx, epoch)
+            reply.add_params(
+                md.MSG_ARG_KEY_UPLOAD_KEY,
+                f"{self.rank}:{round_idx}:"
+                f"{-1 if epoch is None else int(epoch)}:{attempt}")
         self._send_with_reconnect(reply, seed_extra=round_idx)
+
+    # -- crash-recovery journal (ISSUE 13) ------------------------------------
+    def _next_upload_attempt(self, round_idx: int, epoch) -> int:
+        """Attempt ordinal for this (round, epoch)'s upload; the bounded dict
+        drops the oldest entries (only the current assignment can still be
+        re-dispatched)."""
+        k = f"{round_idx}:{-1 if epoch is None else int(epoch)}"
+        n = self._upload_attempts.get(k, 0)
+        self._upload_attempts[k] = n + 1
+        from .client_journal import MAX_ATTEMPT_ENTRIES
+
+        while len(self._upload_attempts) > MAX_ATTEMPT_ENTRIES:
+            self._upload_attempts.pop(next(iter(self._upload_attempts)))
+        return n
+
+    def _client_journal_snapshot(self, round_idx: int, epoch) -> None:
+        """Durably commit the protocol state this upload depends on: the
+        post-compression EF residual carry, last round/epoch, attempt
+        counters, and (when the trainer keeps one) its local state."""
+        if self.client_journal is None or self._killed:
+            return
+        from .client_journal import pack_client_state
+
+        exporter = getattr(self.trainer, "export_local_state", None)
+        tstate = exporter() if callable(exporter) else None
+        proto, arrays = pack_client_state(
+            rank=self.rank, round_idx=round_idx, session_epoch=self._last_epoch,
+            rounds_trained=self.rounds_trained,
+            server_restarts_seen=self.server_restarts_seen,
+            upload_attempts=self._upload_attempts,
+            residuals=self._comm_residuals, trainer_state=tstate)
+        try:
+            self.client_journal.snapshot_state(proto, arrays)
+        except OSError:
+            # durability degraded (disk full, dir vanished) must not kill the
+            # round — the client keeps training, it just rejoins cold
+            log.warning("client %d: journal snapshot failed; continuing "
+                        "without durability", self.rank, exc_info=True)
+
+    def _client_journal_recover(self) -> None:
+        """Install the newest intact client snapshot (construction-time):
+        the restarted client resumes mid-conversation — EF residuals intact,
+        epoch remembered, attempt counters monotone — instead of rejoining
+        cold."""
+        from .client_journal import CLIENT_RESUMES, unpack_client_state
+
+        snap = self.client_journal.restore_state()
+        if snap is None:
+            CLIENT_RESUMES.inc(result="cold")
+            return
+        state = unpack_client_state(snap)
+        self._comm_residuals = state["residuals"]
+        self._last_epoch = state["session_epoch"]
+        self.rounds_trained = state["rounds_trained"]
+        self.server_restarts_seen = state["server_restarts_seen"]
+        self._upload_attempts = state["upload_attempts"]
+        if state["trainer_state"] is not None:
+            restorer = getattr(self.trainer, "restore_local_state", None)
+            if callable(restorer):
+                restorer(state["trainer_state"])
+        self.resumed_from_journal = True
+        CLIENT_RESUMES.inc(result="resumed")
+        log.info("client %d: resumed from journal step %d (round %s, epoch "
+                 "%s, %d rounds trained)", self.rank, snap["step"],
+                 state["round_idx"], state["session_epoch"],
+                 state["rounds_trained"])
+
+    def hard_kill(self) -> None:  # graftlint: disable=GL008(crash simulation: deliberately lock-free like the server's hard_kill — a SIGKILL takes no locks either; the receive-loop thread re-checks _killed at every send/journal site and goes silent)
+        """Crash simulation for the soak harnesses: stop the receive loop and
+        go silent ABRUPTLY — no FINISH handshake, no journal write, no
+        teardown.  Anything not already journaled is lost, exactly like a
+        SIGKILL; only the process (which a real SIGKILL would reclaim) stays
+        alive for the harness to inspect.  A mid-train handler finishes its
+        XLA call but its send/journal sites observe ``_killed`` and drop the
+        result."""
+        self._killed = True
+        self.com_manager.stop_receive_message()
 
     def _send_with_reconnect(self, reply: Message, seed_extra: int = 0) -> None:
         """Upload with the reconnect handshake: a server mid-restart refuses
@@ -300,18 +409,24 @@ class ClientMasterManager(FedMLCommManager):
         fleet de-synchronizes instead of stampeding the restarted listener).
         Exhausted retries abandon the upload loudly: the server's straggler
         quorum / redispatch watchdog owns recovery from there."""
-        from ..comm.base import backoff_delay
+        from ..comm.base import BACKOFF_PURPOSE_RECONNECT, backoff_delay
 
         for attempt in range(RECONNECT_TRIES):
+            if self._killed:
+                return  # crash simulation: a dead client retries nothing
             try:
                 self.send_message(reply)
                 return
             except Exception:
                 if attempt + 1 >= RECONNECT_TRIES:
                     break
+                # the purpose constant namespaces this jitter stream away
+                # from the receive loop's decode-retry stream, so colocated
+                # schedules whose seeds coincide still de-correlate
                 delay = backoff_delay(
                     attempt, base=RECONNECT_BASE_S, cap=RECONNECT_CAP_S,
-                    seed=self.rank * 1_000_003 + int(seed_extra))
+                    seed=self.rank * 1_000_003 + int(seed_extra),
+                    purpose=BACKOFF_PURPOSE_RECONNECT)
                 log.warning(
                     "client %d: upload send failed (attempt %d/%d) — "
                     "reconnecting in %.3fs", self.rank, attempt + 1,

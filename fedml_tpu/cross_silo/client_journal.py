@@ -1,0 +1,182 @@
+"""Durable client recovery journal — survivable cross-silo clients (ISSUE 13).
+
+PR 10 made the *server* crash-safe; a killed client still lost everything it
+owned: its error-feedback residuals (silently corrupting the qsgd8/topk
+compression contract — the dropped top-k mass is supposed to be re-injected
+next round, and a cold rejoin throws it away), its last-seen session epoch,
+and its upload bookkeeping (a reconnecting client could re-send an upload the
+server already folded).  The communication-perspective FL survey (PAPERS.md
+2405.20431) names exactly this client churn the dominant reality practical
+deployments must absorb, so client state gets the same treatment the server
+got:
+
+- :class:`ClientJournal` — per-client, step-addressed snapshots in the
+  ``MAGIC + json meta + npz`` envelope with the tmp+``os.replace``+fsync+
+  flock discipline proven by :class:`~fedml_tpu.cross_silo.journal.
+  ServerJournal` and the AOT store (it *is* a ``ServerJournal`` pointed at
+  ``<root>/client_<rank>``; the model checkpointer half simply stays unused).
+- **Snapshot-before-send is the exactly-once protocol.**  The client commits
+  ``(residuals, round/version, epoch, attempt)`` durably and only THEN sends
+  the upload carrying the idempotence key ``<rank>:<round>:<epoch>:<attempt>``
+  — so every distinct piece of work ships under a distinct key, and any
+  redelivery of the same bytes (a chaos duplicate, a reconnect resend, a
+  crash-resend of an attempt whose snapshot committed) reuses the same key
+  and is deduped by the server.  A crash BETWEEN snapshot and send just burns
+  an attempt number; a crash before the snapshot re-trains deterministically
+  (same round, same rng stream) and re-sends under the same key, which the
+  server folds at most once either way.
+- **Residual durability is bitwise.**  The journal stores the leaf-aligned
+  error-feedback residual list exactly as the codec returned it, so a
+  restarted client's next compressed upload is bit-identical to the upload an
+  uncrashed client would have produced (proven by the crash-parity test).
+
+Gated entirely on ``extra.client_journal_dir``: unset means
+:func:`client_journal_from_config` returns ``None``, no key header is ever
+stamped, and the client's wire bytes stay byte-identical to the journal-free
+protocol.
+
+Thread model (GL008-audited): one journal belongs to ONE client manager and
+every snapshot/restore runs on that manager's receive-loop thread (handlers)
+or at construction — the journal itself is lock-free; the inherited flock is
+CROSS-process (a not-yet-dead predecessor vs the restarted client).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core.flags import cfg_extra
+from ..obs import registry as obsreg
+from .journal import ServerJournal
+
+log = logging.getLogger("fedml_tpu.cross_silo.client_journal")
+
+__all__ = ["ClientJournal", "client_journal_from_config",
+           "pack_client_state", "unpack_client_state"]
+
+CLIENT_RESUMES = obsreg.REGISTRY.counter(
+    "fedml_client_journal_resumes_total",
+    "Client-journal restore attempts at client construction, by result "
+    "(resumed = state applied, cold = no intact step found).",
+    labels=("result",),
+)
+
+#: upload-attempt entries retained per client — bounded: only the current
+#: (round, epoch) can be re-dispatched, older entries exist purely so a
+#: late redelivery of a previous round's key still reads as intentional
+MAX_ATTEMPT_ENTRIES = 8
+
+
+class ClientJournal(ServerJournal):
+    """Per-client recovery journal: the :class:`ServerJournal` envelope and
+    atomicity, scoped to ``<root>/client_<rank>`` with a local monotonic
+    step sequence (async dispatches can repeat a server version, so the
+    version is state *inside* the snapshot, not its address)."""
+
+    def __init__(self, root: str, rank: int, keep: int = 2):
+        super().__init__(os.path.join(str(root), f"client_{int(rank)}"),
+                         keep=keep)
+        self.rank = int(rank)
+        steps = self.steps()
+        self._seq = steps[-1] if steps else 0
+
+    def snapshot_state(self, protocol: dict,
+                       arrays: Optional[dict] = None) -> None:
+        """Commit the next step in this client's local sequence."""
+        self._seq += 1
+        self.snapshot(self._seq, protocol, arrays)
+
+    def restore_state(self) -> Optional[dict]:
+        """Newest intact snapshot (``{"step", "protocol", "arrays", ...}``)
+        or None; advances the local sequence past it so post-restore
+        snapshots never rewind."""
+        snap = self.restore()
+        if snap is not None:
+            self._seq = max(self._seq, int(snap["step"]))
+        return snap
+
+
+def pack_client_state(*, rank: int, round_idx: Optional[int],
+                      session_epoch: Optional[int], rounds_trained: int,
+                      server_restarts_seen: int, upload_attempts: dict,
+                      residuals: Optional[list],
+                      trainer_state: Any = None) -> tuple[dict, dict]:
+    """Client protocol state -> (json protocol, named numpy arrays).
+
+    ``residuals`` is the codec's leaf-aligned error-feedback list (entries
+    may be None — qsgd8 carries none, topk skips small/raw leaves); the
+    arrays store only the present entries and the protocol records the list
+    length + indices so :func:`unpack_client_state` reconstructs the exact
+    shape.  ``trainer_state`` (optional: optimizer/LoRA local state) is any
+    pytree — flattened through the wire skeleton so the arrays stay named
+    and the structure rides the JSON side."""
+    from ..comm import wire
+
+    proto: dict = {
+        "kind": "client",
+        "rank": int(rank),
+        "round_idx": None if round_idx is None else int(round_idx),
+        "session_epoch": None if session_epoch is None else int(session_epoch),
+        "rounds_trained": int(rounds_trained),
+        "server_restarts_seen": int(server_restarts_seen),
+        "upload_attempts": {str(k): int(v) for k, v in upload_attempts.items()},
+    }
+    arrays: dict = {}
+    if residuals is not None:
+        idx = [i for i, r in enumerate(residuals) if r is not None]
+        proto["residual_len"] = len(residuals)
+        proto["residual_idx"] = idx
+        for i in idx:
+            arrays[f"resid_{i}"] = np.asarray(residuals[i])
+    if trainer_state is not None:
+        skel, leaves = wire.flatten_with_skeleton(trainer_state)
+        proto["trainer_skel"] = skel
+        for i, leaf in enumerate(leaves):
+            arrays[f"local_{i}"] = np.asarray(leaf)
+    return proto, arrays
+
+
+def unpack_client_state(snap: dict) -> dict:
+    """Inverse of :func:`pack_client_state` over a journal snapshot dict."""
+    from ..comm import wire
+
+    proto, arrays = snap["protocol"], snap["arrays"]
+    residuals = None
+    if proto.get("residual_len") is not None:
+        residuals = [None] * int(proto["residual_len"])
+        for i in proto.get("residual_idx") or []:
+            residuals[int(i)] = np.asarray(arrays[f"resid_{int(i)}"])
+    trainer_state = None
+    if proto.get("trainer_skel") is not None:
+        n = len([k for k in arrays if k.startswith("local_")])
+        leaves = [arrays[f"local_{i}"] for i in range(n)]
+        trainer_state = wire.restore_skeleton(proto["trainer_skel"], leaves)
+    return {
+        "round_idx": proto.get("round_idx"),
+        "session_epoch": proto.get("session_epoch"),
+        "rounds_trained": int(proto.get("rounds_trained", 0)),
+        "server_restarts_seen": int(proto.get("server_restarts_seen", 0)),
+        "upload_attempts": {str(k): int(v) for k, v in
+                            (proto.get("upload_attempts") or {}).items()},
+        "residuals": residuals,
+        "trainer_state": trainer_state,
+    }
+
+
+def client_journal_from_config(cfg: Any, rank: int) -> Optional[ClientJournal]:
+    """The one gate: ``extra.client_journal_dir`` unset/falsy → ``None``
+    (no journal object, no key header, wire byte-identical)."""
+    if cfg is None or not cfg_extra(cfg, "client_journal_dir"):
+        return None
+    root = cfg_extra(cfg, "client_journal_dir")
+    keep = int(cfg_extra(cfg, "client_journal_keep"))
+    try:
+        return ClientJournal(str(root), rank, keep=keep)
+    except OSError as e:
+        log.warning("client journal: directory %s unusable (%s) — running "
+                    "without crash recovery", root, e)
+        return None

@@ -138,11 +138,22 @@ class ServerJournal:
     # -- write side ----------------------------------------------------------
     def snapshot(self, step: int, protocol: dict,
                  arrays: Optional[dict] = None,
-                 model_state: Optional[dict] = None) -> None:
+                 model_state: Optional[dict] = None,
+                 model_step: Optional[int] = None) -> None:
         """Commit one step.  Write order is model-first so a crash between
         the two writes leaves a sidecar-less model step (ignored) rather
         than a sidecar pointing at a missing model — the sidecar is the
-        commit record."""
+        commit record.
+
+        ``model_step`` (mid-round snapshots, ISSUE 13): instead of
+        re-serializing the unchanged model tree every few folds, the sidecar
+        REFERENCES the boundary step whose model checkpoint already holds
+        this round's starting global — restore loads the model from there.
+        The referenced step is always the newest model checkpoint (the round
+        being accumulated started from it), so pruning never orphans it.
+        Re-snapshotting the SAME step (each fold cadence overwrites the
+        round's sidecar with more progress) is atomic: readers see the
+        previous complete sidecar or the new one, never a torn mix."""
         t0 = time.perf_counter()
         with self._journal_flock():
             has_model = model_state is not None
@@ -159,6 +170,8 @@ class ServerJournal:
                 "created_unix": round(time.time(), 3),
                 "protocol": protocol,
             }
+            if not has_model and model_step is not None:
+                meta["model_step"] = int(model_step)
             blob = (_MAGIC + json.dumps(meta, sort_keys=True).encode("utf-8")
                     + b"\n" + payload)
             path = self._step_path(step)
@@ -215,8 +228,11 @@ class ServerJournal:
         """Newest intact snapshot, falling back past corrupt steps.
 
         A step counts only when its sidecar parses AND (when the snapshot
-        carried a model) the model checkpoint at the same step restores;
-        anything less is discarded and the previous step is tried."""
+        carried or referenced a model) the model checkpoint it names
+        restores; anything less is discarded and the previous step is
+        tried.  The result's ``model_step`` is the step the model was
+        actually loaded from (None for model-less snapshots — a mid-round-0
+        sidecar, whose round started from the deterministic fresh init)."""
         for step in reversed(self.steps()):
             loaded = self._load_step(step)
             if loaded is None:
@@ -226,20 +242,28 @@ class ServerJournal:
                 continue
             meta, arrays = loaded
             model = None
+            model_from: Optional[int] = None
             if meta.get("has_model"):
+                model_from = step
+            elif meta.get("model_step") is not None:
+                model_from = int(meta["model_step"])
+            if model_from is not None:
                 try:
-                    model = self._model().restore(step, template=model_template)
+                    model = self._model().restore(model_from,
+                                                  template=model_template)
                 except Exception as e:
-                    log.warning("journal: step %d sidecar is intact but its "
-                                "model checkpoint is not (%s: %s) — falling "
-                                "back", step, type(e).__name__, e)
+                    log.warning("journal: step %d sidecar is intact but the "
+                                "model checkpoint it names (step %d) is not "
+                                "(%s: %s) — falling back", step, model_from,
+                                type(e).__name__, e)
                     DISCARDED.inc()
                     with contextlib.suppress(OSError):
                         os.remove(self._step_path(step))
                     continue
             RECOVERIES.inc(result="recovered")
             return {"step": step, "protocol": meta["protocol"],
-                    "arrays": arrays, "model": model}
+                    "arrays": arrays, "model": model,
+                    "model_step": model_from}
         RECOVERIES.inc(result="empty")
         return None
 

@@ -31,6 +31,15 @@ MSG_ARG_KEY_CLIENT_OS = "client_os"
 # them deterministically (never double-folded).  Absent when the journal is
 # off — the wire stays byte-identical to the journal-free protocol.
 MSG_ARG_KEY_SESSION_EPOCH = "session_epoch"
+# TPU-native extension: upload idempotence key (ISSUE 13).  Stamped as
+# "<rank>:<round>:<epoch>:<attempt>" on every model reply when the client's
+# crash-recovery journal (extra.client_journal_dir) is on; the client
+# journals the attempt counter BEFORE the send, so every distinct piece of
+# work carries a distinct key and any wire-level redelivery (chaos duplicate,
+# reconnect resend, crash-resend of an unjournaled attempt) is recognizable —
+# the servers fold each key at most once and count the rest as deduped.
+# Absent when client journaling is off: wire byte-identical to before.
+MSG_ARG_KEY_UPLOAD_KEY = "upload_key"
 
 CLIENT_STATUS_ONLINE = "ONLINE"
 CLIENT_OS_PYTHON = "python"

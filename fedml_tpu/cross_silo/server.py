@@ -70,6 +70,17 @@ REJECTED_STALE = obsreg.REGISTRY.counter(
     "slot — folding it would double-count work already in the journal).",
     labels=("reason",),
 )
+DEDUPED_UPLOADS = obsreg.REGISTRY.counter(
+    "fedml_crosssilo_uploads_deduped_total",
+    "Uploads dropped by the idempotence-key dedup (ISSUE 13): a redelivery "
+    "of bytes already folded — chaos duplicate, reconnect resend, or a "
+    "client crash-resend of a journaled attempt.",
+)
+
+#: idempotence keys remembered per client for the exactly-once dedup — small
+#: (duplicates arrive close to their original; the journal carries the table
+#: across a server crash so pre-crash folds still dedup after recovery)
+DEDUP_KEYS_PER_CLIENT = 16
 
 
 def _apply_delta(global_leaf, delta_leaf):
@@ -403,6 +414,12 @@ class FedMLAggregator:
             "stream_folded": int(self._stream_folded),
             "stream_samples": {str(k): float(v)
                                for k, v in sorted(self.sample_num_dict.items())},
+            # the clients whose folds the partial sums already contain —
+            # dense-buffered stragglers (model_dict) are NOT listed: their
+            # trees are not in the sidecar, so a mid-round resume re-collects
+            # them while the stream-folded contributions stay folded
+            "stream_clients": sorted(
+                set(self.flag_client_model_uploaded) - set(self.model_dict)),
         }
         sums = self._stream_acc.host_sums() if self._stream_acc is not None else []
         arrays = {f"stream_sum_{i}": a for i, a in enumerate(sums)}
@@ -431,6 +448,12 @@ class FedMLAggregator:
         self._stream_folded = int(proto.get("stream_folded", 0))
         for k, v in (proto.get("stream_samples") or {}).items():
             self.sample_num_dict[int(k)] = float(v)
+        # mid-round resume (ISSUE 13): the already-folded clients are marked
+        # received, so the resumed round neither re-dispatches to them nor
+        # double-folds a re-sent upload (pre-ISSUE-13 snapshots lack the key
+        # and restore with an empty set — the round simply redoes everyone)
+        for c in proto.get("stream_clients") or []:
+            self.flag_client_model_uploaded[int(c)] = True
 
     def test_on_server(self) -> dict:
         return {k: float(v) for k, v in self._eval_fn(self.global_vars, *self._test).items()}
@@ -569,6 +592,20 @@ class FedMLServerManager(FedMLCommManager):
         self.rejected_stale = 0
         self._journal_every = max(1, int(
             cfg_extra(cfg, "server_journal_every_rounds"))) if self.journal else 1
+        # exactly-once uploads (ISSUE 13): recently folded idempotence keys
+        # per client + the dedup counter; journaled, so redeliveries of
+        # pre-crash folds dedup after recovery too.  Keys only exist when the
+        # CLIENT journal stamps them — key-less uploads take the historical
+        # path untouched.
+        self._folded_keys: dict[int, object] = {}
+        self.deduped_uploads = 0
+        # mid-round journaling (ISSUE 13, sync server): snapshot the partial
+        # streaming fold every N folds so a crash between folds resumes the
+        # round's partial sum; the sidecar references the newest boundary
+        # model step instead of rewriting the model tree
+        self._journal_every_folds = max(0, int(
+            cfg_extra(cfg, "server_journal_every_folds"))) if self.journal else 0
+        self._last_model_step: Optional[int] = None
         if not getattr(type(self), "_journal_recover_deferred", False):
             self._journal_recover()
 
@@ -603,14 +640,15 @@ class FedMLServerManager(FedMLCommManager):
         self._arm_status_reprobe()
 
     def _arm_status_reprobe(self) -> None:  # graftlint: disable=GL008(single handle + attempt counter, benign race: finish() cancelling while the timer re-arms costs at most one extra probe, which re-checks _init_sent/done under _agg_lock and exits)
-        from ..comm.base import backoff_delay
+        from ..comm.base import BACKOFF_PURPOSE_STATUS_PROBE, backoff_delay
 
-        # capped exponential from a small base (deterministic jitter): a
-        # probe lost to a flaky wire re-fires in ~100ms, a genuinely slow
-        # fleet is re-probed at a gentle 1s cadence
+        # capped exponential from a small base (deterministic jitter, its own
+        # purpose stream): a probe lost to a flaky wire re-fires in ~100ms, a
+        # genuinely slow fleet is re-probed at a gentle 1s cadence
         attempt = self._status_probe_attempt
         self._status_probe_attempt = attempt + 1
-        t = threading.Timer(backoff_delay(attempt, base=0.1, cap=1.0),
+        t = threading.Timer(backoff_delay(attempt, base=0.1, cap=1.0,
+                                          purpose=BACKOFF_PURPOSE_STATUS_PROBE),
                             self._on_status_reprobe)
         t.daemon = True
         self._status_timer = t
@@ -679,6 +717,17 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_receive_model(self, msg: Message) -> None:
         with self._agg_lock:
+            sender = int(msg.get_sender_id())
+            # exactly-once (ISSUE 13): a key the server already folded is a
+            # redelivery of the same bytes (chaos duplicate, reconnect
+            # resend, crash-resend of a journaled attempt) — dropped and
+            # counted BEFORE any other gate, since the journaled key table
+            # outlives both the round and a server crash
+            upload_key = msg.get_control(md.MSG_ARG_KEY_UPLOAD_KEY)
+            if upload_key is not None and self._is_duplicate_upload(sender, upload_key):
+                self.deduped_uploads += 1
+                DEDUPED_UPLOADS.inc()
+                return
             if self.journal is not None:
                 # session-epoch fence (recovery): an upload produced by a
                 # pre-crash dispatch is rejected deterministically — the
@@ -696,7 +745,6 @@ class FedMLServerManager(FedMLCommManager):
                     return
             if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) != self.round_idx:
                 return  # stale round (post-timeout arrival)
-            sender = int(msg.get_sender_id())
             sent_at = self._sent_at.pop(sender, None)
             if sent_at is not None:
                 rtt = time.perf_counter() - sent_at
@@ -723,6 +771,15 @@ class FedMLServerManager(FedMLCommManager):
                     # (masked/ciphertext uploads) override this method with
                     # the historical 3-arg signature
                     self.aggregator.add_local_trained_result(sender, params, n_samples)
+            self._note_upload_key(sender, upload_key)
+            # mid-round durability (ISSUE 13): every N streaming folds the
+            # partial sums go to the journal, so a crash between folds
+            # resumes the round's fold instead of redoing it
+            if (self._journal_every_folds
+                    and self.aggregator._stream_folded
+                    and self.aggregator._stream_folded
+                    % self._journal_every_folds == 0):
+                self._journal_midround_snapshot()
             if self.aggregator.check_whether_all_receive(len(self.selected)):
                 self._finish_round()
 
@@ -835,6 +892,13 @@ class FedMLServerManager(FedMLCommManager):
         self._round_payload_bytes = 0
         params = jax.device_get(self.aggregator.global_vars)
         for cid in self.selected:
+            if self.aggregator.has_received(cid):
+                # mid-round journal resume (ISSUE 13): this client's fold is
+                # already in the restored partial sums — it stays selected
+                # (the all-receive count includes it) but is not re-asked to
+                # redo work the journal kept.  Empty outside recovery: flags
+                # reset at every aggregate.
+                continue
             msg = Message(msg_type, 0, cid)
             msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
             msg.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
@@ -870,12 +934,40 @@ class FedMLServerManager(FedMLCommManager):
                   "run_id": str(getattr(self.cfg, "run_id", "0")),
                   "session_epoch": self.session_epoch})
 
+    # -- exactly-once upload dedup (ISSUE 13) ---------------------------------
+    def _is_duplicate_upload(self, sender: int, key: str) -> bool:  # graftlint: disable=GL004(caller holds _agg_lock: receive-handler gate)
+        dq = self._folded_keys.get(sender)
+        return dq is not None and key in dq
+
+    def _note_upload_key(self, sender: int, key: Optional[str]) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: receive-handler accept path)
+        """Remember a folded upload's idempotence key (bounded per client)."""
+        if key is None:
+            return
+        from collections import deque
+
+        dq = self._folded_keys.get(sender)
+        if dq is None:
+            dq = self._folded_keys[sender] = deque(maxlen=DEDUP_KEYS_PER_CLIENT)
+        dq.append(key)
+
+    def _export_folded_keys(self) -> dict:  # graftlint: disable=GL004(caller holds _agg_lock: journal snapshot sites)
+        return {str(c): list(dq) for c, dq in sorted(self._folded_keys.items())}
+
+    def _restore_folded_keys(self, proto: dict) -> None:  # graftlint: disable=GL004(construction-time: runs from _journal_recover before any thread exists)
+        from collections import deque
+
+        for c, keys in (proto.get("folded_keys") or {}).items():
+            self._folded_keys[int(c)] = deque(
+                [str(k) for k in keys], maxlen=DEDUP_KEYS_PER_CLIENT)
+        self.deduped_uploads = int(proto.get("deduped", 0))
+
     # -- recovery journal -----------------------------------------------------
     def _journal_recover(self) -> None:  # graftlint: disable=GL004(construction-time: runs from __init__ before the receive loop or any timer thread exists)
         """Install the newest intact journal snapshot (construction-time):
-        round index, model/server-state tree, streaming partials, health
-        scores; resume under a bumped session epoch so pre-crash uploads are
-        recognizable."""
+        round index, model/server-state tree, streaming partials (including
+        a MID-ROUND partial fold — the round then resumes instead of
+        redoing), folded-key dedup table, health scores; resume under a
+        bumped session epoch so pre-crash uploads are recognizable."""
         if self.journal is None:
             return
         snap = self.journal.restore(model_template=self.aggregator.model_state())
@@ -885,17 +977,22 @@ class FedMLServerManager(FedMLCommManager):
         self.session_epoch = int(proto.get("session_epoch", 0)) + 1
         self.round_idx = int(proto.get("round_idx", 0))
         self.recovered_step = int(snap["step"])
+        self._last_model_step = snap.get("model_step")
         if snap["model"] is not None:
             self.aggregator.restore_model_state(snap["model"])
         self.aggregator.restore_stream_state(proto, snap["arrays"])
+        self._restore_folded_keys(proto)
         self.health.import_state(proto.get("health") or {})
-        log.info("recovered from journal step %d (round %d, session epoch %d)",
-                 self.recovered_step, self.round_idx, self.session_epoch)
+        log.info("recovered from journal step %d (round %d, session epoch %d, "
+                 "%d folds carried)", self.recovered_step, self.round_idx,
+                 self.session_epoch, self.aggregator._stream_folded)
 
     def _journal_protocol_state(self) -> dict:  # graftlint: disable=GL004(caller holds _agg_lock: _journal_snapshot runs at locked round boundaries)
         return {"kind": "sync", "session_epoch": self.session_epoch,
                 "round_idx": self.round_idx,
                 "rejected_stale": self.rejected_stale,
+                "deduped": self.deduped_uploads,
+                "folded_keys": self._export_folded_keys(),
                 "health": self.health.export_state()}
 
     def _journal_snapshot(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: round-boundary sites only)
@@ -910,6 +1007,33 @@ class FedMLServerManager(FedMLCommManager):
         self.journal.snapshot(
             step, {**self._journal_protocol_state(), **stream_proto},
             arrays, model_state=self.aggregator.model_state())
+        self._last_model_step = step
+
+    def _journal_midround_snapshot(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: receive-handler fold-cadence site)
+        """Commit the in-progress round's partial streaming fold (ISSUE 13):
+        the sidecar carries the accumulator partials + the folded-client set
+        and REFERENCES the boundary step whose model checkpoint holds this
+        round's starting global (``model_step``) — no model rewrite, so the
+        cadence stays cheap.  Atomically overwrites this round's sidecar
+        with more progress each time."""
+        stream_proto, arrays = self.aggregator.export_stream_state()
+        self.journal.snapshot(
+            self.round_idx, {**self._journal_protocol_state(), **stream_proto},
+            arrays, model_step=self._last_model_step)
+
+    def hard_kill(self) -> None:  # graftlint: disable=GL004(crash simulation: deliberately lock-free — a SIGKILL takes no locks either; every surviving thread re-checks state under _agg_lock and exits),GL008(same invariant)
+        """Crash simulation for the chaos harness (sync server): stop the
+        receive loop and all timers ABRUPTLY — no FINISH broadcast, no
+        journal write, no teardown bookkeeping.  Everything not already
+        committed to the journal (including a mid-round partial fold past
+        the last fold-cadence snapshot) is lost, exactly like a SIGKILL;
+        only the process stays alive for the test to inspect."""
+        for timer in (self._round_timer, self._status_timer):
+            if timer is not None:
+                timer.cancel()
+        self._round_timer = None
+        self._status_timer = None
+        self.com_manager.stop_receive_message()
 
     def send_finish(self) -> None:
         for cid in self.client_ids:

@@ -1,0 +1,97 @@
+"""Worker entry for the real-process SIGKILL soak (ISSUE 13).
+
+Spawned by :func:`fedml_tpu.cross_silo.async_soak.run_multiproc_kill_soak`:
+
+    python -m fedml_tpu.cross_silo.soak_worker <cfg.json> <role> <rank> <workdir>
+
+``role`` is ``server`` (rank 0: one buffered-async manager over the TCP
+backend, recovery journal on) or ``client`` (a REAL ``ClientMasterManager``
++ trainer with its own crash-recovery journal).  The supervisor SIGKILLs
+workers mid-run and respawns the identical command line — recovery is
+entirely the journals' job, the worker just builds and runs.
+
+Supervisor-facing artifacts (all atomic tmp+``os.replace`` writes in
+``workdir``):
+
+- ``boot_r<rank>_<pid>.json`` — written by every client at startup:
+  ``{"rank", "pid", "restart", "resumed"}``.  ``restart`` means an earlier
+  boot file for this rank exists (so this process replaces a SIGKILLed
+  predecessor); ``resumed`` is whether the client journal produced a warm
+  resume.  The soak's client-side accounting identity reads these.
+- ``server_summary.json`` — written by the server once the run completes:
+  ``async_summary()`` + a ``completed`` flag.  Its presence is the
+  supervisor's completion signal.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import tempfile
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), prefix=".tmp_")
+    with os.fdopen(fd, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def main() -> int:
+    cfg_path, role, rank, workdir = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), sys.argv[4])
+    timeout_s = float(os.environ.get("SOAK_WORKER_TIMEOUT_S", "600"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # share the repo-root persistent compilation cache with the test suite /
+    # dryrun / bench, so a SIGKILL-restarted worker recompiles nothing
+    from fedml_tpu.core.cache import setup_persistent_cache
+
+    setup_persistent_cache()
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+
+    with open(cfg_path) as f:
+        cfg = Config(**json.load(f))
+    fedml_tpu.init(cfg)
+
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+
+    if role == "server":
+        from fedml_tpu.cross_silo import build_server
+
+        server = build_server(cfg, ds, model, backend="TCP")
+        server.run_in_thread()
+        server.start()
+        ok = server.done.wait(timeout_s)
+        summary = server.async_summary()
+        summary["completed"] = bool(ok)
+        _atomic_write_json(os.path.join(workdir, "server_summary.json"), summary)
+        server.finish()
+        return 0 if ok else 3
+
+    from fedml_tpu.cross_silo import build_client
+
+    client = build_client(cfg, ds, model, rank=rank, backend="TCP")
+    prior_boots = glob.glob(os.path.join(workdir, f"boot_r{rank}_*.json"))
+    _atomic_write_json(
+        os.path.join(workdir, f"boot_r{rank}_{os.getpid()}.json"),
+        {"rank": rank, "pid": os.getpid(), "restart": bool(prior_boots),
+         "resumed": bool(client.resumed_from_journal)})
+    client.run_in_thread()
+    ok = client.done.wait(timeout_s)
+    client.finish()
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

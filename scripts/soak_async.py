@@ -11,6 +11,12 @@ stalls, leaks buffered updates (peak > 2), or loses a drop unaccounted.
 
     JAX_PLATFORMS=cpu python scripts/soak_async.py --clients 10000 \
         --concurrency 1024 --buffer-k 64 --versions 20
+
+Fault-tolerance modes: ``--kill-recover`` (ISSUE 10: in-process server
+hard-kill + journal recovery under seeded chaos on both legs) and
+``--procs N`` (ISSUE 13: real OS processes over TCP, seeded SIGKILLs of
+the server and clients, journal-recovered completion with the extended
+client-side accounting identity).
 """
 
 import argparse
@@ -36,13 +42,44 @@ def main() -> int:
     p.add_argument("--timeout-s", type=float, default=600.0)
     p.add_argument("--kill-recover", action="store_true",
                    help="ISSUE-10 mode: run with the recovery journal + "
-                        "seeded chaos, HARD-KILL the server mid-run, restart "
-                        "it, and assert the recovery invariants (monotone "
-                        "version, zero unaccounted losses)")
+                        "seeded chaos (BOTH legs: dispatch and upload), "
+                        "HARD-KILL the server mid-run, restart it, and "
+                        "assert the recovery invariants (monotone version, "
+                        "zero unaccounted losses, duplicates deduped)")
     p.add_argument("--journal-dir", default=None,
                    help="journal directory for --kill-recover (default: a "
                         "fresh temp dir, removed afterwards)")
+    p.add_argument("--procs", type=int, default=0, metavar="N",
+                   help="ISSUE-13 mode: REAL OS processes over the TCP "
+                        "backend — 1 server + N clients, seeded SIGKILLs of "
+                        "the server and clients mid-run, every party "
+                        "journal-recovered and the run driven to completion "
+                        "(client/server counts from --clients etc. are "
+                        "ignored; the multiproc soak sizes itself)")
     args = p.parse_args()
+
+    if args.procs:
+        from fedml_tpu.cross_silo.async_soak import run_multiproc_kill_soak
+
+        res = run_multiproc_kill_soak(n_clients=args.procs,
+                                      timeout_s=args.timeout_s)
+        print(json.dumps(res, indent=2))
+        failures = []
+        if not res["completed"]:
+            failures.append("run did not complete")
+        if not res["monotone"]:
+            failures.append("server version not monotone through the SIGKILL")
+        if res["server_kills"] < 1 or res["client_kills"] < 2:
+            failures.append(
+                f"kill schedule under-delivered (server {res['server_kills']}, "
+                f"clients {res['client_kills']})")
+        if res["unaccounted"] != 0:
+            failures.append(
+                f"{res['unaccounted']} client restarts unaccounted")
+        if failures:
+            print("SOAK FAILED: " + "; ".join(failures), file=sys.stderr)
+            return 1
+        return 0
 
     if args.kill_recover:
         from fedml_tpu.cross_silo.async_soak import run_kill_recover_soak

@@ -1,0 +1,459 @@
+"""Survivable clients (ISSUE 13): the client crash-recovery journal,
+exactly-once uploads under the idempotence-key dedup, mid-round sync-server
+journaling, the backoff purpose namespacing, and the real-process SIGKILL
+soak (slow-marked)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from .conftest import tiny_config
+
+
+def _load(cfg):
+    import fedml_tpu
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    return ds, model
+
+
+# ---------------------------------------------------------------------------
+# ClientJournal: roundtrip, sequence, gate
+# ---------------------------------------------------------------------------
+
+def test_client_journal_roundtrip_and_sequence(tmp_path):
+    from fedml_tpu.cross_silo.client_journal import (
+        ClientJournal, pack_client_state, unpack_client_state,
+    )
+
+    j = ClientJournal(str(tmp_path / "cj"), rank=3, keep=2)
+    residuals = [None, np.arange(8, dtype=np.float32), None,
+                 np.ones(4, np.float32) * 0.5]
+    tstate = {"momentum": {"w": np.arange(6, dtype=np.float32)}}
+    proto, arrays = pack_client_state(
+        rank=3, round_idx=5, session_epoch=2, rounds_trained=6,
+        server_restarts_seen=1, upload_attempts={"5:2": 2},
+        residuals=residuals, trainer_state=tstate)
+    j.snapshot_state(proto, arrays)
+    j.snapshot_state(proto, arrays)
+
+    # a fresh journal object (the restarted client) restores the newest step
+    # and continues the sequence past it
+    j2 = ClientJournal(str(tmp_path / "cj"), rank=3, keep=2)
+    snap = j2.restore_state()
+    assert snap["step"] == 2
+    state = unpack_client_state(snap)
+    assert state["round_idx"] == 5 and state["session_epoch"] == 2
+    assert state["rounds_trained"] == 6 and state["server_restarts_seen"] == 1
+    assert state["upload_attempts"] == {"5:2": 2}
+    got = state["residuals"]
+    assert len(got) == 4 and got[0] is None and got[2] is None
+    np.testing.assert_array_equal(got[1], residuals[1])
+    np.testing.assert_array_equal(got[3], residuals[3])
+    np.testing.assert_array_equal(
+        state["trainer_state"]["momentum"]["w"], tstate["momentum"]["w"])
+    j2.snapshot_state(proto, arrays)
+    assert j2.steps()[-1] == 3  # never rewinds over the restored step
+
+
+def test_client_journal_keep_prunes(tmp_path):
+    from fedml_tpu.cross_silo.client_journal import ClientJournal
+
+    j = ClientJournal(str(tmp_path / "cj"), rank=1, keep=2)
+    for _ in range(5):
+        j.snapshot_state({"kind": "client"}, {})
+    assert j.steps() == [4, 5]
+
+
+def test_client_journal_gate(tmp_path):
+    from fedml_tpu.cross_silo.client_journal import client_journal_from_config
+
+    assert client_journal_from_config(tiny_config(), rank=1) is None
+    assert client_journal_from_config(None, rank=1) is None
+    j = client_journal_from_config(
+        tiny_config(extra={"client_journal_dir": str(tmp_path / "cj")}), rank=2)
+    assert j is not None and j.rank == 2 and j.keep == 2
+
+
+# ---------------------------------------------------------------------------
+# EF-residual durability: crash-resume is BITWISE the uncrashed client
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("codec", ["topk", "qsgd8"])
+def test_client_crash_resume_bitwise_parity(codec, eight_devices):
+    from fedml_tpu.cross_silo.async_soak import run_client_crash_parity
+
+    res = run_client_crash_parity(codec=codec, rounds=3, kill_before_round=2)
+    assert res["swapped"] == 1, res
+    assert res["resumed"], res
+    if codec == "topk":
+        # the EF carry exists and survived the crash bit for bit
+        assert res["residual_leaves"] > 0, res
+    assert res["bitwise_residuals"], res
+    assert res["bitwise_global"], res
+
+
+# ---------------------------------------------------------------------------
+# exactly-once uploads: idempotence-key dedup on both servers
+# ---------------------------------------------------------------------------
+
+def _async_server(tmp_path, **extra):
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_server
+
+    cfg = tiny_config(
+        training_type="cross_silo", comm_round=50, run_id="dedup_async",
+        frequency_of_the_test=0,
+        extra={"async_aggregation": True, "async_buffer_k": 100,
+               "async_redispatch_timeout_s": 0.0,
+               "server_journal_dir": str(tmp_path / "j"), **extra})
+    ds, model = _load(cfg)
+    InProcRouter.reset("dedup_async")
+    return build_server(cfg, ds, model, backend="INPROC"), ds, model
+
+
+def _keyed_upload(rank, params, version, key):
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+
+    msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, rank, 0)
+    msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+    msg.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, 16.0)
+    msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, int(version))
+    if key is not None:
+        msg.add_params(md.MSG_ARG_KEY_UPLOAD_KEY, str(key))
+    return Message.decode(msg.encode())
+
+
+def test_async_dedup_folds_each_key_once(tmp_path, eight_devices):
+    import jax
+
+    server, ds, model = _async_server(tmp_path)
+    base = jax.device_get(server.aggregator.global_vars)
+
+    server.handle_message_receive_model(_keyed_upload(1, base, 0, "1:0:-1:0"))
+    assert server.total_arrivals == 1 and server.deduped_uploads == 0
+
+    # the identical key redelivered (chaos duplicate / reconnect resend /
+    # crash-resend of a journaled attempt): DEDUPED, never double-folded
+    server.handle_message_receive_model(_keyed_upload(1, base, 0, "1:0:-1:0"))
+    assert server.total_arrivals == 1 and server.deduped_uploads == 1
+
+    # a NEW attempt of the same assignment is new work (the client journaled
+    # a fresh attempt, so the old one never folded or was lost): FOLDED
+    server.handle_message_receive_model(_keyed_upload(1, base, 0, "1:0:-1:1"))
+    assert server.total_arrivals == 2 and server.deduped_uploads == 1
+
+    # key-less uploads (client journaling off) take the historical path
+    server.handle_message_receive_model(_keyed_upload(2, base, 0, None))
+    server.handle_message_receive_model(_keyed_upload(2, base, 0, None))
+    assert server.total_arrivals == 4 and server.deduped_uploads == 1
+    server.finish()
+
+
+def test_async_dedup_table_survives_server_crash(tmp_path, eight_devices):
+    """The folded-key table is journaled: a duplicate of a PRE-crash fold
+    arriving at the RECOVERED server still dedups instead of re-entering
+    through the in-flight acceptance."""
+    import jax
+
+    server_a, ds, model = _async_server(tmp_path, async_buffer_k=2)
+    base = jax.device_get(server_a.aggregator.global_vars)
+    # two keyed folds close the virtual round -> journal snapshot commits
+    # the key table with the version bump
+    server_a.handle_message_receive_model(_keyed_upload(1, base, 0, "1:0:-1:0"))
+    server_a.handle_message_receive_model(_keyed_upload(2, base, 0, "2:0:-1:0"))
+    assert server_a.server_version == 1
+    server_a.hard_kill()
+
+    from fedml_tpu.cross_silo import build_server
+
+    server_b = build_server(server_a.cfg, ds, model, backend="INPROC")
+    assert server_b.server_version == 1  # recovered
+    assert server_b.session_epoch == 1
+    server_b.handle_message_receive_model(_keyed_upload(1, base, 0, "1:0:-1:0"))
+    assert server_b.deduped_uploads == 1
+    assert server_b.total_arrivals == 2  # journaled counter, nothing refolded
+    server_b.finish()
+
+
+def test_sync_dedup_counts_duplicates(tmp_path, eight_devices):
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_server
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=4,
+        client_num_per_round=4, comm_round=1, run_id="dedup_sync",
+        frequency_of_the_test=0,
+        extra={"streaming_aggregation": True,
+               "server_journal_dir": str(tmp_path / "j")})
+    ds, model = _load(cfg)
+    InProcRouter.reset("dedup_sync")
+    server = build_server(cfg, ds, model, backend="INPROC")
+    import jax
+
+    base = jax.device_get(server.aggregator.global_vars)
+    server.selected = [1, 2, 3, 4]
+    server._init_sent = True
+    server.handle_message_receive_model(_keyed_upload(1, base, 0, "1:0:0:0"))
+    server.handle_message_receive_model(_keyed_upload(1, base, 0, "1:0:0:0"))
+    assert server.deduped_uploads == 1
+    assert server.aggregator.received_count() == 1
+    server.finish()
+    InProcRouter.reset("dedup_sync")
+
+
+# ---------------------------------------------------------------------------
+# mid-round sync journaling: crash between folds resumes the partial fold
+# ---------------------------------------------------------------------------
+
+def _scaled(params, cid):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: ((np.asarray(a) * (1.0 + 0.01 * cid)).astype(a.dtype)
+                   if np.asarray(a).dtype.kind == "f" else a), params)
+
+
+def _mk_sync_server(tmp_path, run_id, journal):
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_server
+
+    cfg = tiny_config(
+        training_type="cross_silo", client_num_in_total=4,
+        client_num_per_round=4, comm_round=2, run_id=run_id,
+        frequency_of_the_test=0,
+        extra={"streaming_aggregation": True,
+               **({"server_journal_dir": str(tmp_path / "j"),
+                   "server_journal_every_folds": 1} if journal else {})})
+    ds, model = _load(cfg)
+    InProcRouter.reset(run_id)
+    server = build_server(cfg, ds, model, backend="INPROC")
+    server.selected = [1, 2, 3, 4]
+    server._init_sent = True
+    return server, ds, model
+
+
+def test_sync_midround_crash_resumes_partial_fold_bitwise(tmp_path,
+                                                          eight_devices):
+    """The acceptance run: round 0 completes, round 1 is killed after 2 of
+    4 folds (each journaled at the fold cadence), the restart resumes the
+    PARTIAL fold (folds-after-recovery = 2 < 4) and the finished global is
+    BITWISE the uninterrupted run's — including the model_step reference
+    (the mid-round sidecar points at round 0's boundary checkpoint instead
+    of rewriting the model)."""
+    import jax
+
+    # uninterrupted reference: 2 rounds, uploads in fixed order 1..4
+    ref, _, _ = _mk_sync_server(tmp_path / "ref", "midround_ref", journal=False)
+    base = jax.device_get(ref.aggregator.global_vars)
+    for r in (0, 1):
+        for cid in (1, 2, 3, 4):
+            ref.handle_message_receive_model(
+                _keyed_upload(cid, _scaled(base, cid), r, None))
+        if r == 0:
+            ref.selected = [1, 2, 3, 4]  # _broadcast_model re-selected; pin
+    assert ref.done.is_set()
+    ref_leaves = jax.tree_util.tree_leaves(
+        jax.device_get(ref.aggregator.global_vars))
+
+    # crashed run: same uploads, killed mid-round-1 after 2 folds
+    srv_a, ds, model = _mk_sync_server(tmp_path / "crash", "midround_a",
+                                       journal=True)
+    for cid in (1, 2, 3, 4):
+        srv_a.handle_message_receive_model(
+            _keyed_upload(cid, _scaled(base, cid), 0, None))
+    srv_a.selected = [1, 2, 3, 4]
+    assert srv_a.round_idx == 1
+    for cid in (1, 2):
+        srv_a.handle_message_receive_model(
+            _keyed_upload(cid, _scaled(base, cid), 1, None))
+    assert srv_a.aggregator._stream_folded == 2
+    srv_a.hard_kill()
+
+    from fedml_tpu.cross_silo import build_server
+
+    srv_b = build_server(srv_a.cfg, ds, model, backend="INPROC")
+    # resumed MID-round: partial fold + folded-client set restored, model
+    # loaded through the referenced boundary step
+    assert srv_b.round_idx == 1
+    assert srv_b.session_epoch == 1
+    assert srv_b.aggregator._stream_folded == 2
+    assert srv_b.aggregator.has_received(1) and srv_b.aggregator.has_received(2)
+    assert not srv_b.aggregator.has_received(3)
+    srv_b.selected = [1, 2, 3, 4]
+    srv_b._init_sent = True
+    for cid in (3, 4):  # folds-after-recovery = 2 < 4 clients/round
+        srv_b.handle_message_receive_model(
+            _keyed_upload(cid, _scaled(base, cid), 1, None))
+    assert srv_b.done.is_set()
+    res_leaves = jax.tree_util.tree_leaves(
+        jax.device_get(srv_b.aggregator.global_vars))
+    for x, y in zip(ref_leaves, res_leaves):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    srv_a.finish()
+    srv_b.finish()
+
+
+def test_midround_broadcast_skips_folded_clients(tmp_path, eight_devices):
+    """A recovered mid-round server re-broadcasts the interrupted round only
+    to the NOT-yet-folded clients — the journal kept the others' work."""
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_server, message_define as md
+    import jax
+
+    srv_a, ds, model = _mk_sync_server(tmp_path, "midround_bcast",
+                                       journal=True)
+    base = jax.device_get(srv_a.aggregator.global_vars)
+    for cid in (1, 2):
+        srv_a.handle_message_receive_model(
+            _keyed_upload(cid, _scaled(base, cid), 0, None))
+    srv_a.hard_kill()
+
+    srv_b = build_server(srv_a.cfg, ds, model, backend="INPROC")
+    sent = []
+    router = InProcRouter.get("midround_bcast")
+    orig_route = router.route
+
+    def tap(msg):
+        if msg.get_type() in (md.MSG_TYPE_S2C_INIT_CONFIG,
+                              md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT):
+            sent.append(msg.get_receiver_id())
+        orig_route(msg)
+
+    router.route = tap
+    srv_b.send_init_msg()  # all-online entry point of the resumed round
+    assert sorted(sent) == [3, 4]  # folded clients not re-asked
+    assert sorted(srv_b.selected) == [1, 2, 3, 4]  # but still counted
+    srv_a.finish()
+    srv_b.finish()
+    InProcRouter.reset("midround_bcast")
+
+
+# ---------------------------------------------------------------------------
+# journal back-compat + prune (satellite)
+# ---------------------------------------------------------------------------
+
+def test_pre13_snapshot_still_restores(tmp_path, eight_devices):
+    """A PR-10-era snapshot (no model_step, folded_keys, deduped, or
+    stream_clients fields) restores into the ISSUE-13 servers with empty
+    dedup state — the sidecar format change is purely additive."""
+    from fedml_tpu.cross_silo.journal import ServerJournal
+
+    server, ds, model = _async_server(tmp_path / "fresh")
+    model_state = server.aggregator.model_state()
+    server.finish()
+
+    jd = tmp_path / "old" / "j"
+    j = ServerJournal(str(jd), keep=3)
+    j.snapshot(2, {"kind": "async", "session_epoch": 0, "server_version": 2,
+                   "round_idx": 2, "outstanding": {"1": 1}, "rr_cursor": 4,
+                   "total_arrivals": 7},
+               arrays={}, model_state=model_state)
+
+    srv, _, _ = _async_server(tmp_path / "old")
+    assert srv.server_version == 2
+    assert srv.session_epoch == 1
+    assert srv.total_arrivals == 7
+    assert srv.deduped_uploads == 0 and srv._folded_keys == {}
+    assert srv._prev_epoch_inflight == {1: 1}
+    srv.finish()
+
+
+def test_midround_snapshots_respect_keep_and_never_prune_newest(tmp_path):
+    from fedml_tpu.cross_silo.journal import ServerJournal
+
+    j = ServerJournal(str(tmp_path / "j"), keep=2)
+    for step in (1, 2, 3):
+        j.snapshot(step, {"server_version": step}, arrays={})
+    assert j.steps() == [2, 3]
+    # mid-round cadence: the in-progress round OVERWRITES its own step with
+    # more progress — no step-count growth, so keep never prunes the newest
+    for folds in (1, 2, 3):
+        # model-less mid-round sidecar (a round started from the fresh init
+        # references no model step; the model_step restore path is covered
+        # by test_sync_midround_crash_resumes_partial_fold_bitwise)
+        j.snapshot(3, {"server_version": 3, "stream_folded": folds},
+                   arrays={"stream_sum_0": np.ones(4, np.float32) * folds})
+    assert j.steps() == [2, 3]
+    snap = j.restore()
+    assert snap["step"] == 3
+    assert snap["protocol"]["stream_folded"] == 3  # the newest overwrite won
+    np.testing.assert_array_equal(snap["arrays"]["stream_sum_0"],
+                                  np.ones(4, np.float32) * 3)
+
+
+# ---------------------------------------------------------------------------
+# backoff purpose namespacing (satellite)
+# ---------------------------------------------------------------------------
+
+def test_backoff_purpose_streams_decorrelate():
+    """Colocated retry schedules whose numeric seeds coincide must NOT draw
+    identical jitter: each call site's purpose constant namespaces its
+    stream, while any single schedule stays exactly reproducible."""
+    from fedml_tpu.comm.base import (
+        BACKOFF_PURPOSE_DECODE_RETRY, BACKOFF_PURPOSE_RECONNECT,
+        BACKOFF_PURPOSE_STATUS_PROBE, backoff_delay,
+    )
+
+    kw = dict(base=0.2, cap=2.0, seed=0)
+    decode = [backoff_delay(a, purpose=BACKOFF_PURPOSE_DECODE_RETRY, **kw)
+              for a in range(8)]
+    reconnect = [backoff_delay(a, purpose=BACKOFF_PURPOSE_RECONNECT, **kw)
+                 for a in range(8)]
+    probe = [backoff_delay(a, purpose=BACKOFF_PURPOSE_STATUS_PROBE, **kw)
+             for a in range(8)]
+    # deterministic per stream
+    assert decode == [backoff_delay(a, purpose=BACKOFF_PURPOSE_DECODE_RETRY,
+                                    **kw) for a in range(8)]
+    # the streams are namespaced apart despite the identical seed
+    assert decode != reconnect and decode != probe and reconnect != probe
+    # the jitter envelope is unchanged: [0.5, 1.0) of the capped exponential
+    for sched in (decode, reconnect, probe):
+        for a, d in enumerate(sched):
+            raw = min(2.0, 0.2 * 2 ** a)
+            assert 0.5 * raw <= d < raw
+
+
+# ---------------------------------------------------------------------------
+# client-kill soak (in-proc, real clients) + multiproc SIGKILL soak (slow)
+# ---------------------------------------------------------------------------
+
+def test_client_kill_soak_resumes_and_accounts(eight_devices):
+    from fedml_tpu.cross_silo.async_soak import run_client_kill_soak
+
+    res = run_client_kill_soak(
+        n_clients=4, versions=4, buffer_k=2, concurrency=2,
+        kill_marks=((2, 1),), redispatch_timeout_s=1.0, seed=0,
+        timeout_s=180.0)
+    assert res["versions"] == 4, res
+    assert res["kills"] == 1, res
+    assert res["resumed_from_journal"] == 1, res
+    assert res["unaccounted"] == 0, res
+    assert res["peak_buffered_updates"] <= 2, res
+    assert res["clients_finished"] == 4, res
+
+
+@pytest.mark.slow
+def test_multiproc_sigkill_soak():
+    """The acceptance soak (ISSUE 13): REAL OS processes over TCP, the
+    server and >= 2 clients SIGKILLed mid-run, every party journal-recovered
+    and the run driven to completion with the extended accounting identity.
+    Out of tier-1 (slow): interpreter restarts alone cost ~30s."""
+    from fedml_tpu.cross_silo.async_soak import run_multiproc_kill_soak
+
+    res = run_multiproc_kill_soak()
+    assert res["completed"], res
+    assert res["versions"] == 160, res
+    assert res["server_kills"] == 1, res
+    assert res["client_kills"] == 2, res
+    assert res["monotone"], res
+    assert res["session_epoch"] >= 1, res
+    assert res["unaccounted"] == 0, res
+    assert (res["resumed_from_journal"] + res["cold_rejoins"]
+            == res["client_kills"]), res

@@ -457,10 +457,10 @@ def test_kill_recover_soak_invariants(eight_devices):
 # ---------------------------------------------------------------------------
 
 def test_default_path_wire_and_manager_identical(eight_devices):
-    """Flags unset: no chaos wrapper, no journal object, and NOT ONE dispatch
-    carries the session-epoch key — the control JSON is byte-identical to
-    the pre-ISSUE-10 protocol (same discipline as comm_compression /
-    async_aggregation)."""
+    """Flags unset: no chaos wrapper, no journal object (server OR client),
+    and NOT ONE frame carries the session-epoch or upload-key headers — the
+    control JSON is byte-identical to the pre-ISSUE-10/13 protocol (same
+    discipline as comm_compression / async_aggregation)."""
     import json as _json
 
     from fedml_tpu.comm.inproc import InProcCommManager, InProcRouter
@@ -490,6 +490,7 @@ def test_default_path_wire_and_manager_identical(eight_devices):
         c.run_in_thread()
     server = build_server(cfg, ds, model, backend="INPROC")
     assert server.journal is None
+    assert all(c.client_journal is None for c in clients)
     assert type(server.com_manager) is InProcCommManager  # no chaos wrapper
     try:
         server.run_until_done(timeout=120.0)
@@ -501,6 +502,7 @@ def test_default_path_wire_and_manager_identical(eight_devices):
         clen = int.from_bytes(data[:4], "little")
         control = _json.loads(bytes(data[4:4 + clen]).decode())
         assert md.MSG_ARG_KEY_SESSION_EPOCH not in control
+        assert md.MSG_ARG_KEY_UPLOAD_KEY not in control
 
 
 # ---------------------------------------------------------------------------

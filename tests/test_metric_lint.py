@@ -24,6 +24,8 @@ from fedml_tpu.analysis.rules.gl005_metrics import (
 INSTRUMENTED_MODULES = [
     "fedml_tpu.comm.base",
     "fedml_tpu.comm.codecs",
+    "fedml_tpu.cross_silo.client_journal",
+    "fedml_tpu.cross_silo.journal",
     "fedml_tpu.cross_silo.server",
     "fedml_tpu.obs.health",
     "fedml_tpu.obs.otlp",
