@@ -162,11 +162,16 @@ def mesh_signature(mesh: Any) -> Optional[dict]:
 _VOLATILE_CFG_KEYS = {
     "run_id", "metrics_jsonl_path", "obs_jsonl_path", "otlp_endpoint",
     "metrics_port", "aot_programs", "aot_programs_dir", "population_store",
-    "checkpoint_dir", "server_journal_dir", "model_publish_dir",
-    "global_model_file_path", "grpc_base_port",
+    "checkpoint_dir", "server_journal_dir", "client_journal_dir",
+    "model_publish_dir", "global_model_file_path", "grpc_base_port",
     "tcp_base_port", "grpc_ip_config", "tcp_ip_config", "mqtt_host",
     "mqtt_port", "object_store_url", "coordinator_address", "process_id",
     "num_processes",
+    # multi-tenant identity/scheduling knobs (ISSUE 14): two tenants whose
+    # recipes differ only in job id / fair-share policy trace the SAME
+    # programs — stripping these is what makes the shared store a cross-job
+    # warm start instead of N cold ones
+    "mt_job_id", "mt_weight", "mt_priority", "mt_slots", "mt_shared_aot_dir",
 }
 
 

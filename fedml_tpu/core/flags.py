@@ -243,6 +243,11 @@ FLAGS: dict[str, FlagSpec] = _specs(
     FlagSpec("client_journal_keep", "int", 2,
              "Client-journal snapshots retained per client (older steps are "
              "pruned)."),
+    FlagSpec("client_journal_keep_retired", "int", 8,
+             "Per-rank journal directories of RETIRED clients (ranks no "
+             "longer in the live set) kept under client_journal_dir; older "
+             "retired dirs are reclaimed at run finish — live ranks are "
+             "never pruned."),
     FlagSpec("straggler_timeout_s", "float", 0.0,
              "Bounded-wait straggler deadline per round; 0 = wait forever."),
     FlagSpec("straggler_quorum_frac", "float", 0.5,
@@ -300,6 +305,31 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "jax.distributed process count ($JAX_NUM_PROCESSES fallback)."),
     FlagSpec("process_id", "int", None,
              "jax.distributed process id ($JAX_PROCESS_ID fallback)."),
+    # -- multi-tenant control plane (fedml_tpu/sched/multi_tenant.py) ---------
+    FlagSpec("mt_job_id", "str", None,
+             "Tenant job id under a multi-tenant control plane: namespaces "
+             "the job's run_id, journal roots (<journal_root>/job_<id>/), "
+             "and metric label (job=<id>); unset = single-job run, every "
+             "path bit-identical to before the flag existed."),
+    FlagSpec("mt_weight", "float", 1.0,
+             "Fair-share weight of this tenant's job: the gang scheduler "
+             "charges each granted round's measured wall time / weight to "
+             "the job's virtual clock, so a weight-2 job receives ~2x the "
+             "mesh time of a weight-1 sibling."),
+    FlagSpec("mt_priority", "int", 0,
+             "Strict priority class of this tenant's job: higher classes "
+             "win every round-boundary grant over lower ones (preemption "
+             "is at round boundaries only — a running round is never "
+             "aborted); fair share applies within a class."),
+    FlagSpec("mt_slots", "int", 1,
+             "Concurrent mesh slots the multi-tenant gang scheduler grants: "
+             "how many tenants' (virtual) rounds may run on the shared "
+             "mesh/host pool at once."),
+    FlagSpec("mt_shared_aot_dir", "str", None,
+             "Shared AOT program-store root for all tenants of one control "
+             "plane: jobs with the same tracing fingerprint deserialize "
+             "each other's exported round/eval programs instead of "
+             "recompiling (unset = per-config aot_programs_dir semantics)."),
     # -- serving -------------------------------------------------------------
     FlagSpec("model_publish_dir", "str", None,
              "Continuous model publication directory: the cross-silo servers "

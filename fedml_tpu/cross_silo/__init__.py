@@ -40,7 +40,12 @@ def build_aggregator(cfg, dataset, model, trust=None) -> FedMLAggregator:
     return FedMLAggregator(cfg, model, sample_x, test_arrays, trust=trust)
 
 
-def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None) -> FedMLServerManager:
+def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None,
+                 runtime=None) -> FedMLServerManager:
+    """``runtime`` (cross_silo/runtime.py ServerRuntime): the multi-tenant
+    control plane passes its shared timer-wheel/dispatch loop so N tenant
+    servers ride one thread; None = the manager owns its own (the
+    single-job default, semantics unchanged)."""
     aggregator = build_aggregator(cfg, dataset, model, trust=trust)
     if cfg_extra(cfg, "async_aggregation"):
         # buffered-async (FedBuff-style) server: clients upload whenever
@@ -49,8 +54,9 @@ def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None)
         # synchronous manager, bit-identical to before the flag existed.
         from .async_server import AsyncFedMLServerManager
 
-        return AsyncFedMLServerManager(cfg, aggregator, backend=backend)
-    return FedMLServerManager(cfg, aggregator, backend=backend)
+        return AsyncFedMLServerManager(cfg, aggregator, backend=backend,
+                                       runtime=runtime)
+    return FedMLServerManager(cfg, aggregator, backend=backend, runtime=runtime)
 
 
 def build_client(cfg, dataset, model, rank: int, backend: Optional[str] = None) -> ClientMasterManager:

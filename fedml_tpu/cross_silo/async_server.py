@@ -118,8 +118,9 @@ class AsyncFedMLServerManager(FedMLServerManager):
     _journal_recover_deferred = True
 
     def __init__(self, cfg, aggregator: FedMLAggregator, backend: Optional[str] = None,
-                 logger: Optional[MetricsLogger] = None):
-        super().__init__(cfg, aggregator, backend=backend, logger=logger)
+                 logger: Optional[MetricsLogger] = None, runtime=None):
+        super().__init__(cfg, aggregator, backend=backend, logger=logger,
+                         runtime=runtime)
         # re-bound (construction-time, before any receive/timer thread
         # exists) so this class's own body declares the guarded state for
         # the GL004 lock-discipline scan
@@ -140,7 +141,11 @@ class AsyncFedMLServerManager(FedMLServerManager):
         self._arrivals_in_round = 0
         self._round_staleness: list[int] = []
         self._finished = False
-        self._watchdog: Optional[threading.Timer] = None
+        #: gang-gated dispatch (sched/multi_tenant.py): True while this job
+        #: holds the mesh slot — new work dispatches only then; arrivals
+        #: from the previous wave keep folding regardless.  Always False on
+        #: the single-job path (round_gate None short-circuits every check).
+        self._has_slot = False
         # soak/bench accounting (all guarded by _agg_lock)
         self.total_arrivals = 0
         self.timeout_redispatches = 0
@@ -197,7 +202,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 for cid, ver in self._recovered_outstanding.items():
                     self._outstanding.setdefault(cid, (ver, now))
                 self._recovered_outstanding = {}
-            self._refill()
+            if self.round_gate is None:
+                self._refill()
+            else:
+                self.round_gate.request(self, self._granted_wave)
             self._arm_watchdog()
 
     def handle_message_receive_model(self, msg: Message) -> None:
@@ -279,7 +287,8 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 THROTTLED.inc()
             if self._arrivals_in_round >= self.buffer_k:
                 self._close_virtual_round()
-            if not throttled and not self._finished:
+            if (not throttled and not self._finished
+                    and (self.round_gate is None or self._has_slot)):
                 self._dispatch(self._next_client(fallback=sender))
                 REDISPATCHES.inc(reason="upload")
 
@@ -323,10 +332,25 @@ class AsyncFedMLServerManager(FedMLServerManager):
         if self.server_version >= self.comm_round:
             self._finished = True
             self.finished_monotonic = time.monotonic()
+            if self.round_gate is not None and self._has_slot:
+                self._has_slot = False
+                self.round_gate.release(self)
             self.send_finish()
             return
         self._round_span = obstrace.Span(
             "round", round_idx=self.server_version, async_mode=True)
+        if self.round_gate is not None:
+            # virtual-round boundary: hand the mesh slot back and get back
+            # in line — in-flight uploads keep folding while a sibling
+            # tenant holds the mesh, so the network tail still overlaps.
+            # (A K-arrival close can land BETWEEN release and the next
+            # grant: release is a no-op then and request() replaces the
+            # pending callback — the scheduler stays single-entry per job.)
+            if self._has_slot:
+                self._has_slot = False
+                self.round_gate.release(self)
+            self.round_gate.request(self, self._granted_wave)
+            return
         # throttled clients re-enter on the fresh version (deprioritized,
         # never dropped)
         for cid in sorted(self._throttled):
@@ -334,6 +358,21 @@ class AsyncFedMLServerManager(FedMLServerManager):
             REDISPATCHES.inc(reason="round")
         self._throttled.clear()
         self._refill()
+
+    def _granted_wave(self) -> None:
+        """Gang-scheduler grant: dispatch this virtual round's wave —
+        throttled re-entries first (deprioritized, never dropped), then
+        refill to concurrency.  Runs on the control plane's runtime loop."""
+        with self._agg_lock:
+            if self._finished or self.done.is_set():
+                self.round_gate.release(self)
+                return
+            self._has_slot = True
+            for cid in sorted(self._throttled):
+                self._dispatch(cid)
+                REDISPATCHES.inc(reason="round")
+            self._throttled.clear()
+            self._refill()
 
     # -- dispatch ------------------------------------------------------------
     def _dispatch(self, cid: int) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: every dispatch site is a lock-held handler/timer body)
@@ -385,6 +424,8 @@ class AsyncFedMLServerManager(FedMLServerManager):
 
     def _refill(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock)
         """Top the in-flight set back up to ``concurrency``."""
+        if self.round_gate is not None and not self._has_slot:
+            return  # between release and grant: no new work off-slot
         need = self.concurrency - len(self._outstanding)
         for _ in range(max(0, need)):
             cid = self._next_client(fallback=-1)
@@ -396,29 +437,31 @@ class AsyncFedMLServerManager(FedMLServerManager):
     def _arm_watchdog(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock)
         if self.redispatch_timeout <= 0:
             return
-        t = threading.Timer(max(0.05, min(1.0, self.redispatch_timeout / 4)),
-                            self._on_watchdog)
-        t.daemon = True
-        self._watchdog = t
-        t.start()
+        self._runtime.arm(self, "watchdog",
+                          max(0.05, min(1.0, self.redispatch_timeout / 4)),
+                          self._on_watchdog)
 
     def _on_watchdog(self) -> None:
         with self._agg_lock:
             if self._finished or self.done.is_set():
                 return
-            now = time.monotonic()
-            overdue = [cid for cid, (_v, t0) in self._outstanding.items()
-                       if now - t0 > self.redispatch_timeout]
-            for cid in overdue:
-                self._outstanding.pop(cid, None)
-                self._sent_at.pop(cid, None)
-                # the breach is remembered: behind health_aware_selection the
-                # repeat offender is throttled out of the hot rotation
-                self.health.record_deadline_breach(cid)
-                self.timeout_redispatches += 1
-                REDISPATCHES.inc(reason="timeout")
-                self._dispatch(self._next_client(fallback=cid))
-            self._refill()
+            if self.round_gate is None or self._has_slot:
+                # off-slot, overdue dispatches stay TRACKED (the accounting
+                # identity counts them in-flight) and re-issue at the next
+                # grant instead of dispatching while a sibling holds the mesh
+                now = time.monotonic()
+                overdue = [cid for cid, (_v, t0) in self._outstanding.items()
+                           if now - t0 > self.redispatch_timeout]
+                for cid in overdue:
+                    self._outstanding.pop(cid, None)
+                    self._sent_at.pop(cid, None)
+                    # the breach is remembered: behind health_aware_selection
+                    # the repeat offender is throttled out of the hot rotation
+                    self.health.record_deadline_breach(cid)
+                    self.timeout_redispatches += 1
+                    REDISPATCHES.inc(reason="timeout")
+                    self._dispatch(self._next_client(fallback=cid))
+                self._refill()
             self._arm_watchdog()
 
     # -- recovery journal ------------------------------------------------------
@@ -478,12 +521,8 @@ class AsyncFedMLServerManager(FedMLServerManager):
         }
 
     # -- teardown ------------------------------------------------------------
-    def finish(self) -> None:  # graftlint: disable=GL004(single boolean latch + timer handle; runs under _agg_lock when reached via send_finish, bare on the timeout path — both orders are safe because _finished only ever flips False->True),GL008(same invariant: taking _agg_lock here would self-deadlock on the send_finish path, and the worst bare-path outcome is one extra watchdog fire that re-checks _finished under the lock and exits)
+    def finish(self) -> None:  # graftlint: disable=GL004(single boolean latch; runs under _agg_lock when reached via send_finish, bare on the timeout path — both orders are safe because _finished only ever flips False->True),GL008(same invariant: taking _agg_lock here would self-deadlock on the send_finish path, and the worst bare-path outcome is one extra watchdog fire that re-checks _finished under the lock and exits)
         self._finished = True
-        w = self._watchdog
-        self._watchdog = None
-        if w is not None:
-            w.cancel()
         super().finish()
 
     def hard_kill(self) -> None:  # graftlint: disable=GL004(crash simulation: deliberately lock-free — a SIGKILL takes no locks either; every surviving thread re-checks state under _agg_lock and exits),GL008(same invariant)
@@ -493,11 +532,7 @@ class AsyncFedMLServerManager(FedMLServerManager):
         journal is lost, exactly like a SIGKILL; only the process (which a
         real SIGKILL would reclaim) stays alive for the test to inspect."""
         self._finished = True
-        for timer in (self._watchdog, self._status_timer):
-            if timer is not None:
-                timer.cancel()
-        self._watchdog = None
-        self._status_timer = None
+        self._runtime.cancel(self)
         self.com_manager.stop_receive_message()
 
     # -- accounting (soak harness / bench) ------------------------------------

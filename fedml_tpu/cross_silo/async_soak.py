@@ -178,6 +178,8 @@ class _SimulatedFleet:
         self.seed = int(seed)
         self.drops_injected = 0
         self.replies_sent = 0
+        #: the upload-leg ChaosCommManager when attach_sim_fleet wired one
+        self.upload_chaos = None
         self._nonce = 0
         self._lock = threading.Lock()
         self._heap: list = []
@@ -291,6 +293,39 @@ class _SimulatedFleet:
             self.replies_sent += 1
 
 
+def attach_sim_fleet(server, *, drop_prob: float = 0.0,
+                     latency_mean_s: float = 0.003, latency_sigma: float = 1.0,
+                     seed: int = 0, workers: int = 4,
+                     upload_chaos: Optional[dict] = None,
+                     upload_keys: bool = False):
+    """Swap an already-built in-proc server's fabric for the fan-in
+    simulated fleet and start it; returns ``(fleet, shared_queue)`` —
+    ``fleet.stop(shared_queue)`` tears it down.  Shared by :func:`run_soak`
+    and the multi-tenant control plane's fleet-scale jobs (ISSUE 14), so
+    both drive the identical simulated-client machinery."""
+    import jax
+
+    from ..comm.inproc import InProcRouter
+    from . import message_define as md
+
+    run_id = str(getattr(server.cfg, "run_id", "0"))
+    router = InProcRouter.get(run_id)
+    shared: "queue.Queue" = queue.Queue()
+    # swap in the fan-in fabric AFTER the server bound its rank-0 inbox
+    router.queues = _FanInQueues(shared, router.queues[0])
+    template = jax.device_get(server.aggregator.global_vars)
+    sender = chaos_wrapper = None
+    if upload_chaos:
+        sender, chaos_wrapper = _upload_chaos_sender(router, upload_chaos, seed)
+    fleet = _SimulatedFleet(
+        router, md, template, drop_prob=drop_prob,
+        latency_mean_s=latency_mean_s, latency_sigma=latency_sigma,
+        seed=seed, workers=workers, sender=sender, upload_keys=upload_keys)
+    fleet.upload_chaos = chaos_wrapper
+    fleet.start(shared)
+    return fleet, shared
+
+
 def _soak_config(run_id: str, n_clients: int, concurrency: int, buffer_k: int,
                  versions: int, staleness_exponent: float,
                  redispatch_timeout_s: float, extra_flags: Optional[dict] = None):
@@ -358,20 +393,11 @@ def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64
 
     InProcRouter.reset(run_id)
     server = build_server(cfg, ds, model, backend="INPROC")
-    router = InProcRouter.get(run_id)
-    shared: queue.Queue = queue.Queue()
-    # swap in the fan-in fabric AFTER the server bound its rank-0 inbox
-    router.queues = _FanInQueues(shared, router.queues[0])
-
-    template = jax.device_get(server.aggregator.global_vars)
-    fleet = _SimulatedFleet(
-        router, md, template, drop_prob=drop_prob,
-        latency_mean_s=latency_mean_s, latency_sigma=latency_sigma,
-        seed=seed, workers=workers)
-
     fold_lag_base = _hist_counts(FOLD_LAG)
     stal_base = _hist_counts(STALENESS)
-    fleet.start(shared)
+    fleet, shared = attach_sim_fleet(
+        server, drop_prob=drop_prob, latency_mean_s=latency_mean_s,
+        latency_sigma=latency_sigma, seed=seed, workers=workers)
     t0 = time.monotonic()
     server.run_in_thread()
     server.start()
@@ -906,6 +932,7 @@ def run_multiproc_kill_soak(n_clients: int = 3, versions: int = 160,
                             client_kills: tuple = ((1, 20), (2, 45)),
                             journal_every_rounds: int = 5,
                             redispatch_timeout_s: float = 1.0, seed: int = 0,
+                            chaos: Optional[dict] = None,
                             timeout_s: float = 420.0) -> dict:
     """REAL OS processes, REAL SIGKILLs (ISSUE 13): one buffered-async
     server process + ``n_clients`` real client processes over the TCP
@@ -929,6 +956,19 @@ def run_multiproc_kill_soak(n_clients: int = 3, versions: int = 160,
     resume or a cold rejoin (``unaccounted`` == 0); and no upload folds
     twice — crash-resent duplicates reconcile as the server's ``deduped``
     counter, enforced by the journaled idempotence-key table.
+
+    ``chaos`` (ISSUE 14 satellite, the ROADMAP carried-over item) threads
+    ``chaos_*`` flags into EVERY worker's cfg: each real process's TCP
+    backend wraps itself in its own seeded :class:`ChaosCommManager`
+    (FedMLCommManager does this from the flags), so seeded drop/delay/
+    duplicate/corrupt faults ride the same run as the genuine SIGKILLs on
+    both protocol legs.  The accounting identity is unchanged and still
+    must close: chaos losses are recovered by the redispatch watchdog and
+    reconnect backoff, duplicates reconcile as journaled-key dedups, and
+    every client kill still comes back as exactly one journal resume
+    (``unaccounted == 0``).  The server worker reports its wrapper's
+    injected-fault counters in ``server_summary.json`` (the ``chaos`` key
+    of the result).
 
     Sizing note: rounds are CHEAP (tiny lr model, warm compile cache) while
     a SIGKILL restart costs a full interpreter boot (~5-10s), so the run
@@ -972,6 +1012,10 @@ def run_multiproc_kill_soak(n_clients: int = 3, versions: int = 160,
                 "client_journal_dir": os.path.join(workdir, "client_journal"),
                 "comm_compression": "topk", "comm_compress_min_size": 64,
                 "tcp_base_port": base_port,
+                # seeded fault schedule on the REAL transport (ISSUE 14):
+                # every worker process wraps its TCP backend from these
+                # flags, so chaos and genuine SIGKILLs compose in one run
+                **({"chaos_seed": seed, **chaos} if chaos else {}),
             },
         }, f)
 
@@ -1082,6 +1126,7 @@ def run_multiproc_kill_soak(n_clients: int = 3, versions: int = 160,
             "rejected_stale": summary["rejected_stale"],
             "timeout_redispatches": summary["timeout_redispatches"],
             "clients_finished": clients_finished,
+            "chaos": summary.get("chaos"),
         }
     finally:
         for p in procs.values():

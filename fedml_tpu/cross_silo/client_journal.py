@@ -56,7 +56,8 @@ from .journal import ServerJournal
 log = logging.getLogger("fedml_tpu.cross_silo.client_journal")
 
 __all__ = ["ClientJournal", "client_journal_from_config",
-           "pack_client_state", "unpack_client_state"]
+           "pack_client_state", "unpack_client_state",
+           "prune_retired_client_dirs"]
 
 CLIENT_RESUMES = obsreg.REGISTRY.counter(
     "fedml_client_journal_resumes_total",
@@ -165,6 +166,52 @@ def unpack_client_state(snap: dict) -> dict:
         "residuals": residuals,
         "trainer_state": trainer_state,
     }
+
+
+def prune_retired_client_dirs(root: str, live_ranks, keep: int = 8) -> list[int]:
+    """Reclaim per-rank journal directories of long-RETIRED clients
+    (ISSUE 14 satellite: before this, ``client_journal_dir`` grew one
+    ``client_<rank>`` directory per rank ever seen and nothing ever deleted
+    them — a fleet that cycles through ephemeral ranks leaks disk forever).
+
+    A rank is *retired* when it is not in ``live_ranks``; the newest
+    ``keep`` retired directories (by most recent journal-step mtime, so a
+    recently crashed-but-replaceable client keeps its resume state) are
+    kept and every older one is removed.  Live ranks are NEVER touched,
+    whatever ``keep`` says.  Returns the pruned rank list."""
+    import re
+    import shutil
+
+    live = {int(r) for r in live_ranks}
+    retired: list[tuple[float, int, str]] = []
+    try:
+        names = os.listdir(str(root))
+    except OSError:
+        return []
+    for name in names:
+        m = re.fullmatch(r"client_(\d+)", name)
+        if not m or int(m.group(1)) in live:
+            continue
+        path = os.path.join(str(root), name)
+        try:
+            mtimes = [os.path.getmtime(os.path.join(path, f))
+                      for f in os.listdir(path)] or [os.path.getmtime(path)]
+        except OSError:
+            continue
+        retired.append((max(mtimes), int(m.group(1)), path))
+    retired.sort(reverse=True)  # newest first
+    pruned: list[int] = []
+    for _mtime, rank, path in retired[max(0, int(keep)):]:
+        try:
+            shutil.rmtree(path)
+            pruned.append(rank)
+        except OSError as e:
+            log.warning("client journal: could not prune retired rank %d "
+                        "(%s)", rank, e)
+    if pruned:
+        log.info("client journal: pruned %d retired rank dir(s) under %s",
+                 len(pruned), root)
+    return pruned
 
 
 def client_journal_from_config(cfg: Any, rank: int) -> Optional[ClientJournal]:

@@ -200,8 +200,7 @@ class LSAServerManager(FedMLServerManager):
         set and ask those survivors for their aggregate encoded masks
         (reference ``send_message_to_active_client`` :277). Caller holds
         _agg_lock."""
-        if self._round_timer is not None:
-            self._round_timer.cancel()
+        self._runtime.cancel(self, "straggler")
         self._phase = "mask"
         self.active_first = sorted(self.aggregator.model_dict.keys())
         for cid in self.active_first:

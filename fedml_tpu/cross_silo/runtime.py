@@ -1,0 +1,375 @@
+"""Event-driven server runtime — one dispatch loop + timer wheel (ISSUE 14).
+
+Before this module, every server manager hand-rolled its own thread soup:
+the sync server spawned a fresh ``threading.Timer`` per straggler deadline
+AND per status re-probe, the buffered-async server added a third family for
+its redispatch watchdog, and each timer callback was its own short-lived
+thread racing the receive loop for ``_agg_lock``.  That shape is why the
+GL007/GL008 concurrency lint grew a suppression list: timer *handles* were
+shared mutable state written from three thread roots.
+
+:class:`ServerRuntime` replaces all of it with ONE daemon thread per
+runtime (started lazily — a server that never arms a timer never pays for
+the thread):
+
+- a **timer wheel**: ``arm(owner, name, delay, fn)`` schedules ``fn`` on
+  the wheel; re-arming the same ``(owner, name)`` atomically supersedes the
+  previous entry (the cancel+create dance the managers used to do with raw
+  Timer handles), and ``cancel(owner)`` drops everything an owner scheduled
+  — so managers no longer store timer handles at all, which is what lets
+  their GL008 suppressions be *deleted* instead of grown;
+- a **dispatch loop**: ``post(fn)`` runs ``fn`` on the same thread, the
+  hook the multi-tenant gang scheduler uses to run round-grant callbacks
+  off every server's receive loop.
+
+Callbacks run OUTSIDE the runtime's internal lock (a callback that takes a
+server's ``_agg_lock`` never creates a runtime-lock -> agg-lock edge), and
+one runtime can serve MANY managers: the multi-tenant control plane
+(``sched/multi_tenant.py``) passes one shared runtime to every tenant's
+server, collapsing N per-job thread soups into a single loop.  A manager
+constructed without a runtime builds (and owns) its own — the single-job
+path keeps exactly one extra thread, timer semantics unchanged.
+
+:class:`GangScheduler` is the round-boundary arbiter the control plane
+builds on top: N jobs request the mesh slot when they are ready to start a
+(virtual) round, the scheduler grants ``slots`` of them by strict priority
+then weighted fair share (virtual time += measured hold / weight), and
+grant callbacks are ``post()``-ed to the runtime so they never run under
+the scheduler's lock.  Preemption is at round boundaries by construction:
+a higher-priority job never aborts a running round, it simply wins every
+subsequent grant until it finishes (each pass-over of an otherwise-next
+job is metered as a preemption).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..obs import registry as obsreg
+
+log = logging.getLogger("fedml_tpu.cross_silo.runtime")
+
+__all__ = ["ServerRuntime", "GangScheduler"]
+
+TIMER_FIRES = obsreg.REGISTRY.counter(
+    "fedml_runtime_timer_fires_total",
+    "Timer-wheel callbacks executed by the event-driven server runtime.",
+)
+POSTED_CALLBACKS = obsreg.REGISTRY.counter(
+    "fedml_runtime_posted_total",
+    "Callbacks posted onto the runtime's dispatch loop (gang-scheduler "
+    "grants, deferred work).",
+)
+SLOT_GRANTS = obsreg.REGISTRY.counter(
+    "fedml_mt_slot_grants_total",
+    "Mesh-slot grants issued by the gang scheduler, by job.",
+    labels=("job",),
+)
+SLOT_WAIT = obsreg.REGISTRY.histogram(
+    "fedml_mt_slot_wait_seconds",
+    "Round-boundary wait between a job's slot request and its grant, by job.",
+    labels=("job",),
+)
+SLOT_HOLD = obsreg.REGISTRY.histogram(
+    "fedml_mt_round_hold_seconds",
+    "Mesh-slot hold time of one granted (virtual) round, by job — the "
+    "per-tenant round latency under gang scheduling.",
+    labels=("job",),
+)
+PREEMPTIONS = obsreg.REGISTRY.counter(
+    "fedml_mt_preemptions_total",
+    "Round-boundary preemptions: grants where a higher-priority job was "
+    "chosen over the fair-share (lowest-virtual-time) candidate, by the "
+    "job that was passed over.",
+    labels=("job",),
+)
+
+
+class ServerRuntime:
+    """One daemon thread driving a timer wheel + posted-callback queue.
+
+    Thread model (GL008-audited): every mutable structure below is touched
+    only under ``_cond`` (its lock); callbacks are dequeued under the lock
+    and invoked outside it on the loop thread.  A callback exception is
+    logged and contained — one bad timer must not kill every tenant's
+    timers.  The loop thread starts lazily at the first ``arm``/``post``.
+    """
+
+    def __init__(self, name: str = "fedml-server-runtime"):
+        self.name = name
+        self._cond = threading.Condition()
+        #: min-heap of (due_monotonic, seq) — entries resolve through
+        #: _timers so a superseded/cancelled heap entry is skipped cheaply
+        self._heap: list[tuple[float, int]] = []
+        #: (owner-id, name) -> (seq, due, fn); seq identifies the live entry
+        self._timers: dict[tuple[int, str], tuple[int, float, Callable]] = {}
+        self._by_seq: dict[int, tuple[int, str]] = {}
+        self._posted: list[Callable] = []
+        self._seq = itertools.count(1)
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- scheduling interface -------------------------------------------------
+    def arm(self, owner: object, name: str, delay_s: float, fn: Callable) -> None:
+        """Schedule ``fn`` after ``delay_s``; supersedes any previous timer
+        armed under the same ``(owner, name)`` (the old entry never fires)."""
+        key = (id(owner), str(name))
+        due = time.monotonic() + max(0.0, float(delay_s))
+        with self._cond:
+            if self._closed:
+                return
+            old = self._timers.pop(key, None)
+            if old is not None:
+                self._by_seq.pop(old[0], None)
+            seq = next(self._seq)
+            self._timers[key] = (seq, due, fn)
+            self._by_seq[seq] = key
+            heapq.heappush(self._heap, (due, seq))
+            self._ensure_thread()
+            self._cond.notify()
+
+    def cancel(self, owner: object, name: Optional[str] = None) -> None:
+        """Cancel one named timer, or every timer of ``owner`` when ``name``
+        is None.  A callback already dequeued keeps running (exactly the
+        ``threading.Timer.cancel`` race the managers always had)."""
+        oid = id(owner)
+        with self._cond:
+            keys = ([(oid, str(name))] if name is not None
+                    else [k for k in self._timers if k[0] == oid])
+            for key in keys:
+                entry = self._timers.pop(key, None)
+                if entry is not None:
+                    self._by_seq.pop(entry[0], None)
+
+    def post(self, fn: Callable) -> None:
+        """Run ``fn`` as soon as possible on the loop thread (FIFO)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._posted.append(fn)
+            POSTED_CALLBACKS.inc()
+            self._ensure_thread()
+            self._cond.notify()
+
+    def close(self) -> None:
+        """Stop the loop thread and drop every pending timer/callback.
+        Idempotent; safe to call from a callback (the loop notices the flag
+        on its next iteration)."""
+        with self._cond:
+            self._closed = True
+            self._timers.clear()
+            self._by_seq.clear()
+            self._heap.clear()
+            self._posted.clear()
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+
+    # -- loop -----------------------------------------------------------------
+    def _ensure_thread(self) -> None:  # graftlint: disable=GL004(caller holds _cond: both arm() and post() call this under the lock)
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name=self.name, daemon=True)
+            self._thread.start()
+
+    def _next_work(self) -> tuple[Optional[Callable], bool]:
+        """(callback-or-None, closed) — one bounded wait for due work.
+        Posted callbacks run before due timers (grants must not starve
+        behind a busy wheel)."""
+        with self._cond:
+            if self._closed:
+                return None, True
+            if self._posted:
+                return self._posted.pop(0), False
+            now = time.monotonic()
+            while self._heap and self._heap[0][0] <= now:
+                _due, seq = heapq.heappop(self._heap)
+                key = self._by_seq.pop(seq, None)
+                if key is None:
+                    continue  # superseded or cancelled
+                entry = self._timers.pop(key, None)
+                if entry is None or entry[0] != seq:
+                    continue
+                TIMER_FIRES.inc()
+                return entry[2], False
+            timeout = 0.2
+            if self._heap:
+                timeout = min(timeout, max(0.0, self._heap[0][0] - now))
+            self._cond.wait(timeout=max(0.001, timeout))
+            return None, self._closed
+
+    def _loop(self) -> None:
+        while True:
+            fn, closed = self._next_work()
+            if closed:
+                return
+            if fn is None:
+                continue
+            try:
+                fn()
+            except Exception:
+                # contain: one tenant's bad callback must not kill the
+                # shared wheel (same invariant as the receive loop's
+                # handler guard)
+                log.exception("runtime callback failed on %s", self.name)
+
+
+class GangScheduler:
+    """Round-boundary mesh-slot arbiter for N concurrent FL jobs.
+
+    Jobs (server managers) call :meth:`request` when ready to start a
+    (virtual) round and :meth:`release` when the round's aggregate commits.
+    ``slots`` rounds run concurrently; the next grant goes to the highest
+    priority class first, then the lowest virtual time within it
+    (``vtime += hold_seconds / weight`` — weighted fair share over the
+    *measured* round cost, so an expensive tenant does not starve cheap
+    ones at equal weights).  Grant callbacks are posted to the runtime's
+    dispatch loop, never run under this scheduler's lock or the caller's.
+
+    Thread model (GL008-audited): all state below is guarded by ``_lock``;
+    grant callbacks are collected under the lock and posted outside it.
+    """
+
+    def __init__(self, runtime: ServerRuntime, slots: int = 1):
+        self.runtime = runtime
+        self.slots = max(1, int(slots))
+        self._lock = threading.Lock()
+        self._names: dict[int, str] = {}
+        self._weights: dict[int, float] = {}
+        self._priority: dict[int, int] = {}
+        self._vtime: dict[int, float] = {}
+        #: job-id -> (grant callback, enqueue monotonic, arrival seq)
+        self._pending: dict[int, tuple[Callable, float, int]] = {}
+        #: job-id -> grant monotonic of the held slot
+        self._holders: dict[int, float] = {}
+        self._arrival = itertools.count()
+        #: per-job accounting the bench/tests read: grants, waits, holds,
+        #: times this job was passed over by a higher-priority grant
+        self.stats: dict[str, dict] = {}
+
+    def register(self, job: object, name: str, weight: float = 1.0,
+                 priority: int = 0) -> None:
+        with self._lock:
+            jid = id(job)
+            self._names[jid] = str(name)
+            self._weights[jid] = max(1e-6, float(weight))
+            self._priority[jid] = int(priority)
+            # WFQ catch-up: a late-admitted job starts at the busiest
+            # sibling's virtual time instead of replaying the past
+            floor = max(self._vtime.values(), default=0.0)
+            self._vtime[jid] = max(self._vtime.get(jid, 0.0), floor)
+            self.stats.setdefault(self._names[jid], {
+                "grants": 0, "preempted": 0, "wait_s": [], "hold_s": [],
+                "weight": self._weights[jid], "priority": self._priority[jid],
+            })
+
+    def request(self, job: object, grant_cb: Callable) -> None:
+        """Queue ``job`` for the next slot; idempotent per job (a re-request
+        before the grant replaces the callback)."""
+        with self._lock:
+            jid = id(job)
+            if jid not in self._names:
+                # un-registered single-job use: admit with defaults
+                self._register_locked(jid, f"job{jid % 1000}")
+            if jid in self._holders:
+                # already holding (a re-broadcast inside the same round):
+                # run the callback directly on the loop, no second slot
+                self.runtime.post(grant_cb)
+                return
+            prev = self._pending.get(jid)
+            self._pending[jid] = (grant_cb, prev[1] if prev else time.monotonic(),
+                                  prev[2] if prev else next(self._arrival))
+        self._pump()
+
+    def release(self, job: object) -> None:
+        """Release ``job``'s held slot (no-op when it holds none) and charge
+        the measured hold time to its virtual clock."""
+        with self._lock:
+            jid = id(job)
+            t0 = self._holders.pop(jid, None)
+            if t0 is not None:
+                hold = time.monotonic() - t0
+                self._vtime[jid] = self._vtime.get(jid, 0.0) + hold / self._weights.get(jid, 1.0)
+                name = self._names.get(jid, "?")
+                rec = self.stats.setdefault(name, {"grants": 0, "preempted": 0,
+                                                   "wait_s": [], "hold_s": []})
+                rec["hold_s"].append(hold)
+                SLOT_HOLD.observe(hold, job=name)
+        self._pump()
+
+    def _register_locked(self, jid: int, name: str) -> None:  # graftlint: disable=GL004(caller holds _lock)
+        self._names[jid] = name
+        self._weights[jid] = 1.0
+        self._priority[jid] = 0
+        self._vtime[jid] = max(self._vtime.values(), default=0.0)
+        self.stats.setdefault(name, {"grants": 0, "preempted": 0,
+                                     "wait_s": [], "hold_s": []})
+
+    def _pump(self) -> None:
+        """Grant free slots; callbacks post to the runtime OUTSIDE the lock
+        (a grant callback takes its server's _agg_lock — posting under
+        _lock would build the scheduler-lock -> agg-lock edge this design
+        exists to avoid)."""
+        grants: list[Callable] = []
+        with self._lock:
+            while self._pending and len(self._holders) < self.slots:
+                chosen = self._pick_locked()
+                cb, enq, _seq = self._pending.pop(chosen)
+                now = time.monotonic()
+                self._holders[chosen] = now
+                name = self._names.get(chosen, "?")
+                rec = self.stats.setdefault(name, {"grants": 0, "preempted": 0,
+                                                   "wait_s": [], "hold_s": []})
+                rec["grants"] += 1
+                rec["wait_s"].append(now - enq)
+                SLOT_GRANTS.inc(job=name)
+                SLOT_WAIT.observe(now - enq, job=name)
+                grants.append(cb)
+        for cb in grants:
+            self.runtime.post(cb)
+
+    def _pick_locked(self) -> int:  # graftlint: disable=GL004(caller holds _lock: _pump's selection step)
+        """Highest priority class, then lowest virtual time, then arrival
+        order.  When priority overrides fair share, the passed-over job's
+        preemption counter ticks — the boundary-preemption meter."""
+        def fair_key(jid: int):
+            return (self._vtime.get(jid, 0.0), self._pending[jid][2])
+
+        fair = min(self._pending, key=fair_key)
+        chosen = min(self._pending,
+                     key=lambda j: (-self._priority.get(j, 0),) + fair_key(j))
+        if chosen != fair and self._priority.get(chosen, 0) > self._priority.get(fair, 0):
+            name = self._names.get(fair, "?")
+            self.stats.setdefault(name, {"grants": 0, "preempted": 0,
+                                         "wait_s": [], "hold_s": []})
+            self.stats[name]["preempted"] += 1
+            PREEMPTIONS.inc(job=name)
+        return chosen
+
+    # -- introspection --------------------------------------------------------
+    def summary(self) -> dict:
+        """Per-job scheduling accounting (grants, p50/p95 wait + hold)."""
+        import numpy as np
+
+        with self._lock:
+            out = {}
+            for name, rec in self.stats.items():
+                holds = rec["hold_s"]
+                waits = rec["wait_s"]
+                out[name] = {
+                    "grants": rec["grants"],
+                    "preempted": rec["preempted"],
+                    "weight": rec.get("weight", 1.0),
+                    "priority": rec.get("priority", 0),
+                    "hold_p50_s": round(float(np.percentile(holds, 50)), 6) if holds else None,
+                    "hold_p95_s": round(float(np.percentile(holds, 95)), 6) if holds else None,
+                    "wait_p50_s": round(float(np.percentile(waits, 50)), 6) if waits else None,
+                    "wait_p95_s": round(float(np.percentile(waits, 95)), 6) if waits else None,
+                }
+            return out

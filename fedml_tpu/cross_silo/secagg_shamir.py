@@ -325,8 +325,7 @@ class SAServerManager(FedMLServerManager):
     def _request_reveals(self) -> None:
         """Freeze the survivor set, announce it, collect reveals (reference
         ``_send_message_to_active_client`` :313).  Caller holds _agg_lock."""
-        if self._round_timer is not None:
-            self._round_timer.cancel()
+        self._runtime.cancel(self, "straggler")
         self._phase = "reveal"
         self.active_first = sorted(self.aggregator.model_dict.keys())
         for cid in self.active_first:

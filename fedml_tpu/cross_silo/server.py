@@ -512,7 +512,7 @@ class FedMLAggregator:
 
 class FedMLServerManager(FedMLCommManager):
     def __init__(self, cfg, aggregator: FedMLAggregator, backend: Optional[str] = None,
-                 logger: Optional[MetricsLogger] = None):
+                 logger: Optional[MetricsLogger] = None, runtime=None):
         super().__init__(cfg, rank=0, size=cfg.client_num_in_total + 1, backend=backend)
         self.aggregator = aggregator
         self.round_idx = 0
@@ -527,9 +527,20 @@ class FedMLServerManager(FedMLCommManager):
         # bounded-wait straggler handling
         self.straggler_timeout = float(cfg_extra(cfg, "straggler_timeout_s") or 0)
         self.quorum_frac = float(cfg_extra(cfg, "straggler_quorum_frac") or 0.5)
-        self._round_timer: Optional[threading.Timer] = None
-        self._status_timer: Optional[threading.Timer] = None
-        self._status_probe_attempt = 0
+        # event-driven runtime (cross_silo/runtime.py): ONE timer wheel +
+        # dispatch loop replaces the per-deadline threading.Timer threads
+        # (straggler, status re-probe, async watchdog).  The multi-tenant
+        # control plane passes a SHARED runtime so N tenants ride one loop;
+        # a manager built without one owns its own (single extra thread,
+        # started lazily, timer semantics unchanged).
+        from .runtime import ServerRuntime
+
+        self._runtime = runtime if runtime is not None else ServerRuntime()
+        self._owns_runtime = runtime is None
+        # round-boundary gang gate (sched/multi_tenant.py GangScheduler):
+        # None = the single-job path, broadcasts run inline exactly as they
+        # always did — bit-identical by construction
+        self.round_gate = None
         self._agg_lock = threading.Lock()
         self._init_sent = False
         # set by handlers/timers when the run cannot make progress; surfaced
@@ -639,22 +650,22 @@ class FedMLServerManager(FedMLCommManager):
                             "timer retries", cid, exc_info=True)
         self._arm_status_reprobe()
 
-    def _arm_status_reprobe(self) -> None:  # graftlint: disable=GL008(single handle + attempt counter, benign race: finish() cancelling while the timer re-arms costs at most one extra probe, which re-checks _init_sent/done under _agg_lock and exits)
+    def _arm_status_reprobe(self, attempt: int = 0) -> None:
         from ..comm.base import BACKOFF_PURPOSE_STATUS_PROBE, backoff_delay
 
         # capped exponential from a small base (deterministic jitter, its own
         # purpose stream): a probe lost to a flaky wire re-fires in ~100ms, a
-        # genuinely slow fleet is re-probed at a gentle 1s cadence
-        attempt = self._status_probe_attempt
-        self._status_probe_attempt = attempt + 1
-        t = threading.Timer(backoff_delay(attempt, base=0.1, cap=1.0,
-                                          purpose=BACKOFF_PURPOSE_STATUS_PROBE),
-                            self._on_status_reprobe)
-        t.daemon = True
-        self._status_timer = t
-        t.start()
+        # genuinely slow fleet is re-probed at a gentle 1s cadence.  The
+        # attempt counter rides the timer-wheel closure (no shared handle, no
+        # shared counter — the state the old per-Timer shape had to suppress
+        # GL008 over).
+        self._runtime.arm(
+            self, "status_probe",
+            backoff_delay(attempt, base=0.1, cap=1.0,
+                          purpose=BACKOFF_PURPOSE_STATUS_PROBE),
+            lambda: self._on_status_reprobe(attempt))
 
-    def _on_status_reprobe(self) -> None:
+    def _on_status_reprobe(self, attempt: int = 0) -> None:
         """Retry CHECK_CLIENT_STATUS for ranks that never answered (their
         probe or reply was lost on the wire); disarms once the round starts."""
         with self._agg_lock:
@@ -667,7 +678,7 @@ class FedMLServerManager(FedMLCommManager):
             except Exception:
                 log.warning("status re-probe to client %d failed", cid,
                             exc_info=True)
-        self._arm_status_reprobe()
+        self._arm_status_reprobe(attempt + 1)
 
     def handle_message_client_status(self, msg: Message) -> None:
         ready = False
@@ -708,7 +719,27 @@ class FedMLServerManager(FedMLCommManager):
             # bootstrap publication: serving workers can come up on the
             # initial (or journal-recovered) global before round 1 closes
             self._publish_model()
-            self._broadcast_model(md.MSG_TYPE_S2C_INIT_CONFIG)  # graftlint: disable=GL007(round-boundary broadcast: every client is idle until the new global arrives, so the host fetch under _agg_lock serializes nothing that could otherwise progress)
+            self._gated_broadcast(md.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _gated_broadcast(self, msg_type: int) -> None:  # graftlint: disable=GL004(callers hold _agg_lock: send_init_msg and _finish_round),GL007(round-boundary broadcast: every selected client is idle until the new global arrives, so the host fetch under _agg_lock serializes nothing that could otherwise progress)
+        """Start this round's broadcast NOW (single-job path: ``round_gate``
+        is None, the call is exactly the historical inline broadcast), or
+        queue for the gang scheduler's mesh slot and broadcast on grant
+        (multi-tenant path; the grant callback runs on the control plane's
+        shared runtime loop, never on a sibling tenant's thread)."""
+        if self.round_gate is None:
+            self._broadcast_model(msg_type)
+            return
+        self.round_gate.request(self, lambda: self._granted_broadcast(msg_type))
+
+    def _granted_broadcast(self, msg_type: int) -> None:  # graftlint: disable=GL007(grant callback: the round starts here, so the host fetch under _agg_lock serializes nothing — every selected client is idle until this broadcast lands)
+        """Gang-scheduler grant callback: the mesh slot is ours — broadcast
+        the round.  Runs on the runtime's dispatch loop."""
+        with self._agg_lock:
+            if self.done.is_set():
+                self.round_gate.release(self)
+                return
+            self._broadcast_model(msg_type)
 
     def _candidate_ids(self) -> list[int]:
         """The candidate set for this round's selection — subclasses narrow
@@ -786,11 +817,11 @@ class FedMLServerManager(FedMLCommManager):
     def _arm_straggler_timer(self) -> None:
         if self.straggler_timeout <= 0:
             return
-        if self._round_timer is not None:
-            self._round_timer.cancel()
-        self._round_timer = threading.Timer(self.straggler_timeout, self._on_straggler_timeout)
-        self._round_timer.daemon = True
-        self._round_timer.start()
+        # re-arming the same (owner, name) supersedes the previous deadline
+        # atomically on the wheel — the cancel+create dance the raw Timer
+        # handle needed is gone, and so is the handle
+        self._runtime.arm(self, "straggler", self.straggler_timeout,
+                          self._on_straggler_timeout)
 
     def _on_straggler_timeout(self) -> None:
         with self._agg_lock:
@@ -814,8 +845,7 @@ class FedMLServerManager(FedMLCommManager):
     def _finish_round(self) -> None:
         """Aggregate, eval, and either sync the next round or finish.
         Caller holds _agg_lock."""
-        if self._round_timer is not None:
-            self._round_timer.cancel()
+        self._runtime.cancel(self, "straggler")
         received = self.aggregator.received_count()
         with obstrace.traced("aggregate", parent=self._round_span,
                              round_idx=self.round_idx,
@@ -838,10 +868,14 @@ class FedMLServerManager(FedMLCommManager):
         self.round_idx += 1
         self._journal_snapshot()
         self._publish_model()
+        if self.round_gate is not None:
+            # round boundary: the aggregate is committed — give the mesh
+            # slot back so sibling tenants can interleave their rounds
+            self.round_gate.release(self)
         if self.round_idx >= self.comm_round:
             self.send_finish()
             return
-        self._broadcast_model(md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+        self._gated_broadcast(md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
 
     def _close_round_trace(self, *child_spans) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: _finish_round and the async server's _close_virtual_round call this)
         """End the round span, record its duration, and persist the server's
@@ -1021,18 +1055,14 @@ class FedMLServerManager(FedMLCommManager):
             self.round_idx, {**self._journal_protocol_state(), **stream_proto},
             arrays, model_step=self._last_model_step)
 
-    def hard_kill(self) -> None:  # graftlint: disable=GL004(crash simulation: deliberately lock-free — a SIGKILL takes no locks either; every surviving thread re-checks state under _agg_lock and exits),GL008(same invariant)
+    def hard_kill(self) -> None:  # graftlint: disable=GL008(crash simulation: deliberately lock-free — a SIGKILL takes no locks either; every surviving thread re-checks state under _agg_lock and exits)
         """Crash simulation for the chaos harness (sync server): stop the
         receive loop and all timers ABRUPTLY — no FINISH broadcast, no
         journal write, no teardown bookkeeping.  Everything not already
         committed to the journal (including a mid-round partial fold past
         the last fold-cadence snapshot) is lost, exactly like a SIGKILL;
         only the process stays alive for the test to inspect."""
-        for timer in (self._round_timer, self._status_timer):
-            if timer is not None:
-                timer.cancel()
-        self._round_timer = None
-        self._status_timer = None
+        self._runtime.cancel(self)
         self.com_manager.stop_receive_message()
 
     def send_finish(self) -> None:
@@ -1045,16 +1075,34 @@ class FedMLServerManager(FedMLCommManager):
                 # done unset (the run DID complete)
                 log.warning("FINISH to client %d failed", cid, exc_info=True)
         self.done.set()
+        self._prune_retired_client_journals()
         self.finish()
+
+    def _prune_retired_client_journals(self) -> None:
+        """Run-complete housekeeping (ISSUE 14 satellite): reclaim the
+        per-rank journal dirs of clients no longer in this fleet's live set
+        — bounded by ``client_journal_keep_retired``, best-effort (a prune
+        failure never costs the run)."""
+        root = cfg_extra(self.cfg, "client_journal_dir")
+        if not root:
+            return
+        from .client_journal import prune_retired_client_dirs
+
+        try:
+            prune_retired_client_dirs(
+                root, self.client_ids,
+                keep=int(cfg_extra(self.cfg, "client_journal_keep_retired")))
+        except Exception:
+            log.warning("retired-client journal prune failed", exc_info=True)
 
     def handle_message_client_finished(self, msg: Message) -> None:
         pass  # bookkeeping only
 
     def finish(self) -> None:  # graftlint: disable=GL008(teardown: finish can race the straggler timer's finish, but every resource close here is idempotent and metrics_server flips non-None->None exactly once per object)
-        t = self._status_timer
-        self._status_timer = None
-        if t is not None:
-            t.cancel()
+        self._runtime.cancel(self)
+        if self.round_gate is not None:
+            # never strand a held mesh slot on an abnormal teardown
+            self.round_gate.release(self)
         super().finish()
         if self.obs_collector is not None:
             self.obs_collector.close()  # release the JSONL append handle
@@ -1066,6 +1114,8 @@ class FedMLServerManager(FedMLCommManager):
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None
+        if self._owns_runtime:
+            self._runtime.close()
 
     # -- runner API ----------------------------------------------------------
     def run_until_done(self, timeout: float = 600.0) -> list[dict]:  # graftlint: disable=GL008(reads after done.wait() are ordered by the Event (set after the last locked write); the round_idx read in the timeout message is an intentionally racy diagnostic)

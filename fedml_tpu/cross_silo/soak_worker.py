@@ -67,6 +67,7 @@ def main() -> int:
     model = model_hub.create(cfg, ds.class_num)
 
     if role == "server":
+        from fedml_tpu.comm.chaos import ChaosCommManager
         from fedml_tpu.cross_silo import build_server
 
         server = build_server(cfg, ds, model, backend="TCP")
@@ -75,6 +76,13 @@ def main() -> int:
         ok = server.done.wait(timeout_s)
         summary = server.async_summary()
         summary["completed"] = bool(ok)
+        if isinstance(server.com_manager, ChaosCommManager):
+            # seeded-fault composition (ISSUE 14): record what the wrapper
+            # injected on the real TCP dispatch leg alongside the SIGKILLs
+            summary["chaos"] = {
+                "injected": dict(server.com_manager.injected),
+                "silent_losses": int(server.com_manager.silent_losses()),
+            }
         _atomic_write_json(os.path.join(workdir, "server_summary.json"), summary)
         server.finish()
         return 0 if ok else 3

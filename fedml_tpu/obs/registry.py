@@ -23,7 +23,8 @@ from typing import Optional, Sequence
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-    "MetricsHTTPServer", "maybe_start_metrics_server", "default_latency_buckets",
+    "ScopedRegistry", "MetricsHTTPServer", "maybe_start_metrics_server",
+    "default_latency_buckets",
 ]
 
 _INF = float("inf")
@@ -259,6 +260,17 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def scoped(self, **bound: str) -> "ScopedRegistry":
+        """A view of this registry with ``bound`` labels pre-applied — the
+        multi-tenant namespace mechanism (ISSUE 14): two tenants registering
+        the SAME family name through ``REGISTRY.scoped(job=...)`` share one
+        family whose samples stay fully separated by the ``job`` label, so
+        neither can observe (or clobber) the other's series.  Bound label
+        names are appended to every family's declared labels; re-registering
+        an existing family with a different label set still refuses exactly
+        as the base registry does."""
+        return ScopedRegistry(self, bound)
+
     def snapshot(self) -> list[dict]:
         """Structured point-in-time view of every family: name/kind/help/
         labels plus samples (and buckets for histograms).  This is what the
@@ -279,6 +291,85 @@ class MetricsRegistry:
             out.append(f"# TYPE {metric.name} {metric.kind}")
             metric._render_into(out)
         return "\n".join(out) + "\n"
+
+
+class _BoundChild:
+    """One family viewed through fixed label values: every write/read call
+    merges the bound labels in, so tenant code uses the plain metric API
+    while its samples land in its own label series."""
+
+    __slots__ = ("_metric", "_bound")
+
+    def __init__(self, metric: _Metric, bound: dict):
+        self._metric = metric
+        self._bound = bound
+
+    def _merge(self, labels: dict) -> dict:
+        overlap = set(labels) & set(self._bound)
+        if overlap:
+            raise ValueError(
+                f"{self._metric.name}: labels {sorted(overlap)} are bound by "
+                "the scoped registry and cannot be overridden")
+        return {**self._bound, **labels}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        self._metric.inc(amount, **self._merge(labels))
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self._metric.dec(amount, **self._merge(labels))
+
+    def set(self, value: float, **labels) -> None:
+        self._metric.set(value, **self._merge(labels))
+
+    def observe(self, value: float, **labels) -> None:
+        self._metric.observe(value, **self._merge(labels))
+
+    def value(self, **labels) -> float:
+        return self._metric.value(**self._merge(labels))
+
+    def count(self, **labels) -> int:
+        return self._metric.count(**self._merge(labels))
+
+    def sum(self, **labels) -> float:
+        return self._metric.sum(**self._merge(labels))
+
+    @property
+    def name(self) -> str:
+        return self._metric.name
+
+
+class ScopedRegistry:
+    """Label-bound view over a :class:`MetricsRegistry` (see
+    :meth:`MetricsRegistry.scoped`).  Family names must still carry the
+    ``fedml_`` namespace — GL005 and the runtime metric lint see the same
+    underlying families."""
+
+    def __init__(self, registry: "MetricsRegistry", bound: dict):
+        for name in bound:
+            if not _LABEL_RE.match(name) or name == "le":
+                raise ValueError(f"invalid bound label name {name!r}")
+        self.registry = registry
+        self.bound = {k: str(v) for k, v in bound.items()}
+
+    def _labels(self, labels: Sequence[str]) -> tuple:
+        clash = set(labels) & set(self.bound)
+        if clash:
+            raise ValueError(f"labels {sorted(clash)} already bound by this scope")
+        return tuple(self.bound) + tuple(labels)
+
+    def counter(self, name: str, help: str = "", labels: Sequence[str] = ()) -> _BoundChild:
+        return _BoundChild(self.registry.counter(name, help, self._labels(labels)),
+                           self.bound)
+
+    def gauge(self, name: str, help: str = "", labels: Sequence[str] = ()) -> _BoundChild:
+        return _BoundChild(self.registry.gauge(name, help, self._labels(labels)),
+                           self.bound)
+
+    def histogram(self, name: str, help: str = "", labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> _BoundChild:
+        return _BoundChild(
+            self.registry.histogram(name, help, self._labels(labels), buckets=buckets),
+            self.bound)
 
 
 #: the process-global registry every instrumented layer writes to

@@ -1,0 +1,414 @@
+"""Multi-tenant federated control plane — pack N concurrent FL jobs onto one
+fleet (ISSUE 14).
+
+The reference framework's largest subsystem is its MLOps scheduler
+(PAPER.md §L8, ~29.3k LoC: any machine becomes a launchable worker serving
+many jobs); this repo's sched/ ran exactly ONE job at a time.  Production on
+shared chips means many tenants per mesh, so this module adds the missing
+layer: a control plane that
+
+- **admits N concurrent FL jobs** (`admit`), each with its own isolated
+  config (:func:`tenant_config` deep-copies the recipe, re-keys the in-proc
+  fabric per job, and scopes every durable artifact under the job id);
+- **gang-schedules their (virtual) rounds onto one mesh/host pool** at
+  round boundaries through the :class:`~fedml_tpu.cross_silo.runtime.
+  GangScheduler`: ``mt_slots`` rounds run at once, grants go by strict
+  ``mt_priority`` then weighted fair share over the MEASURED round cost
+  (``mt_weight``), and preemption happens only at boundaries — a running
+  round is never aborted, a higher-priority job simply wins every
+  subsequent grant;
+- **isolates tenants end-to-end**: per-job journal roots
+  (``<journal_root>/job_<id>/server`` and ``.../clients`` — the existing
+  :class:`ServerJournal`/:class:`ClientJournal` machinery rides unchanged
+  under the scoped path), per-job metric namespaces (a ``job`` label
+  threaded through :meth:`MetricsRegistry.scoped` — colliding family names
+  land in one family whose samples stay separated per job), and per-tenant
+  flag isolation (each job reads only its own ``extra``);
+- **shares ONE AOT program store** across tenants (``mt_shared_aot_dir``):
+  job k+1 with the same tracing fingerprint DESERIALIZES job k's exported
+  round/eval programs instead of recompiling — the FedJAX observation
+  (PAPERS.md 2108.02117) that identically-shaped round programs are free
+  warm starts, now across jobs.
+
+All of it rides the event-driven server runtime extracted in
+``cross_silo/runtime.py``: one shared timer wheel + dispatch loop serves
+every tenant's server, so N jobs cost one loop thread, not N thread soups.
+With the plane unused (no ``round_gate``, no ``mt_*`` flags) the single-job
+sync and async server paths are bit-identical to before this module
+existed — regression-pinned by tests/test_multi_tenant.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from ..core.flags import cfg_extra
+from ..cross_silo.runtime import GangScheduler, ServerRuntime
+from ..obs import registry as obsreg
+
+log = logging.getLogger("fedml_tpu.sched.multi_tenant")
+
+__all__ = ["MultiTenantControlPlane", "TenantJob", "tenant_config",
+           "run_multi_tenant_soak"]
+
+JOB_ROUNDS = obsreg.REGISTRY.gauge(
+    "fedml_mt_job_rounds",
+    "Rounds (sync) / virtual rounds (async) completed per tenant job.",
+    labels=("job",),
+)
+JOBS_ADMITTED = obsreg.REGISTRY.counter(
+    "fedml_mt_jobs_admitted_total",
+    "Tenant jobs admitted by a multi-tenant control plane.",
+)
+AOT_WARM_JOBS = obsreg.REGISTRY.counter(
+    "fedml_mt_shared_aot_warm_jobs_total",
+    "Admitted jobs whose server programs resolved from the SHARED AOT "
+    "store with at least one cross-job warm hit.",
+)
+
+
+def tenant_config(cfg, job_id: str, *, journal_root: Optional[str] = None,
+                  aot_dir: Optional[str] = None):
+    """One tenant's isolated config: a deep-copied recipe whose run_id,
+    journal roots, publish dir, and metric namespace are scoped under
+    ``job_<id>`` — reusing ServerJournal/ClientJournal/ModelPublisher
+    unchanged underneath the per-job path.
+
+    The returned config owns a FRESH ``extra`` dict: a tenant mutating its
+    flags can never be observed by a sibling or by the admitted base
+    recipe.  When ``journal_root`` is unset, any journal/publish dirs the
+    base recipe carries are job-scoped in place (``<dir>/job_<id>``) so two
+    tenants admitted from one recipe never interleave snapshots."""
+    jid = str(job_id)
+    overrides = {"mt_job_id": jid}
+
+    def _scoped(base_dir: Optional[str], leaf: str) -> Optional[str]:
+        if journal_root:
+            return os.path.join(str(journal_root), f"job_{jid}", leaf)
+        if base_dir:
+            return os.path.join(str(base_dir), f"job_{jid}")
+        return None
+
+    sj = _scoped(cfg_extra(cfg, "server_journal_dir"), "server")
+    if sj:
+        overrides["server_journal_dir"] = sj
+    cj = _scoped(cfg_extra(cfg, "client_journal_dir"), "clients")
+    if cj:
+        overrides["client_journal_dir"] = cj
+    pub = cfg_extra(cfg, "model_publish_dir")
+    if pub:
+        overrides["model_publish_dir"] = os.path.join(str(pub), f"job_{jid}")
+    shared_aot = aot_dir or cfg_extra(cfg, "mt_shared_aot_dir")
+    if shared_aot:
+        overrides["aot_programs"] = True
+        overrides["aot_programs_dir"] = str(shared_aot)
+    new_extra = {**dict(getattr(cfg, "extra", None) or {}), **overrides}
+    return dataclasses.replace(
+        cfg, run_id=f"{getattr(cfg, 'run_id', '0')}_job_{jid}", extra=new_extra)
+
+
+class TenantJob:
+    """One admitted job: its isolated config, server, clients (real in-proc
+    managers or a simulated fleet), and job-scoped metric view."""
+
+    def __init__(self, job_id: str, cfg, dataset, model, server, clients,
+                 weight: float, priority: int):
+        self.job_id = job_id
+        self.cfg = cfg
+        self.dataset = dataset
+        self.model = model
+        self.server = server
+        self.clients = list(clients)
+        self.weight = weight
+        self.priority = priority
+        #: job-scoped registry view — every family registered through it
+        #: carries job=<id>, so colliding names across tenants cannot bleed
+        self.metrics = obsreg.REGISTRY.scoped(job=job_id)
+        self.fleet = None
+        self._fleet_queue = None
+        #: per-job AOT accounting delta captured at admit (shared-store
+        #: warm starts show up as hits during server construction)
+        self.aot_hits_at_admit = 0
+        self.started_monotonic: Optional[float] = None
+        self.finished_monotonic: Optional[float] = None
+
+    @property
+    def done(self) -> "threading.Event":
+        return self.server.done
+
+    def rounds_completed(self) -> int:
+        return int(getattr(self.server, "server_version", None)
+                   or len(self.server.history))
+
+    def summary(self) -> dict:
+        out = {
+            "job_id": self.job_id,
+            "weight": self.weight,
+            "priority": self.priority,
+            "rounds": self.rounds_completed(),
+            "history_rows": len(self.server.history),
+            "done": self.server.done.is_set(),
+        }
+        if self.started_monotonic and self.finished_monotonic:
+            out["wall_s"] = round(self.finished_monotonic - self.started_monotonic, 4)
+        if hasattr(self.server, "async_summary"):
+            a = self.server.async_summary()
+            out["server_version"] = a["server_version"]
+            out["arrivals"] = a["arrivals"]
+        return out
+
+
+class MultiTenantControlPlane:
+    """Admit → gang-schedule → run N FL jobs on one mesh/host pool.
+
+    One shared :class:`ServerRuntime` (timer wheel + dispatch loop) serves
+    every tenant's server; one :class:`GangScheduler` arbitrates the mesh
+    slots.  ``slots``/``aot_dir`` default from the optional ``base_cfg``'s
+    ``mt_slots``/``mt_shared_aot_dir`` flags (1 / unset without one).
+
+    Thread model (GL008-audited): admit/start/run_until_done/close are
+    driver-thread calls (the plane is built and driven from one thread, like
+    the soak harnesses); all cross-thread state lives inside the runtime,
+    the scheduler, and the servers, each with its own discipline.
+    """
+
+    def __init__(self, *, slots: Optional[int] = None,
+                 journal_root: Optional[str] = None,
+                 aot_dir: Optional[str] = None,
+                 runtime: Optional[ServerRuntime] = None,
+                 base_cfg=None):
+        self.slots = int(slots if slots is not None
+                         else cfg_extra(base_cfg, "mt_slots"))
+        self.journal_root = journal_root
+        self.aot_dir = aot_dir or cfg_extra(base_cfg, "mt_shared_aot_dir")
+        self.runtime = runtime if runtime is not None else ServerRuntime(
+            name="fedml-mt-runtime")
+        self._owns_runtime = runtime is None
+        self.scheduler = GangScheduler(self.runtime, slots=self.slots)
+        self.jobs: dict[str, TenantJob] = {}
+        self._started = False
+
+    # -- admission ------------------------------------------------------------
+    def admit(self, cfg, *, job_id: Optional[str] = None,
+              weight: Optional[float] = None, priority: Optional[int] = None,
+              dataset=None, model=None, backend: str = "INPROC",
+              build_clients: bool = True) -> TenantJob:
+        """Admit one job: isolate its config, build its server (+ real
+        in-proc clients unless ``build_clients=False`` — attach a simulated
+        fleet instead via :meth:`attach_sim_fleet`), and register it with
+        the gang scheduler.  Nothing runs until :meth:`start`."""
+        from ..core.aot import AOT_HITS
+
+        jid = str(job_id if job_id is not None
+                  else (cfg_extra(cfg, "mt_job_id") or f"job{len(self.jobs)}"))
+        if jid in self.jobs:
+            raise ValueError(f"job id {jid!r} already admitted")
+        w = float(weight if weight is not None else cfg_extra(cfg, "mt_weight"))
+        prio = int(priority if priority is not None
+                   else cfg_extra(cfg, "mt_priority"))
+        tcfg = tenant_config(cfg, jid, journal_root=self.journal_root,
+                             aot_dir=self.aot_dir)
+        if dataset is None:
+            from ..data import loader
+
+            dataset = loader.load(tcfg)
+        if model is None:
+            from ..models import model_hub
+
+            model = model_hub.create(tcfg, dataset.class_num)
+        if backend == "INPROC":
+            from ..comm.inproc import InProcRouter
+
+            InProcRouter.reset(tcfg.run_id)
+        from ..cross_silo import build_client, build_server
+
+        clients = []
+        if build_clients:
+            clients = [build_client(tcfg, dataset, model, rank=r, backend=backend)
+                       for r in range(1, tcfg.client_num_in_total + 1)]
+        hits0 = AOT_HITS.value()
+        server = build_server(tcfg, dataset, model, backend=backend,
+                              runtime=self.runtime)
+        job = TenantJob(jid, tcfg, dataset, model, server, clients,
+                        weight=w, priority=prio)
+        job.aot_hits_at_admit = int(AOT_HITS.value() - hits0)
+        if job.aot_hits_at_admit > 0:
+            AOT_WARM_JOBS.inc()
+        server.round_gate = self.scheduler
+        self.scheduler.register(server, jid, weight=w, priority=prio)
+        self.jobs[jid] = job
+        JOBS_ADMITTED.inc()
+        log.info("admitted job %s (weight %.2f, priority %d, %d clients, "
+                 "aot warm hits at admit %d)", jid, w, prio, len(clients),
+                 job.aot_hits_at_admit)
+        return job
+
+    def attach_sim_fleet(self, job: TenantJob, **fleet_kwargs) -> None:
+        """Replace real clients with the event-scheduled simulated fleet
+        (``cross_silo/async_soak.py``) for fleet-scale jobs — the bench's
+        8-concurrent-jobs shape."""
+        from ..cross_silo.async_soak import attach_sim_fleet
+
+        job.fleet, job._fleet_queue = attach_sim_fleet(job.server, **fleet_kwargs)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        """Launch every admitted job: client receive loops, server receive
+        loops, then the status-discovery kick.  Rounds begin as the gang
+        scheduler grants slots."""
+        self._started = True
+        for job in self.jobs.values():
+            for c in job.clients:
+                c.run_in_thread()
+        for job in self.jobs.values():
+            job.started_monotonic = time.monotonic()
+            job.server.run_in_thread()
+            job.server.start()
+
+    def run_until_done(self, timeout: float = 600.0) -> dict:
+        """Block until every job completes (or raise on timeout, naming the
+        laggards); returns :meth:`summary`."""
+        deadline = time.monotonic() + float(timeout)
+        for jid, job in self.jobs.items():
+            remaining = deadline - time.monotonic()
+            if not job.server.done.wait(max(0.0, remaining)):
+                laggards = [j for j, jb in self.jobs.items()
+                            if not jb.server.done.is_set()]
+                raise TimeoutError(
+                    f"multi-tenant run did not finish in {timeout}s; "
+                    f"pending jobs: {laggards}; scheduler: "
+                    f"{self.scheduler.summary()}")
+            if job.finished_monotonic is None:
+                job.finished_monotonic = time.monotonic()
+            JOB_ROUNDS.set(job.rounds_completed(), job=jid)
+        return self.summary()
+
+    def summary(self) -> dict:
+        """Per-job completion + gang-scheduler accounting."""
+        return {
+            "slots": self.slots,
+            "jobs": {jid: job.summary() for jid, job in self.jobs.items()},
+            "scheduler": self.scheduler.summary(),
+        }
+
+    def close(self) -> None:
+        """Tear every job down (idempotent): servers, clients, fleets,
+        per-job fabrics, and the owned runtime."""
+        from ..comm.inproc import InProcRouter
+
+        for job in self.jobs.values():
+            try:
+                job.server.finish()
+            except Exception:
+                log.warning("job %s server teardown failed", job.job_id,
+                            exc_info=True)
+            for c in job.clients:
+                try:
+                    c.finish()
+                except Exception:
+                    log.warning("job %s client teardown failed", job.job_id,
+                                exc_info=True)
+            if job.fleet is not None:
+                job.fleet.stop(job._fleet_queue)
+                job.fleet = None
+            InProcRouter.reset(job.cfg.run_id)
+        if self._owns_runtime:
+            self.runtime.close()
+
+
+# ---------------------------------------------------------------------------
+# bench / dryrun harness
+# ---------------------------------------------------------------------------
+
+def run_multi_tenant_soak(n_jobs: int = 8, versions: int = 6, *,
+                          concurrent: bool = True, slots: int = 2,
+                          clients_per_job: int = 32, concurrency: int = 8,
+                          buffer_k: int = 8, latency_mean_s: float = 0.002,
+                          latency_sigma: float = 1.0, seed: int = 0,
+                          weights: Optional[list] = None,
+                          priorities: Optional[list] = None,
+                          journal_root: Optional[str] = None,
+                          aot_dir: Optional[str] = None,
+                          timeout_s: float = 600.0) -> dict:
+    """N buffered-async jobs, each with its own simulated client fleet,
+    gang-scheduled onto one host pool — or the SAME jobs run one at a time
+    through the same gated machinery (``concurrent=False``, the Nx-sequential
+    baseline the bench ratio divides by).
+
+    Returns aggregate versions/s, pooled p50/p95 round-hold latency (the
+    per-round mesh occupancy under gang scheduling), and the per-job
+    scheduler accounting."""
+    import fedml_tpu
+
+    from ..cross_silo.async_soak import _soak_config
+
+    def _job_cfg(i: int):
+        return _soak_config(
+            f"mtsoak_{'c' if concurrent else 's'}_{seed}_{i}",
+            clients_per_job, concurrency, buffer_k, versions,
+            staleness_exponent=0.5, redispatch_timeout_s=2.0)
+
+    def _run_plane(job_indices) -> tuple[float, list, dict]:
+        plane = MultiTenantControlPlane(slots=slots, journal_root=journal_root,
+                                        aot_dir=aot_dir)
+        try:
+            for i in job_indices:
+                cfg = _job_cfg(i)
+                fedml_tpu.init(cfg)
+                job = plane.admit(
+                    cfg, job_id=f"t{i}",
+                    weight=(weights[i] if weights else None),
+                    priority=(priorities[i] if priorities else None),
+                    build_clients=False)
+                plane.attach_sim_fleet(
+                    job, drop_prob=0.0, latency_mean_s=latency_mean_s,
+                    latency_sigma=latency_sigma, seed=seed + i, workers=2)
+            t0 = time.monotonic()
+            plane.start()
+            plane.run_until_done(timeout=timeout_s)
+            wall = time.monotonic() - t0
+            holds = [h for rec in plane.scheduler.stats.values()
+                     for h in rec["hold_s"]]
+            return wall, holds, plane.summary()
+        finally:
+            plane.close()
+
+    if concurrent:
+        wall, holds, summary = _run_plane(list(range(n_jobs)))
+        walls = [wall]
+    else:
+        wall = 0.0
+        holds = []
+        summaries = []
+        walls = []
+        for i in range(n_jobs):
+            w, h, s = _run_plane([i])
+            wall += w
+            walls.append(w)
+            holds.extend(h)
+            summaries.append(s)
+        summary = {"sequential_runs": summaries}
+
+    import numpy as np
+
+    total_versions = n_jobs * versions
+    return {
+        "mode": "concurrent" if concurrent else "sequential",
+        "jobs": n_jobs,
+        "slots": slots,
+        "versions_per_job": versions,
+        "versions_total": total_versions,
+        "wall_s": round(wall, 4),
+        "aggregate_versions_per_sec": round(total_versions / max(wall, 1e-9), 4),
+        "round_hold_p50_s": (round(float(np.percentile(holds, 50)), 6)
+                             if holds else None),
+        "round_hold_p95_s": (round(float(np.percentile(holds, 95)), 6)
+                             if holds else None),
+        "rounds_granted": len(holds),
+        "summary": summary,
+    }

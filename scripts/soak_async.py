@@ -56,13 +56,23 @@ def main() -> int:
                         "journal-recovered and the run driven to completion "
                         "(client/server counts from --clients etc. are "
                         "ignored; the multiproc soak sizes itself)")
+    p.add_argument("--chaos", action="store_true",
+                   help="with --procs: thread the default seeded chaos_* "
+                        "fault mix into every worker's cfg, so drop/delay/"
+                        "duplicate/corrupt faults ride the REAL TCP "
+                        "transport in the same run as the genuine SIGKILLs "
+                        "(ISSUE 14 satellite; the accounting identity must "
+                        "still close)")
     args = p.parse_args()
 
     if args.procs:
-        from fedml_tpu.cross_silo.async_soak import run_multiproc_kill_soak
+        from fedml_tpu.cross_silo.async_soak import (
+            DEFAULT_CHAOS_FLAGS, run_multiproc_kill_soak,
+        )
 
-        res = run_multiproc_kill_soak(n_clients=args.procs,
-                                      timeout_s=args.timeout_s)
+        res = run_multiproc_kill_soak(
+            n_clients=args.procs, timeout_s=args.timeout_s, seed=args.seed,
+            chaos=dict(DEFAULT_CHAOS_FLAGS) if args.chaos else None)
         print(json.dumps(res, indent=2))
         failures = []
         if not res["completed"]:

@@ -441,13 +441,18 @@ def test_client_kill_soak_resumes_and_accounts(eight_devices):
 
 @pytest.mark.slow
 def test_multiproc_sigkill_soak():
-    """The acceptance soak (ISSUE 13): REAL OS processes over TCP, the
-    server and >= 2 clients SIGKILLed mid-run, every party journal-recovered
-    and the run driven to completion with the extended accounting identity.
-    Out of tier-1 (slow): interpreter restarts alone cost ~30s."""
-    from fedml_tpu.cross_silo.async_soak import run_multiproc_kill_soak
+    """The acceptance soak (ISSUE 13 + the ISSUE 14 chaos satellite): REAL
+    OS processes over TCP with the seeded ``chaos_*`` fault mix threaded
+    into every worker's cfg — drop/delay/duplicate/corrupt faults ride the
+    real transport in the SAME run as the genuine SIGKILLs of the server
+    and >= 2 clients; every party journal-recovers and the run completes
+    with the extended accounting identity still closing.  Out of tier-1
+    (slow): interpreter restarts alone cost ~30s."""
+    from fedml_tpu.cross_silo.async_soak import (
+        DEFAULT_CHAOS_FLAGS, run_multiproc_kill_soak,
+    )
 
-    res = run_multiproc_kill_soak()
+    res = run_multiproc_kill_soak(chaos=dict(DEFAULT_CHAOS_FLAGS))
     assert res["completed"], res
     assert res["versions"] == 160, res
     assert res["server_kills"] == 1, res
@@ -457,3 +462,6 @@ def test_multiproc_sigkill_soak():
     assert res["unaccounted"] == 0, res
     assert (res["resumed_from_journal"] + res["cold_rejoins"]
             == res["client_kills"]), res
+    # the chaos wrapper really was live on the server's real TCP leg
+    assert res["chaos"] is not None, res
+    assert sum(res["chaos"]["injected"].values()) > 0, res

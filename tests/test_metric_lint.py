@@ -26,7 +26,9 @@ INSTRUMENTED_MODULES = [
     "fedml_tpu.comm.codecs",
     "fedml_tpu.cross_silo.client_journal",
     "fedml_tpu.cross_silo.journal",
+    "fedml_tpu.cross_silo.runtime",
     "fedml_tpu.cross_silo.server",
+    "fedml_tpu.sched.multi_tenant",
     "fedml_tpu.obs.health",
     "fedml_tpu.obs.otlp",
     "fedml_tpu.obs.remote",

@@ -1,0 +1,465 @@
+"""Multi-tenant federated control plane (ISSUE 14).
+
+Covers the event-driven server runtime (timer wheel + dispatch loop), the
+gang scheduler's fair-share/priority policy, end-to-end tenant isolation
+(flags, journal roots, metric namespaces), the shared AOT store's cross-job
+warm start, the single-job bit-identity regression (multi-tenancy unused →
+sync and async paths produce bitwise the pre-refactor results), pre-tenant
+journal back-compat, and retired-client journal pruning.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import Config
+from fedml_tpu.comm.inproc import InProcRouter
+from fedml_tpu.cross_silo import build_client, build_server
+from fedml_tpu.cross_silo.runtime import GangScheduler, ServerRuntime
+from fedml_tpu.sched.multi_tenant import (
+    MultiTenantControlPlane, run_multi_tenant_soak, tenant_config,
+)
+
+
+def _sync_cfg(run_id, rounds=2, extra=None, clients=2):
+    return Config(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=clients, client_num_per_round=clients,
+        comm_round=rounds, epochs=1, batch_size=16, learning_rate=0.1,
+        partition_method="homo", synthetic_train_size=32 * clients,
+        synthetic_test_size=32, frequency_of_the_test=0,
+        compute_dtype="float32", metrics_jsonl_path="", run_id=run_id,
+        extra=dict(extra or {}),
+    )
+
+
+def _run_group(cfg, ds, model):
+    """1 server + clients on the plain (gate-free) path; returns the server
+    so the test can read its final global."""
+    InProcRouter.reset(cfg.run_id)
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC")
+               for r in range(1, cfg.client_num_in_total + 1)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    try:
+        server.run_until_done(timeout=120.0)
+        for c in clients:
+            c.done.wait(5.0)
+    finally:
+        for c in clients:
+            c.finish()
+    InProcRouter.reset(cfg.run_id)
+    return server
+
+
+def _leaves(tree):
+    import jax
+
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(jax.device_get(tree))]
+
+
+# ---------------------------------------------------------------------------
+# ServerRuntime: timer wheel + dispatch loop
+# ---------------------------------------------------------------------------
+
+def test_runtime_timer_wheel_arm_supersede_cancel():
+    rt = ServerRuntime(name="t-wheel")
+    fired = []
+    owner = object()
+    try:
+        # superseded timer never fires: re-arming the same (owner, name)
+        # atomically replaces the previous entry
+        rt.arm(owner, "a", 5.0, lambda: fired.append("stale"))
+        rt.arm(owner, "a", 0.01, lambda: fired.append("fresh"))
+        # cancelled timer never fires
+        rt.arm(owner, "b", 0.01, lambda: fired.append("cancelled"))
+        rt.cancel(owner, "b")
+        # posted callbacks run promptly and in order
+        rt.post(lambda: fired.append("p1"))
+        rt.post(lambda: fired.append("p2"))
+        deadline = time.monotonic() + 5.0
+        while len(fired) < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert fired[:2] == ["p1", "p2"]
+        assert fired[2] == "fresh"
+        assert "stale" not in fired and "cancelled" not in fired
+        # a raising callback is contained; the wheel keeps serving
+        rt.post(lambda: 1 / 0)
+        rt.post(lambda: fired.append("after-error"))
+        deadline = time.monotonic() + 5.0
+        while "after-error" not in fired and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert "after-error" in fired
+        # cancel-all drops every timer of the owner
+        rt.arm(owner, "x", 0.01, lambda: fired.append("x"))
+        rt.arm(owner, "y", 0.01, lambda: fired.append("y"))
+        rt.cancel(owner)
+        time.sleep(0.1)
+        assert "x" not in fired and "y" not in fired
+    finally:
+        rt.close()
+    # post after close is a no-op, not a crash
+    rt.post(lambda: fired.append("dead"))
+    time.sleep(0.05)
+    assert "dead" not in fired
+
+
+def test_gang_scheduler_priority_and_fair_share():
+    rt = ServerRuntime(name="t-sched")
+    sched = GangScheduler(rt, slots=1)
+    a, b, hi = object(), object(), object()
+    sched.register(a, "a", weight=1.0, priority=0)
+    sched.register(b, "b", weight=1.0, priority=0)
+    sched.register(hi, "hi", weight=1.0, priority=5)
+    granted = []
+    evt = threading.Event()
+
+    def grant(name):
+        def cb():
+            granted.append(name)
+            evt.set()
+        return cb
+
+    def wait_grant(expected):
+        assert evt.wait(5.0), f"no grant; got {granted}"
+        evt.clear()
+        assert granted[-1] == expected, granted
+
+    try:
+        # occupy the slot so the next three requests genuinely queue
+        blocker = object()
+        sched.register(blocker, "blocker")
+        sched.request(blocker, grant("blocker"))
+        wait_grant("blocker")
+        # all three pending: strict priority wins the first grant even
+        # though "a" arrived first — and the pass-over is metered as a
+        # boundary preemption against the fair-share candidate
+        sched.request(a, grant("a"))
+        sched.request(b, grant("b"))
+        sched.request(hi, grant("hi"))
+        sched.release(blocker)
+        wait_grant("hi")
+        assert sched.stats["a"]["preempted"] == 1
+        time.sleep(0.03)  # measurable hold charged to hi's virtual clock
+        sched.release(hi)
+        wait_grant("a")  # same class: arrival order at equal vtime
+        time.sleep(0.05)
+        sched.release(a)
+        wait_grant("b")
+        time.sleep(0.01)
+        sched.release(b)
+        # fair share: "a" accumulated ~5x "b"'s hold — queue both behind a
+        # fresh holder, and the lower-virtual-time job ("b") wins the grant
+        sched.request(hi, grant("hi"))
+        wait_grant("hi")
+        sched.request(a, grant("a"))
+        sched.request(b, grant("b"))
+        sched.release(hi)
+        wait_grant("b")
+        sched.release(b)
+        assert evt.wait(5.0)  # a's turn drains
+        sched.release(a)
+        s = sched.summary()
+        assert s["hi"]["grants"] == 2 and s["a"]["grants"] == 2
+        assert s["a"]["hold_p95_s"] is not None
+    finally:
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# single-job bit-identity: multi-tenancy unused == pre-refactor paths
+# ---------------------------------------------------------------------------
+
+def test_sync_single_job_bit_identical_with_and_without_plane():
+    """The same sync recipe run plain and as a 1-job control-plane tenant
+    must produce BITWISE the same final global (the gate only sequences the
+    round start; with one tenant every grant is immediate)."""
+    cfg = _sync_cfg("mt_bitid_sync", rounds=2)
+    fedml_tpu.init(cfg)
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    plain = _run_group(cfg, ds, model)
+
+    plane = MultiTenantControlPlane(slots=1)
+    try:
+        job = plane.admit(_sync_cfg("mt_bitid_sync", rounds=2), job_id="solo",
+                          dataset=ds, model=model)
+        plane.start()
+        out = plane.run_until_done(timeout=120.0)
+        assert out["jobs"]["solo"]["rounds"] == 2
+    finally:
+        plane.close()
+    for pa, pb in zip(_leaves(plain.aggregator.global_vars),
+                      _leaves(job.server.aggregator.global_vars)):
+        assert np.array_equal(pa, pb)
+    # no tenant key ever reaches the plain run's config
+    assert "mt_job_id" not in (plain.cfg.extra or {})
+    assert job.cfg.extra["mt_job_id"] == "solo"
+
+
+def test_async_gated_vs_unused_fixed_arrival_order_bitwise():
+    """Fixed arrival order, direct-driven: the 1-job GATED async server
+    folds bitwise the same global as the plain (gate-free) server — the
+    gang gate sequences DISPATCH only, never the fold math or the virtual-
+    round boundary.  (With multi-tenancy unused the dispatch path is the
+    exact pre-refactor code; tests/test_async_agg.py pins its behavior.)"""
+    import jax
+
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+
+    extra = {"async_aggregation": True, "async_buffer_k": 3,
+             "async_staleness_exponent": 0.5,
+             "async_redispatch_timeout_s": 0.0}
+
+    def upload(cid, params, n, version):
+        msg = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, cid, 0)
+        msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+        msg.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, int(version))
+        return Message.decode(msg.encode())
+
+    def perturbed(base, salt):
+        return jax.tree_util.tree_map(
+            lambda a: (np.asarray(a) + 0.01 * (salt + 1)).astype(np.asarray(a).dtype)
+            if np.asarray(a).dtype.kind == "f" else np.asarray(a), base)
+
+    def run(run_id, gated):
+        cfg = _sync_cfg(run_id, rounds=2, clients=6, extra=extra)
+        fedml_tpu.init(cfg)
+        from fedml_tpu.data import loader
+        from fedml_tpu.models import model_hub
+
+        ds = loader.load(cfg)
+        model = model_hub.create(cfg, ds.class_num)
+        InProcRouter.reset(run_id)
+        rt = sched = None
+        if gated:
+            rt = ServerRuntime(name="t-async-gate")
+            sched = GangScheduler(rt, slots=1)
+        server = build_server(cfg, ds, model, backend="INPROC", runtime=rt)
+        if gated:
+            server.round_gate = sched
+            sched.register(server, "solo")
+        try:
+            server.send_init_msg()
+            base = jax.device_get(server.aggregator.global_vars)
+            arrivals = [(1, 0), (4, 0), (2, 0), (3, 1), (1, 1), (5, 0)]
+            for i, (cid, ver) in enumerate(arrivals):
+                server.handle_message_receive_model(
+                    upload(cid, perturbed(base, i), 16.0 + cid, ver))
+            assert server.server_version == 2
+            return _leaves(server.aggregator.global_vars)
+        finally:
+            server.finish()
+            if rt is not None:
+                rt.close()
+            InProcRouter.reset(run_id)
+
+    for pa, pb in zip(run("mt_async_plain", False), run("mt_async_gated", True)):
+        assert np.array_equal(pa, pb)
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation: flags, journals, metrics
+# ---------------------------------------------------------------------------
+
+def test_two_tenants_isolated_flags_journals_metrics(tmp_path):
+    """Two concurrent jobs with DIFFERENT extra flags must not observe each
+    other's config, journal steps, or metric samples — and a retired rank's
+    journal dir is reclaimed at job finish while the live set survives."""
+    from fedml_tpu.core.flags import cfg_extra
+    from fedml_tpu.obs import registry as obsreg
+
+    base_a = _sync_cfg("mt_iso", rounds=2,
+                       extra={"streaming_aggregation": True,
+                              "client_journal_dir": "unused-overridden",
+                              "client_journal_keep_retired": 0})
+    base_b = _sync_cfg("mt_iso", rounds=2)
+    fedml_tpu.init(base_a)
+    grants = obsreg.REGISTRY.get("fedml_mt_slot_grants_total")
+    g0_a = grants.value(job="a") if grants is not None else 0.0
+    g0_b = grants.value(job="b") if grants is not None else 0.0
+    plane = MultiTenantControlPlane(slots=1, journal_root=str(tmp_path / "j"))
+    try:
+        ja = plane.admit(base_a, job_id="a")
+        jb = plane.admit(base_b, job_id="b")
+        # config isolation: fresh extra dicts, per-job run ids, A's flags
+        # invisible to B (and to the admitted base recipes)
+        assert ja.cfg.extra is not base_a.extra
+        assert ja.cfg.run_id != jb.cfg.run_id
+        assert cfg_extra(ja.cfg, "streaming_aggregation") is True
+        assert not cfg_extra(jb.cfg, "streaming_aggregation")
+        assert ja.server.aggregator.stream_mode
+        assert not jb.server.aggregator.stream_mode
+        # per-job journal roots under <journal_root>/job_<id>/
+        sj_a = cfg_extra(ja.cfg, "server_journal_dir")
+        sj_b = cfg_extra(jb.cfg, "server_journal_dir")
+        assert "job_a" in sj_a and "job_b" in sj_b and sj_a != sj_b
+        # a long-retired rank's client journal dir, planted before the run
+        cj_a = cfg_extra(ja.cfg, "client_journal_dir")
+        os.makedirs(os.path.join(cj_a, "client_99", "steps"), exist_ok=True)
+
+        plane.start()
+        out = plane.run_until_done(timeout=120.0)
+    finally:
+        plane.close()
+    assert out["jobs"]["a"]["rounds"] == 2 and out["jobs"]["b"]["rounds"] == 2
+    # journal steps landed in each job's own root, never the sibling's
+    assert ja.server.journal is not None and jb.server.journal is not None
+    steps_a = ja.server.journal.steps()
+    steps_b = jb.server.journal.steps()
+    assert steps_a and steps_b
+    assert ja.server.journal.directory != jb.server.journal.directory
+    # metric namespace: the same global families carry job-labeled series
+    # that never bleed — each job saw exactly its own grants this run
+    grants = obsreg.REGISTRY.get("fedml_mt_slot_grants_total")
+    assert grants.value(job="a") - g0_a == 2.0
+    assert grants.value(job="b") - g0_b == 2.0
+    # retired-rank pruning fired at job A's finish (keep_retired=0): the
+    # planted dir is gone, the live ranks' journals survive
+    assert not os.path.exists(os.path.join(cj_a, "client_99"))
+    assert os.path.isdir(os.path.join(cj_a, "client_1"))
+
+
+def test_scoped_registry_collision_isolation():
+    """Colliding family names registered through two job scopes share ONE
+    family whose samples stay separated per job; bound labels cannot be
+    overridden; conflicting re-registration still refuses."""
+    from fedml_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    a = reg.scoped(job="a").counter("fedml_mt_test_collide", "shared family")
+    b = reg.scoped(job="b").counter("fedml_mt_test_collide", "shared family")
+    a.inc(3)
+    b.inc(5)
+    assert a.value() == 3.0 and b.value() == 5.0
+    assert reg.get("fedml_mt_test_collide").value(job="a") == 3.0
+    with pytest.raises(ValueError):
+        a.inc(job="b")  # bound label override refused
+    with pytest.raises(ValueError):
+        reg.scoped(job="a").gauge("fedml_mt_test_collide")  # kind conflict
+    h = reg.scoped(job="a").histogram("fedml_mt_test_hist", labels=("phase",))
+    h.observe(0.5, phase="x")
+    assert reg.get("fedml_mt_test_hist").count(job="a", phase="x") == 1
+
+
+def test_tenant_config_scopes_existing_dirs_and_shared_aot(tmp_path):
+    cfg = _sync_cfg("mt_tc", extra={"server_journal_dir": str(tmp_path / "sj"),
+                                    "model_publish_dir": str(tmp_path / "pub")})
+    t = tenant_config(cfg, "k7", aot_dir=str(tmp_path / "aot"))
+    assert t.run_id == "mt_tc_job_k7"
+    assert t.extra["server_journal_dir"] == str(tmp_path / "sj" / "job_k7")
+    assert t.extra["model_publish_dir"] == str(tmp_path / "pub" / "job_k7")
+    assert t.extra["aot_programs"] is True
+    assert t.extra["aot_programs_dir"] == str(tmp_path / "aot")
+    assert t.extra["mt_job_id"] == "k7"
+    # the base recipe is untouched
+    assert "mt_job_id" not in cfg.extra and cfg.run_id == "mt_tc"
+
+
+def test_shared_aot_store_cross_job_warm_hit(tmp_path):
+    """Job k+1 with the same tracing fingerprint deserializes job k's
+    exported server program instead of recompiling."""
+    cfg = _sync_cfg("mt_aot", rounds=1)
+    fedml_tpu.init(cfg)
+    plane = MultiTenantControlPlane(slots=1, aot_dir=str(tmp_path / "aot"))
+    try:
+        ja = plane.admit(_sync_cfg("mt_aot", rounds=1), job_id="a")
+        jb = plane.admit(_sync_cfg("mt_aot", rounds=1), job_id="b")
+        assert ja.aot_hits_at_admit == 0
+        assert jb.aot_hits_at_admit > 0, (
+            "second tenant re-traced a program the shared store already holds")
+    finally:
+        plane.close()
+
+
+# ---------------------------------------------------------------------------
+# journal back-compat + retired-client pruning
+# ---------------------------------------------------------------------------
+
+def test_pre_tenant_journal_layout_still_restores(tmp_path):
+    """A PR 10/13-era journal (flag-direct directory, no mt_* keys in the
+    protocol sidecar) restores through today's single-job server exactly as
+    it did before the multi-tenant layer existed."""
+    from fedml_tpu.cross_silo.journal import ServerJournal
+
+    jdir = str(tmp_path / "legacy_journal")
+    legacy = ServerJournal(jdir)
+    # the PR-13-era sync sidecar shape: no model tree (model-less snapshots
+    # reference nothing), no folded-keys/mt extensions beyond what PR 13 had
+    legacy.snapshot(2, {
+        "kind": "sync", "session_epoch": 0, "round_idx": 2,
+        "rejected_stale": 0, "deduped": 0,
+        "folded_keys": {}, "health": {},
+        "stream_w": 0.0, "stream_w_delta": 0.0, "stream_folded": 0,
+        "stream_samples": {}, "stream_clients": [],
+    })
+    cfg = _sync_cfg("mt_legacy", rounds=4,
+                    extra={"server_journal_dir": jdir})
+    fedml_tpu.init(cfg)
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset(cfg.run_id)
+    server = build_server(cfg, ds, model, backend="INPROC")
+    try:
+        assert server.recovered_step == 2
+        assert server.round_idx == 2
+        assert server.session_epoch == 1  # bumped past the legacy epoch
+    finally:
+        server.finish()
+        InProcRouter.reset(cfg.run_id)
+
+
+def test_prune_retired_client_dirs(tmp_path):
+    from fedml_tpu.cross_silo.client_journal import prune_retired_client_dirs
+
+    root = tmp_path / "cj"
+    for rank in range(1, 7):
+        d = root / f"client_{rank}"
+        d.mkdir(parents=True)
+        (d / "step_0000000001.journal").write_bytes(b"x")
+        # stagger mtimes: higher rank = newer
+        t = time.time() - (10 - rank) * 100
+        os.utime(d / "step_0000000001.journal", (t, t))
+    (root / "not_a_client_dir").mkdir()
+    pruned = prune_retired_client_dirs(str(root), live_ranks=[1, 2], keep=2)
+    # retired = {3,4,5,6}; newest 2 retired (5, 6) kept, 3 and 4 reclaimed
+    assert sorted(pruned) == [3, 4]
+    assert not (root / "client_3").exists() and not (root / "client_4").exists()
+    for rank in (1, 2, 5, 6):
+        assert (root / f"client_{rank}").exists()
+    assert (root / "not_a_client_dir").exists()
+    # live set is never pruned, whatever keep says
+    assert prune_retired_client_dirs(str(root), live_ranks=[1, 2, 5, 6], keep=0) == []
+    for rank in (1, 2, 5, 6):
+        assert (root / f"client_{rank}").exists()
+
+
+# ---------------------------------------------------------------------------
+# fleet-scale concurrent soak (the bench shape, small)
+# ---------------------------------------------------------------------------
+
+def test_multi_tenant_soak_concurrent_completes_all_jobs():
+    res = run_multi_tenant_soak(n_jobs=3, versions=3, concurrent=True, slots=2,
+                                clients_per_job=12, concurrency=4, buffer_k=4,
+                                timeout_s=120.0)
+    assert res["versions_total"] == 9
+    assert res["aggregate_versions_per_sec"] > 0
+    assert res["rounds_granted"] == 9
+    assert res["round_hold_p95_s"] is not None
+    for jid, s in res["summary"]["jobs"].items():
+        assert s["rounds"] == 3, (jid, s)
+    for jid, s in res["summary"]["scheduler"].items():
+        assert s["grants"] == 3, (jid, s)
