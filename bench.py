@@ -854,6 +854,91 @@ def bench_multi_tenant():
     }
 
 
+def bench_secagg():
+    """Streaming secure aggregation (ISSUE 15): trust off the memory cliff.
+
+    Three measurements. (1) The 10k simulated-cohort soak: masked uploads
+    fold one at a time into the field accumulator — peak buffered <= 2
+    asserted at the full cohort, versions/s with SecAgg on vs off (floor:
+    the secure path keeps >= half the plain throughput at a deliberately
+    cheap proxy local step — real training makes the ratio approach 1), and
+    the streamed-masked == exact-unmasked INTEGER identity.  (2) bytes/round
+    of quantize-then-mask (qsgd8 grid in a cohort-sized ring) vs dense+mask
+    (fixed-point u32) — floor on the ratio — plus the legacy int64 wire for
+    scale.  (3) The real 4-client Shamir protocol e2e: a streamed run's
+    final global must be BITWISE the buffer-all run's (mod-field exactness),
+    with the reveal/dropout machinery live."""
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.cross_silo.secagg_shamir import run_shamir_secagg_process_group
+    from fedml_tpu.cross_silo.secagg_soak import run_secagg_stream_soak
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    cohort = int(os.environ.get("BENCH_SECAGG_COHORT", "10000"))
+    dim = int(os.environ.get("BENCH_SECAGG_DIM", "4096"))
+    rounds = int(os.environ.get("BENCH_SECAGG_ROUNDS", "1"))
+    qsgd8 = run_secagg_stream_soak(cohort=cohort, dim=dim, rounds=rounds)
+    # dense leg: small cohort — it exists to pin the dense-ring identity,
+    # not to re-measure throughput
+    dense = run_secagg_stream_soak(cohort=min(cohort, 512),
+                                   dim=min(dim, 2048), rounds=1,
+                                   codec="dense")
+
+    def sa_cfg(run_id, **extra):
+        e = {"secagg_method": "shamir"}
+        e.update(extra)
+        return Config(
+            dataset="synthetic", model="lr", training_type="cross_silo",
+            client_num_in_total=4, client_num_per_round=4, comm_round=2,
+            epochs=1, batch_size=16, learning_rate=0.1,
+            synthetic_train_size=256, synthetic_test_size=64,
+            partition_method="homo", frequency_of_the_test=0,
+            compute_dtype="float32", metrics_jsonl_path="", run_id=run_id,
+            enable_secagg=True, extra=e,
+        )
+
+    cfg_s = sa_cfg("bench_sa_stream", secagg_stream=True)
+    fedml_tpu.init(cfg_s)
+    ds = loader.load(cfg_s)
+    model = model_hub.create(cfg_s, ds.class_num)
+    t0 = time.perf_counter()
+    _, srv_stream = run_shamir_secagg_process_group(cfg_s, ds, model, timeout=300.0)
+    stream_wall = time.perf_counter() - t0
+    cfg_l = sa_cfg("bench_sa_legacy")
+    fedml_tpu.init(cfg_l)
+    _, srv_legacy = run_shamir_secagg_process_group(cfg_l, ds, model, timeout=300.0)
+    g_s = jax.device_get(srv_stream.aggregator.global_vars)
+    g_l = jax.device_get(srv_legacy.aggregator.global_vars)
+    e2e_bitwise = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(g_s),
+                        jax.tree_util.tree_leaves(g_l)))
+    return {
+        "cohort": cohort,
+        "dim": dim,
+        "rounds": rounds,
+        "soak_qsgd8_mask": qsgd8,
+        "soak_dense_mask": dense,
+        "throughput_ratio": qsgd8["throughput_ratio"],
+        "peak_buffered": max(qsgd8["peak_buffered"], dense["peak_buffered"]),
+        "bitwise_identity": bool(qsgd8["bitwise_identity"]
+                                 and dense["bitwise_identity"]),
+        "bytes_per_round_qsgd8_mask": qsgd8["bytes_per_round"],
+        "bytes_per_round_dense_mask": qsgd8["bytes_per_round_dense_mask"],
+        "bytes_per_round_legacy_int64": qsgd8["bytes_per_round_legacy_int64"],
+        "bytes_ratio_dense_vs_qsgd8": round(
+            qsgd8["bytes_per_round_dense_mask"]
+            / max(qsgd8["bytes_per_round"], 1), 3),
+        "e2e_stream_vs_legacy_bitwise": bool(e2e_bitwise),
+        "e2e_peak_buffered": int(srv_stream.aggregator.peak_buffered_updates),
+        "e2e_stream_wall_s": round(stream_wall, 3),
+    }
+
+
 def bench_llm(peak):
     import jax
     import jax.numpy as jnp
@@ -938,6 +1023,8 @@ def _run_one(mode):
         result = bench_federated_lora()
     elif mode == "multi_tenant":
         result = bench_multi_tenant()
+    elif mode == "secagg":
+        result = bench_secagg()
     else:
         result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
@@ -1048,6 +1135,41 @@ MULTI_TENANT_THROUGHPUT_RATIO_FLOOR = 0.5
 #: compilation cache).  A warm process must reach round 1 in at most half
 #: the cold wall clock, with every program served from the store.
 AOT_WARM_RATIO_CEILING = 0.5
+#: Streaming SecAgg (ISSUE 15) — platform-independent host-side floors.
+#: Throughput: versions/s with SecAgg on over off at the 10k simulated
+#: cohort; the secure path must keep at least half the plain throughput
+#: even with the soak's deliberately cheap proxy local step (real local
+#: training pushes the ratio toward 1).
+SECAGG_THROUGHPUT_RATIO_FLOOR = 0.5
+#: bytes/round of dense+mask (fixed-point u32) over quantize-then-mask
+#: (int8 grid + cohort carry bits): 4 over 3 bytes/element at a 10k
+#: cohort = 1.33x measured
+SECAGG_BYTES_RATIO_FLOOR = 1.25
+
+
+def _secagg_violations(res) -> list:
+    """Floor checks for the secagg section (shared by the full bench and
+    `--mode secagg`)."""
+    v = []
+    ratio = res.get("throughput_ratio")
+    if ratio is not None and ratio < SECAGG_THROUGHPUT_RATIO_FLOOR:
+        v.append(f"secagg on/off versions/s ratio {ratio} < floor "
+                 f"{SECAGG_THROUGHPUT_RATIO_FLOOR}")
+    bytes_ratio = res.get("bytes_ratio_dense_vs_qsgd8")
+    if bytes_ratio is not None and bytes_ratio < SECAGG_BYTES_RATIO_FLOOR:
+        v.append(f"secagg dense+mask/qsgd8+mask bytes ratio {bytes_ratio} "
+                 f"< floor {SECAGG_BYTES_RATIO_FLOOR}")
+    if res.get("peak_buffered", 0) > 2:
+        v.append(f"secagg soak peak buffered {res['peak_buffered']} > 2 "
+                 "(streaming masked fold not engaged)")
+    if res.get("e2e_peak_buffered", 0) > 2:
+        v.append(f"secagg e2e peak buffered {res['e2e_peak_buffered']} > 2")
+    if not res.get("bitwise_identity", False):
+        v.append("secagg streamed masked sum != exact unmasked sum "
+                 "(mod-field integer identity failed)")
+    if not res.get("e2e_stream_vs_legacy_bitwise", False):
+        v.append("secagg e2e streamed global != buffer-all global bitwise")
+    return v
 
 
 def _federated_lora_violations(res) -> list:
@@ -1097,6 +1219,8 @@ def _mode_violations(mode, result) -> list:
         return _federated_lora_violations(result)
     if mode == "multi_tenant":
         return _multi_tenant_violations(result)
+    if mode == "secagg":
+        return _secagg_violations(result)
     return []
 
 
@@ -1192,6 +1316,14 @@ def main():
     if _multi_tenant_violations(multi_tenant):
         # same one-retry policy as the other wall-clock floors
         multi_tenant = _subprocess_bench("multi_tenant")
+    # ISSUE-15 streaming SecAgg: masked uploads through the field-domain
+    # streaming fold at a 10k simulated cohort — on/off versions/s floor,
+    # peak buffered <= 2, streamed==exact integer identity, and the
+    # quantize-then-mask vs dense+mask bytes/round ratio
+    secagg = _subprocess_bench("secagg")
+    if _secagg_violations(secagg):
+        # same one-retry policy as the other wall-clock floors
+        secagg = _subprocess_bench("secagg")
     # ISSUE-7 cold_start: two fresh processes share one AOT program store +
     # compilation cache root; the first populates it, the second must
     # deserialize every program (misses == 0) and start in <= 0.5x the time
@@ -1315,6 +1447,7 @@ def main():
             f"!= final published version {serving.get('versions_published')}")
     violations += _federated_lora_violations(federated_lora)
     violations += _multi_tenant_violations(multi_tenant)
+    violations += _secagg_violations(secagg)
     pop_rss = population.get("rss_multiple")
     if pop_rss is not None and pop_rss > POPULATION_RSS_MULTIPLE_FLOOR:
         violations.append(
@@ -1356,6 +1489,7 @@ def main():
             "serving": serving,
             "federated_lora": federated_lora,
             "multi_tenant": multi_tenant,
+            "secagg": secagg,
             "aot": aot,
             "lint": lint_section,
         },

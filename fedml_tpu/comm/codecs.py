@@ -49,6 +49,13 @@ COMPRESSION_RATIO = obsreg.REGISTRY.gauge(
 #: codecs a payload leaf may carry (``raw`` is the identity)
 CODECS = ("raw", "qsgd8", "topk")
 
+#: secure-aggregation upload forms (ISSUE 15): masked field vectors on the
+#: minimal ring dtype.  ``secagg_dense`` = fixed-point over the M31 field
+#: (u32 wire); ``secagg_qsgd8`` = the quantize-then-mask composition (int8
+#: grid in a cohort-sized ring).  Accounted through the same payload
+#: counters so bytes/round trajectories cover the trusted path too.
+MASKED_CODECS = ("secagg_dense", "secagg_qsgd8")
+
 #: leaves below this element count stay raw: the qsgd8 block padding (1024
 #: elements) would expand them, and their bytes are noise at model scale
 DEFAULT_MIN_COMPRESS_ELEMS = 1024
@@ -156,10 +163,19 @@ def compress_pytree(tree, codec: Optional[str], *, key=None, residuals=None,
              "ratio": float(ratio_out)})
 
 
+def note_masked_payload(codec: str, wire_bytes: int, raw_bytes: int) -> None:
+    """Account one secure-aggregation upload (``codec`` from
+    :data:`MASKED_CODECS`): ``wire_bytes`` = the packed masked vector as
+    shipped, ``raw_bytes`` = the dense f32 equivalent."""
+    PAYLOAD_BYTES.inc(int(wire_bytes), codec=codec)
+    PAYLOAD_RAW_BYTES.inc(int(raw_bytes), codec=codec)
+    COMPRESSION_RATIO.set(raw_bytes / max(wire_bytes, 1), codec=codec)
+
+
 def payload_counters() -> dict:
     """Snapshot of the payload accounting (for BENCH json / tests)."""
     out = {}
-    for codec in CODECS[1:]:
+    for codec in CODECS[1:] + MASKED_CODECS:
         wire_b = PAYLOAD_BYTES.value(codec=codec)
         raw_b = PAYLOAD_RAW_BYTES.value(codec=codec)
         if wire_b or raw_b:

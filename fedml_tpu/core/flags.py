@@ -269,6 +269,20 @@ FLAGS: dict[str, FlagSpec] = _specs(
     FlagSpec("secagg_target_u", "int", None,
              "LightSecAgg surviving-client target; derived: privacy_t + 1."),
     FlagSpec("secagg_q_bits", "int", 16, "Secure-aggregation quantization bits."),
+    FlagSpec("secagg_stream", "bool", False,
+             "Streaming secure aggregation (ISSUE 15): masked uploads fold "
+             "one at a time into a running field total (peak buffered <= 2 "
+             "at any cohort size) and ship on the minimal ring dtype "
+             "(dense+mask u32 instead of int64; qsgd8+mask at int8 width + "
+             "cohort carry bits); dropout masks reconstructed and "
+             "subtracted once at finalize.  Unset = the historical "
+             "buffer-all protocol, wire byte-identical."),
+    FlagSpec("secagg_q8_frac_bits", "int", 7,
+             "Fractional bits of the quantize-then-mask int8 grid "
+             "(comm_compression=qsgd8 under secagg_stream): deltas quantize "
+             "to round(x * 2^bits) stochastically, clipped to [-127, 127]. "
+             "A CONFIG-SHARED scale — per-block adaptive qsgd8 scales "
+             "cannot decode a masked sum."),
     FlagSpec("fhe_key_seed", "int", None,
              "RLWE key seed (out-of-band in production); derived: "
              "random_seed * 7919 + 17."),

@@ -262,9 +262,12 @@ class AsyncFedMLServerManager(FedMLServerManager):
             if self.aggregator.fold(sender, msg, n_samples, is_delta, scale=scale):
                 ARRIVALS.inc(path="folded")
             else:
-                # exact-mode fallback (custom aggregate / LoRA / trust): the
-                # decay rides the weight, so a weight-sensitive aggregate
-                # still sees the staleness-discounted contribution
+                # exact-mode fallback (custom aggregate, or a trust pipeline
+                # that needs the stacked matrix — attack/defense/LDP; a
+                # central-DP-only pipeline STREAMS and lands its noise at
+                # each virtual round's finalize, ISSUE 15): the decay rides
+                # the weight, so a weight-sensitive aggregate still sees the
+                # staleness-discounted contribution
                 params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
                 self.aggregator.add_local_trained_result(
                     sender, params, n_samples * scale, is_delta=is_delta)

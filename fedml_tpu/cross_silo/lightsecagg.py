@@ -97,6 +97,16 @@ def secagg_params(cfg):
             f"server optimizer {cfg.federated_optimizer!r} needs per-client "
             "updates — use FedAvg with enable_secagg"
         )
+    from ..fl.algorithm import config_supports_associative_fold
+
+    if not config_supports_associative_fold(cfg):
+        # the masked field total is an associative fold — same protocol gate
+        # as the f32 streaming accumulator (fl/algorithm.py, ISSUE 15)
+        raise NotImplementedError(
+            "LightSecAgg's masked sum is a weight-associative fold; the "
+            "configured algorithm overrides aggregate() and does not "
+            "declare supports_associative_fold"
+        )
     return t, u, q_bits
 
 
@@ -116,12 +126,40 @@ class LSAAggregator(FedMLAggregator):
         self.model_dim = int(flat.size)
         self.d_pad = self.protocol.pad_len(self.model_dim)
         self.agg_mask_dict: dict[int, np.ndarray] = {}
+        # streaming masked folds (ISSUE 15, extra.secagg_stream): the masked
+        # model vectors — the O(cohort * d) half of the server state — fold
+        # one at a time into a field total; only the aggregate encoded masks
+        # (the protocol's decode inputs, U vectors of d/(U-T)) stay buffered.
+        # Flag unset -> the historical buffer-all path, bit-identical.
+        self.field_stream = bool(cfg_extra(cfg, "secagg_stream"))
+        self._facc = None
+        self._facc_folded = 0
 
     def add_local_trained_result(self, client_idx: int, masked_vec, sample_num: float) -> None:
         vec = np.asarray(masked_vec, dtype=np.int64)
         if vec.shape != (self.d_pad,):
             raise ValueError(f"masked vector shape {vec.shape} != ({self.d_pad},)")
+        if self.field_stream:
+            from ..parallel.stream_fold import FieldStreamAccumulator
+
+            if self._facc is None:
+                self._facc = FieldStreamAccumulator(
+                    [np.zeros(self.d_pad, np.int64)], self.protocol.p)
+            # buffered right now: the running total (once anything folded)
+            # plus this in-flight vector — the <= 2 acceptance bound
+            self.peak_buffered_updates = max(
+                self.peak_buffered_updates, (1 if self._facc_folded else 0) + 1)
+            self._facc.fold_leaf(0, vec)
+            self._facc_folded += 1
+            self.sample_num_dict[client_idx] = sample_num
+            self.flag_client_model_uploaded[client_idx] = True
+            return
         super().add_local_trained_result(client_idx, vec, sample_num)
+
+    def survivor_ids(self) -> list[int]:
+        """Clients whose masked vector is in this round's sum — maintained
+        by both the buffer-all and streaming paths."""
+        return sorted(self.flag_client_model_uploaded)
 
     def add_aggregate_encoded_mask(self, client_idx: int, agg_mask) -> None:
         self.agg_mask_dict[client_idx] = np.asarray(agg_mask, dtype=np.int64)
@@ -132,12 +170,19 @@ class LSAAggregator(FedMLAggregator):
     def aggregate(self, round_idx: int):
         """Reference ``aggregate_model_reconstruction`` (:132): field-sum the
         survivors' masked vectors, decode the sum of their masks from the
-        aggregate encoded masks, subtract, dequantize, uniform-average."""
-        active = sorted(self.model_dict.keys())
+        aggregate encoded masks, subtract, dequantize, uniform-average.
+
+        Under ``extra.secagg_stream`` the field sum already happened fold by
+        fold as uploads arrived; mod-field exactness makes the streamed
+        total BITWISE the buffer-all total."""
+        active = self.survivor_ids()
         p = self.protocol.p
-        total = np.zeros(self.d_pad, dtype=np.int64)
-        for i in active:
-            total = (total + self.model_dict[i]) % p
+        if self._facc is not None:
+            total = self._facc.host_sums()[0]
+        else:
+            total = np.zeros(self.d_pad, dtype=np.int64)
+            for i in active:
+                total = (total + self.model_dict[i]) % p
         # aggregate encoded masks are indexed by 0-based client index
         agg_shares = {cid - 1: v for cid, v in self.agg_mask_dict.items()}
         mask_sum = self.protocol.decode_aggregate_mask(agg_shares, self.d_pad)
@@ -149,6 +194,8 @@ class LSAAggregator(FedMLAggregator):
         self.sample_num_dict.clear()
         self.flag_client_model_uploaded.clear()
         self.agg_mask_dict.clear()
+        self._facc = None
+        self._facc_folded = 0
         return self.global_vars
 
 
@@ -202,7 +249,7 @@ class LSAServerManager(FedMLServerManager):
         _agg_lock."""
         self._runtime.cancel(self, "straggler")
         self._phase = "mask"
-        self.active_first = sorted(self.aggregator.model_dict.keys())
+        self.active_first = self.aggregator.survivor_ids()
         for cid in self.active_first:
             msg = Message(MSG_TYPE_S2C_ACTIVE_CLIENTS, 0, cid)
             msg.add_params(MSG_ARG_KEY_ACTIVE_CLIENTS, [int(c) for c in self.active_first])

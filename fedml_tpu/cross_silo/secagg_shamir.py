@@ -60,8 +60,11 @@ import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 
+from ..comm import codecs
 from ..comm.message import Message
+from ..core import rng
 from ..core.flags import cfg_extra
+from ..trust.secagg import stream as secagg_stream
 from ..trust.secagg.field import DEFAULT_PRIME, dequantize_from_field, quantize_to_field
 from ..trust.secagg.shamir import (
     masked_input,
@@ -94,6 +97,10 @@ MSG_ARG_KEY_SHARE_SOURCE = "share_source"
 MSG_ARG_KEY_ACTIVE_SET = "active_set"
 MSG_ARG_KEY_B_REVEALS = "b_reveals"
 MSG_ARG_KEY_SK_REVEALS = "sk_reveals"
+#: control-plane descriptor of a streaming masked upload (codec, ring_bits,
+#: frac_bits, length, delta) — present only when extra.secagg_stream is set,
+#: so the legacy wire stays byte-identical
+MSG_ARG_KEY_SECAGG_META = "secagg_meta"
 
 P = DEFAULT_PRIME
 DH_G = 5
@@ -138,21 +145,40 @@ def shamir_secagg_params(cfg):
     q_bits = int(cfg_extra(cfg, "secagg_q_bits"))
     if not (0 < t < n):
         raise ValueError(f"Shamir SecAgg needs 0 < T({t}) < N({n})")
+    # central DP composes with the STREAMING fold (ISSUE 15): the noise is
+    # added exactly once, to the unmasked aggregate at finalize — it never
+    # needs the individual updates SecAgg hides.  LDP (and everything else
+    # below) still does, and stays refused.
+    streaming_cdp_ok = bool(cfg_extra(cfg, "secagg_stream")) and (
+        getattr(cfg, "dp_solution_type", "ldp").lower() == "cdp")
     incompatible = [
         f for f in ("enable_attack", "enable_defense", "enable_dp", "enable_contribution", "enable_fhe")
-        if getattr(cfg, f, False)
+        if getattr(cfg, f, False) and not (f == "enable_dp" and streaming_cdp_ok)
     ]
     if incompatible:
         raise NotImplementedError(
             f"trust features {incompatible} operate on individual client "
             "updates, which SecAgg hides from the server by design; disable "
-            "them or disable enable_secagg"
+            "them or disable enable_secagg (central DP composes when "
+            "secagg_stream is set: noise lands once on the unmasked "
+            "aggregate at finalize)"
         )
     if getattr(cfg, "federated_optimizer", "FedAvg") not in ("FedAvg", "fedavg", "FedAvg_seq"):
         raise NotImplementedError(
             "SecAgg reconstruction yields only the uniform mean of the "
             "survivors' updates (reference sa_fedml_aggregator.py:182); "
             f"{cfg.federated_optimizer!r} needs per-client updates"
+        )
+    from ..fl.algorithm import config_supports_associative_fold
+
+    if not config_supports_associative_fold(cfg):
+        # the masked field total IS an associative fold — an algorithm whose
+        # aggregate is order- or set-sensitive cannot ride it (same protocol
+        # gate as the f32 streaming accumulator, fl/algorithm.py)
+        raise NotImplementedError(
+            "SecAgg's masked sum is a weight-associative fold; the "
+            "configured algorithm overrides aggregate() and does not "
+            "declare supports_associative_fold"
         )
     return t, q_bits
 
@@ -162,15 +188,34 @@ class SAAggregator(FedMLAggregator):
 
     def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
         super().__init__(cfg, model, sample_x, test_arrays, trust=trust)
-        # masked field vectors are not foldable f32 trees: the associative
+        # masked field vectors are not foldable f32 trees: the base f32
         # streaming path must NEVER engage here, whatever the comm flags say
-        # (regression-tested — the LoRA opt-in must not bypass this)
+        # (regression-tested — the LoRA opt-in must not bypass this).  The
+        # FIELD-domain streaming fold below (extra.secagg_stream) is this
+        # protocol's own fast path.
         self.stream_mode = False
         self._shard_fold = False
         self.t, self.q_bits = shamir_secagg_params(cfg)
         flat, self._unravel = jax.flatten_util.ravel_pytree(self.global_vars)
         self.model_dim = int(flat.size)
         self.n = cfg.client_num_in_total
+        # streaming masked folds (ISSUE 15): each arriving masked upload
+        # folds into a running field total — peak buffered <= 2 at any
+        # cohort size — and the masks come out once, at finalize.  Flag
+        # unset -> the historical buffer-all path, bit-identical.
+        self.field_stream = bool(cfg_extra(cfg, "secagg_stream"))
+        self.ring = secagg_stream.ring_for(
+            codecs.codec_from_config(cfg), self.n, q_bits=self.q_bits,
+            q8_frac_bits=int(cfg_extra(cfg, "secagg_q8_frac_bits")))
+        self._msum: Optional[secagg_stream.StreamingMaskedSum] = None
+        self._stream_is_delta = False
+        # central DP at finalize (streaming only; shamir_secagg_params
+        # refuses every other trust composition)
+        self._dp = None
+        if getattr(cfg, "enable_dp", False):
+            from ..trust.dp.dp import FedMLDifferentialPrivacy
+
+            self._dp = FedMLDifferentialPrivacy(cfg)
         self.s_pk_table: dict[int, int] = {}
         # reveals[v] = (b_reveals {u: y}, sk_reveals {u: y}) from survivor v
         self.reveals: dict[int, tuple[dict, dict]] = {}
@@ -195,6 +240,42 @@ class SAAggregator(FedMLAggregator):
             raise ValueError(f"masked vector shape {vec.shape} != ({self.model_dim},)")
         super().add_local_trained_result(client_idx, vec, sample_num)
 
+    def add_masked_upload(self, client_idx: int, packed, sample_num: float,
+                          meta: dict) -> None:
+        """Streaming path (extra.secagg_stream): unpack the wire-width
+        masked vector and fold it into the running field total IMMEDIATELY
+        — nothing cohort-sized is ever buffered.  The packed form is freed
+        as soon as the fold returns, so the peak is the total plus the one
+        in-flight upload."""
+        if client_idx in self.compromised:
+            log.warning(
+                "client %d rejoined after its s_sk was reconstructed; refusing "
+                "its upload (accepting would reveal BOTH of its secrets)",
+                client_idx,
+            )
+            return
+        if not self.ring.matches(meta):
+            log.warning("client %d masked upload ring %s != server %s; "
+                        "rejecting", client_idx, meta, self.ring.meta(0))
+            return
+        vec = secagg_stream.unpack_ring(
+            packed, self.ring.bits, int(meta.get("length", self.model_dim)))
+        if vec.shape != (self.model_dim,):
+            raise ValueError(f"masked vector shape {vec.shape} != ({self.model_dim},)")
+        if self._msum is None:
+            self._msum = secagg_stream.StreamingMaskedSum(self.model_dim, self.ring)
+        self._stream_is_delta = bool(meta.get("delta"))
+        self._msum.fold(vec)
+        self.sample_num_dict[client_idx] = sample_num
+        self.flag_client_model_uploaded[client_idx] = True
+        self.peak_buffered_updates = max(self.peak_buffered_updates,
+                                         self._msum.peak_buffered)
+
+    def survivor_ids(self) -> list[int]:
+        """Clients whose (masked) upload is in this round's sum — the one
+        ledger both the buffer-all and streaming paths maintain."""
+        return sorted(self.flag_client_model_uploaded)
+
     def add_reveal(self, sender: int, b_reveals: dict, sk_reveals: dict) -> None:
         self.reveals[int(sender)] = (
             {int(u): int(y) for u, y in b_reveals.items()},
@@ -208,10 +289,16 @@ class SAAggregator(FedMLAggregator):
         """Reference ``aggregate_model_reconstruction`` + ``aggregate_mask_
         reconstruction`` (``sa_fedml_aggregator.py:92-188``): decode survivors'
         b_u -> subtract self-masks; decode dropped s_sk_u -> cancel orphaned
-        pairwise masks; dequantize; uniform average."""
-        active = sorted(self.model_dict.keys())
+        pairwise masks; dequantize; uniform average.
+
+        With ``extra.secagg_stream`` the sum already exists — every upload
+        folded into the field total as it arrived — so finalize is just the
+        seed reconstruction (tiny scalars from the reveals), the unmask over
+        ONE vector, and an optional single central-DP noise draw.  The
+        mod-field math is exact, so the streamed result is BITWISE the
+        buffer-all result."""
+        active = self.survivor_ids()
         dropped = [u for u in range(1, self.n + 1) if u not in active]
-        masked = {u: self.model_dict[u] for u in active}
 
         self_seeds = {}
         for u in active:
@@ -232,15 +319,55 @@ class SAAggregator(FedMLAggregator):
                 s_uv = dh_agree(s_sk_u, self.s_pk_table[v])
                 dropped_pair_seeds[(u, v)] = derive_round_seed(s_uv, round_idx)
 
-        total = unmask_sum(masked, self_seeds, dropped_pair_seeds)
-        avg = dequantize_from_field(total, len(active), bits=self.q_bits)
-        avg = avg / max(len(active), 1)
+        if self._msum is not None:
+            total = self._msum.finalize(self_seeds, dropped_pair_seeds)
+            avg = dequantize_from_field(
+                total, len(active), p=self.ring.modulus, bits=self.ring.frac_bits)
+            avg = avg / max(len(active), 1)
+            if self._stream_is_delta:
+                # qsgd8 composition ships quantized DELTAS vs the round's
+                # broadcast global: the unmasked mean delta lands on it
+                old_flat, _ = jax.flatten_util.ravel_pytree(self.global_vars)
+                avg = np.asarray(old_flat, np.float64) + avg
+        else:
+            masked = {u: self.model_dict[u] for u in active}
+            total = unmask_sum(masked, self_seeds, dropped_pair_seeds)
+            avg = dequantize_from_field(total, len(active), bits=self.q_bits)
+            avg = avg / max(len(active), 1)
+        avg = self._apply_central_dp(avg, round_idx)
         self.global_vars = self._unravel(jnp.asarray(avg, jnp.float32))
         self.model_dict.clear()
         self.sample_num_dict.clear()
         self.flag_client_model_uploaded.clear()
         self.reveals.clear()
+        self._msum = None
+        self._stream_is_delta = False
         return self.global_vars
+
+    def _apply_central_dp(self, avg: np.ndarray, round_idx: int) -> np.ndarray:
+        """Central DP, EXACTLY ONCE, at finalize (ISSUE 15): clip the
+        aggregate's round delta and add calibrated noise on the Pallas RNG
+        path (``ops/pallas/noise.py`` — noise drawn from the round key, the
+        scale-and-add fused).  Engaged only when ``shamir_secagg_params``
+        admitted the enable_dp + secagg_stream + CDP composition."""
+        if self._dp is None or not self._dp.is_cdp_enabled():
+            return avg
+        from ..ops.pallas import noise as pallas_noise
+        from ..trust.dp.dp import gaussian_sigma
+
+        old_flat, _ = jax.flatten_util.ravel_pytree(self.global_vars)
+        delta = jnp.asarray(avg, jnp.float32) - jnp.asarray(old_flat, jnp.float32)
+        delta = self._dp.global_clip(delta)
+        flat = jnp.asarray(old_flat, jnp.float32) + delta
+        key = jax.random.fold_in(rng.round_key(self.root_key, round_idx), 0xCD9)
+        if self._dp.mechanism == "gaussian":
+            sigma = gaussian_sigma(self._dp.epsilon, self._dp.delta,
+                                   self._dp.sensitivity)
+            noised = pallas_noise.apply_gaussian_noise(
+                flat, key, sigma, interpret=jax.default_backend() != "tpu")
+        else:
+            noised = self._dp.add_global_noise(flat, key)
+        return np.asarray(noised, np.float64)
 
 
 class SAServerManager(FedMLServerManager):
@@ -311,11 +438,22 @@ class SAServerManager(FedMLServerManager):
         with self._agg_lock:
             if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) != self.round_idx or self._phase != "model":
                 return
-            self.aggregator.add_local_trained_result(
-                msg.get_sender_id(),
-                msg.get(md.MSG_ARG_KEY_MODEL_PARAMS),
-                float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES)),
-            )
+            meta = msg.get_control(MSG_ARG_KEY_SECAGG_META)
+            if meta is not None:
+                # streaming masked upload (extra.secagg_stream): folds into
+                # the field total right here — never buffered
+                self.aggregator.add_masked_upload(
+                    msg.get_sender_id(),
+                    msg.get(md.MSG_ARG_KEY_MODEL_PARAMS),
+                    float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES)),
+                    meta,
+                )
+            else:
+                self.aggregator.add_local_trained_result(
+                    msg.get_sender_id(),
+                    msg.get(md.MSG_ARG_KEY_MODEL_PARAMS),
+                    float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES)),
+                )
             # permanently-excluded (compromised) clients never count toward
             # the expectation — their uploads are refused by the aggregator
             expected = len([c for c in self.selected if c not in self.aggregator.compromised])
@@ -327,7 +465,7 @@ class SAServerManager(FedMLServerManager):
         ``_send_message_to_active_client`` :313).  Caller holds _agg_lock."""
         self._runtime.cancel(self, "straggler")
         self._phase = "reveal"
-        self.active_first = sorted(self.aggregator.model_dict.keys())
+        self.active_first = self.aggregator.survivor_ids()
         for cid in self.active_first:
             out = Message(MSG_TYPE_S2C_ACTIVE_SET, 0, cid)
             out.add_params(MSG_ARG_KEY_ACTIVE_SET, [int(c) for c in self.active_first])
@@ -397,6 +535,13 @@ class SAClientManager(ClientMasterManager):
         super().__init__(cfg, trainer, rank=rank, backend=backend)
         self.t, self.q_bits = shamir_secagg_params(cfg)
         self.n = cfg.client_num_in_total
+        # streaming masked uploads (ISSUE 15): quantize(-then-mask) into the
+        # cohort-sized ring and ship the minimal wire dtype; flag unset ->
+        # the historical int64 field vector, byte-identical
+        self.stream = bool(cfg_extra(cfg, "secagg_stream"))
+        self.ring = secagg_stream.ring_for(
+            codecs.codec_from_config(cfg), self.n, q_bits=self.q_bits,
+            q8_frac_bits=int(cfg_extra(cfg, "secagg_q8_frac_bits")))
         # secrets from OS entropy (reference seeds np.random with the RANK,
         # sa_fedml_client_manager.py:273 — making every secret public)
         self.c_sk, self.c_pk = dh_keypair()
@@ -506,15 +651,41 @@ class SAClientManager(ClientMasterManager):
         new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key, client_idx)
         self.rounds_trained += 1
         flat, _ = jax.flatten_util.ravel_pytree(new_vars)
-        x_field = quantize_to_field(np.asarray(flat), bits=self.q_bits)
         peer_seeds = {
             v: derive_round_seed(dh_agree(self.s_sk, self.pk_table[v][1]), round_idx)
             for v in self.pk_table if v != self.rank
         }
         self_seed = derive_round_seed(self.b_u, round_idx)
-        masked = masked_input(x_field, self.rank, peer_seeds, self_seed)
         reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, masked)
+        if self.stream:
+            ring = self.ring
+            if ring.codec == "qsgd8":
+                # quantize-then-mask (ISSUE 15): qsgd8's stochastic grid at
+                # the config-shared scale over the round's DELTA — small
+                # values, int8 width, masked sum exactly decodable
+                base_flat, _ = jax.flatten_util.ravel_pytree(params)
+                delta = np.asarray(flat, np.float64) - np.asarray(base_flat, np.float64)
+                q = secagg_stream.quantize_stochastic_int8(
+                    delta, ring.frac_bits,
+                    [int(self.cfg.random_seed), int(round_idx), int(self.rank)])
+                x_field = np.mod(q, ring.modulus)
+                is_delta = True
+            else:
+                x_field = quantize_to_field(np.asarray(flat), bits=self.q_bits)
+                is_delta = False
+            masked = secagg_stream.mask_vector(x_field, self.rank, peer_seeds,
+                                               self_seed, ring.modulus)
+            packed = secagg_stream.pack_ring(masked, ring.bits)
+            codecs.note_masked_payload(
+                f"secagg_{ring.codec}", packed.nbytes, np.asarray(flat).nbytes)
+            reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, packed)
+            meta = ring.meta(int(x_field.size))
+            meta["delta"] = is_delta
+            reply.add_params(MSG_ARG_KEY_SECAGG_META, meta)
+        else:
+            x_field = quantize_to_field(np.asarray(flat), bits=self.q_bits)
+            masked = masked_input(x_field, self.rank, peer_seeds, self_seed)
+            reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, masked)
         reply.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
         reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
         self.send_message(reply)

@@ -1,0 +1,182 @@
+"""Simulated-cohort soak for streaming secure aggregation (ISSUE 15).
+
+Drives the SAME server-side machinery the Shamir protocol rides —
+:class:`~fedml_tpu.trust.secagg.stream.StreamingMaskedSum` over the
+``FieldStreamAccumulator``, :func:`~fedml_tpu.trust.secagg.shamir.
+masked_input` masking, seed-reconstructed unmask at finalize — at cohort
+sizes no thread-per-client harness reaches (the 10k-cohort population
+rounds the buffer-all gate used to exclude from secure aggregation).
+
+Mask topology: the full N^2 pairwise graph of the cross-silo protocol is
+O(N^2 * d) PRG work — at 10k clients that is the simulation's wall, not the
+server's.  The soak uses the k-regular ring topology of scalable SecAgg
+(Bell et al., CCS'20: each client pair-masks with k neighbors per side),
+which changes NOTHING server-side — the fold is the fold, and unmask just
+receives fewer pair seeds.  Dropout reconstruction is exercised both ways:
+``drop_before`` clients complete setup but never upload (their orphaned
+pair masks are cancelled from reconstructed seeds), ``drop_after`` clients
+upload but vanish before the reveal phase (their self-masks come out of
+OTHER clients' Shamir shares — the harness models the reconstruction as
+having succeeded, which is exactly what the real reveal flow yields).
+
+Each client's "local training" is a deliberately cheap deterministic proxy
+(one (PROXY_HIDDEN x d) matvec): the on/off throughput ratio is an OVERHEAD
+bound — real local training is orders of magnitude heavier, so the measured
+ratio is a floor on what a deployment would see.  What the soak asserts
+hard is the headline: peak buffered <= 2 at any cohort, and the streamed
+masked sum == the exact unmasked sum of the quantized updates, as an
+INTEGER identity (mod-field exactness, no FMA tolerance).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..trust.secagg import stream as secagg_stream
+from ..trust.secagg.field import quantize_to_field
+from .secagg_shamir import derive_round_seed
+
+__all__ = ["run_secagg_stream_soak"]
+
+#: hidden width / step count of the proxy local train (see module
+#: docstring): 16 matvec steps ~ 0.5 ms/client — still orders of magnitude
+#: below real local training, so the measured on/off ratio UNDERSTATES a
+#: deployment's
+PROXY_HIDDEN = 64
+PROXY_STEPS = 16
+
+
+def _neighbors(u: int, cohort: int, k: int) -> list[int]:
+    """k-regular ring neighborhood of client ``u`` (1-based ids)."""
+    out = []
+    for off in range(1, k + 1):
+        out.append((u - 1 + off) % cohort + 1)
+        out.append((u - 1 - off) % cohort + 1)
+    return sorted(set(out) - {u})
+
+
+def _pair_seed(u: int, v: int, round_idx: int) -> int:
+    lo, hi = min(u, v), max(u, v)
+    return derive_round_seed(lo * 1_000_003 + hi, round_idx)
+
+
+def _self_seed(u: int, round_idx: int) -> int:
+    return derive_round_seed(0xB00000 + u, round_idx)
+
+
+def _proxy_update(w: np.ndarray, u: int, round_idx: int, dim: int,
+                  seed: int) -> np.ndarray:
+    """Deterministic stand-in for a client's local delta: a short loop of
+    matvec steps so both the secure and plain paths carry the per-client
+    compute cost every real round has."""
+    rng = np.random.default_rng([seed, round_idx, u])
+    x = rng.standard_normal(dim).astype(np.float32)
+    d = np.zeros(dim, np.float32)
+    for _ in range(PROXY_STEPS):
+        h = np.tanh(w @ (x + d))
+        d = d + 0.01 * (w.T @ h) / PROXY_HIDDEN
+    return (d + 0.001 * x).astype(np.float32)
+
+
+def run_secagg_stream_soak(cohort: int = 10_000, dim: int = 4096,
+                           rounds: int = 2, neighbors: int = 2,
+                           codec: str = "qsgd8", frac_bits: int = 7,
+                           q_bits: int = 16, drop_before_frac: float = 0.001,
+                           drop_after_frac: float = 0.001,
+                           seed: int = 0) -> dict:
+    """One soak: ``rounds`` streamed secure rounds at ``cohort`` clients vs
+    the same rounds with SecAgg off (plain f32 streaming fold of the same
+    proxy updates).  Returns the measured dict (see bench.py secagg)."""
+    ring = secagg_stream.ring_for(
+        codec if codec == "qsgd8" else None, cohort,
+        q_bits=q_bits, q8_frac_bits=frac_bits)
+    id_rng = np.random.default_rng([seed, 0xD07])
+    ids = np.arange(1, cohort + 1)
+    n_db = int(round(cohort * drop_before_frac))
+    n_da = int(round(cohort * drop_after_frac))
+    struck = id_rng.choice(ids, size=n_db + n_da, replace=False)
+    drop_before = set(int(u) for u in struck[:n_db])
+    drop_after = set(int(u) for u in struck[n_db:])
+    w_proxy = np.random.default_rng([seed, 0x17]).standard_normal(
+        (PROXY_HIDDEN, dim)).astype(np.float32) / np.sqrt(dim)
+
+    def quantize(x: np.ndarray, u: int, r: int) -> np.ndarray:
+        if ring.codec == "qsgd8":
+            q = secagg_stream.quantize_stochastic_int8(
+                x, ring.frac_bits, [seed, r, u])
+            return np.mod(q, ring.modulus)
+        return quantize_to_field(x, p=ring.modulus, bits=ring.frac_bits)
+
+    secure_s = 0.0
+    plain_s = 0.0
+    peak = 0
+    bitwise = True
+    uploaded: list[int] = []
+    for r in range(rounds):
+        # ---- SecAgg ON: quantize -> mask -> streamed fold -> unmask ----
+        msum = secagg_stream.StreamingMaskedSum(dim, ring)
+        expect = np.zeros(dim, np.int64)  # oracle, untimed
+        uploaded = [int(u) for u in ids if u not in drop_before]
+        t0 = time.perf_counter()
+        for u in uploaded:
+            upd = _proxy_update(w_proxy, u, r, dim, seed)
+            xf = quantize(upd, u, r)
+            peers = {v: _pair_seed(u, v, r)
+                     for v in _neighbors(u, cohort, neighbors)}
+            masked = secagg_stream.mask_vector(xf, u, peers, _self_seed(u, r),
+                                               ring.modulus)
+            msum.fold(masked)
+            t_oracle = time.perf_counter()
+            expect += xf
+            t0 += time.perf_counter() - t_oracle  # oracle time excluded
+        self_seeds = {u: _self_seed(u, r) for u in uploaded}
+        dropped_pairs = {
+            (u, v): _pair_seed(u, v, r)
+            for u in drop_before
+            for v in _neighbors(u, cohort, neighbors) if v not in drop_before
+        }
+        total = msum.finalize(self_seeds, dropped_pairs)
+        secure_s += time.perf_counter() - t0
+        peak = max(peak, msum.peak_buffered)
+        half = ring.modulus // 2
+        exp_mod = np.mod(expect, ring.modulus)
+        exp_signed = np.where(exp_mod > half, exp_mod - ring.modulus, exp_mod)
+        bitwise = bitwise and bool(np.array_equal(total, exp_signed))
+
+        # ---- SecAgg OFF: the same updates through the plain f32 fold ----
+        from ..parallel.stream_fold import HostStreamAccumulator
+
+        acc = HostStreamAccumulator([np.zeros(dim, np.float32)])
+        t0 = time.perf_counter()
+        for u in uploaded:
+            upd = _proxy_update(w_proxy, u, r, dim, seed)
+            acc.fold_leaf(0, 1.0, upd)
+        acc.finalize([np.zeros(dim, np.float32)], 0.0, float(len(uploaded)))
+        plain_s += time.perf_counter() - t0
+
+    versions_on = rounds / max(secure_s, 1e-9)
+    versions_off = rounds / max(plain_s, 1e-9)
+    bytes_round = ring.wire_nbytes(dim) * len(uploaded)
+    dense_ring = secagg_stream.ring_for(None, cohort, q_bits=q_bits,
+                                        q8_frac_bits=frac_bits)
+    return {
+        "cohort": int(cohort),
+        "dim": int(dim),
+        "rounds": int(rounds),
+        "codec": ring.codec,
+        "ring_bits": int(ring.bits),
+        "neighbors": int(neighbors),
+        "dropped_before": len(drop_before),
+        "dropped_after": len(drop_after),
+        "peak_buffered": int(peak),
+        "bitwise_identity": bool(bitwise),
+        "versions_per_sec_on": round(versions_on, 3),
+        "versions_per_sec_off": round(versions_off, 3),
+        "throughput_ratio": round(versions_on / max(versions_off, 1e-9), 3),
+        "bytes_per_round": int(bytes_round),
+        "bytes_per_round_dense_mask": int(dense_ring.wire_nbytes(dim)
+                                          * len(uploaded)),
+        "bytes_per_round_legacy_int64": int(8 * dim * len(uploaded)),
+    }

@@ -168,13 +168,21 @@ class FedMLAggregator:
         buffered-async server asks for it AND the algorithm declares its
         aggregate a weight-associative fold AND no trust pipeline needs the
         stacked client models — otherwise the exact buffer-all path stays
-        reference-bit-exact.  Shared by the base __init__ and subclasses that
-        skip it (LoRAAggregator); requires ``self.algorithm``/``self.trust``
-        to be set."""
+        reference-bit-exact.  A trust pipeline that only adds CENTRAL DP
+        (``TrustPipeline.supports_streaming``, ISSUE 15) no longer forces
+        exact mode: its one hook fires at finalize, on the aggregate the
+        fold already produced bitwise.  Attack/defense/LDP configurations
+        (and the FHE/SecAgg aggregator subclasses, which pin stream_mode
+        False) still buffer exactly.  Shared by the base __init__ and
+        subclasses that skip it (LoRAAggregator); requires
+        ``self.algorithm``/``self.trust`` to be set."""
+        trust_streams = self.trust is None or (
+            hasattr(self.trust, "supports_streaming")
+            and self.trust.supports_streaming())
         self.stream_mode = bool(
             (codecs.codec_from_config(cfg) or cfg_extra(cfg, "streaming_aggregation")
              or cfg_extra(cfg, "async_aggregation"))
-            and self.trust is None
+            and trust_streams
             and self.algorithm.supports_associative_fold()
         )
         # sharded fold (extra.server_shard_fold): the accumulator (and the
@@ -373,6 +381,15 @@ class FedMLAggregator:
         new_global, self.server_state = self.algorithm.server_update(
             self.global_vars, self.server_state, agg, round_idx
         )
+        if self.trust is not None:
+            # trust on the fast path (ISSUE 15): a streaming-compatible
+            # pipeline (central DP only) fires its finalize hook ONCE here,
+            # with the same round key the buffer-all path uses — clip +
+            # noise land on an aggregate the fold produced bitwise, so
+            # streaming-CDP == exact-CDP bitwise
+            rkey = rng.round_key(self.root_key, round_idx)
+            new_global = self.trust.on_after_aggregation(
+                new_global, self.global_vars, rkey)
         self.global_vars = new_global
         self._reset_round()
         return self.global_vars

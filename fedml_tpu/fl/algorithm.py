@@ -80,7 +80,12 @@ class FedAlgorithm:
         is the capability gate for the cross-silo streaming accumulator and
         the buffered-async server (``FedMLAggregator.fold``), which would
         silently compute the wrong thing for an order- or set-sensitive
-        ``aggregate`` (trimmed means, coordinate medians, Krum...).  A
+        ``aggregate`` (trimmed means, coordinate medians, Krum...).  The
+        SAME declaration gates the secure-aggregation protocols (ISSUE 15):
+        pairwise-mask SecAgg is a mod-field SUM — associative by
+        construction — so masked uploads ride a field-domain sibling of the
+        f32 fold (``parallel.stream_fold.FieldStreamAccumulator``), and an
+        algorithm that cannot fold cannot be secure-aggregated either.  A
         subclass that overrides ``aggregate`` with another associative form
         may opt back in by overriding this to True."""
         return type(self).aggregate is FedAlgorithm.aggregate
@@ -90,6 +95,17 @@ class FedAlgorithm:
 
     def server_update(self, global_variables, server_state, agg, round_idx):
         return agg, server_state
+
+
+def config_supports_associative_fold(cfg) -> bool:
+    """Whether ``cfg``'s algorithm declares its aggregate weight-associative
+    — the config-level form of :meth:`FedAlgorithm.supports_associative_
+    fold`, used by the secure-aggregation gates (``cross_silo/secagg_*``)
+    before any model exists."""
+    from ..algorithms import create as create_algorithm, hparams_from_config
+
+    algo = create_algorithm(cfg, hparams_from_config(cfg, steps_per_epoch=1))
+    return bool(algo.supports_associative_fold())
 
 
 def make_server_optimizer(hp: HParams) -> optax.GradientTransformation:

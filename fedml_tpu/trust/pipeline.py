@@ -44,6 +44,17 @@ class TrustPipeline:
         (cross-round family); the engine then threads it as a round argument."""
         return self.defense is not None and hasattr(self.defense, "set_history")
 
+    def supports_streaming(self) -> bool:
+        """True when the pipeline never needs the STACKED per-client matrix
+        — attacks and defenses inspect/transform individual contributions,
+        and LDP noises each client's update, so any of them forces the
+        buffer-all path; central DP only touches the finalized aggregate
+        (hook 3), which the streaming fold applies once at finalize
+        (ISSUE 15).  The cross-silo servers consult this to keep trust on
+        the associative fast path instead of forcing exact mode."""
+        return (self.attacker is None and self.defense is None
+                and (self.dp is None or not self.dp.is_ldp_enabled()))
+
     # -- hook 1: on client outputs (attack simulation + LDP) -----------------
     def on_client_outputs(self, contribs, weights, sampled_idx, global_vars, key):
         run_attack = self.attacker is not None and self.attacker.is_model_attack()

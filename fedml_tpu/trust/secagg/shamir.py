@@ -68,6 +68,18 @@ def unmask_sum(masked: dict[int, np.ndarray], self_seeds: dict[int, int],
     total = np.zeros(d, dtype=np.int64)
     for i in ids:
         total = (total + masked[i]) % p
+    return unmask_streamed(total, self_seeds, dropped_pair_seeds, p)
+
+
+def unmask_streamed(total: np.ndarray, self_seeds: dict[int, int],
+                    dropped_pair_seeds: dict[tuple[int, int], int],
+                    p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Unmask a PRE-SUMMED field total: the streaming-fold form of
+    :func:`unmask_sum` — the masked inputs folded one at a time into
+    ``total`` as they arrived, so only the seed reconstruction (tiny
+    scalars) happens at finalize, never a cohort-sized buffer."""
+    total = np.asarray(total, np.int64) % p
+    d = total.shape[0]
     for i, b in self_seeds.items():
         total = (total - pairwise_mask(b, d, p)) % p
     for (i, j), s in dropped_pair_seeds.items():

@@ -1,0 +1,274 @@
+"""Streaming pairwise-mask secure aggregation — the field-domain fast path.
+
+Pairwise-mask SecAgg is a SUM over a modular ring, i.e. associative: masked
+uploads can fold one at a time into a running field total with peak buffered
+<= 2 at any cohort size, and the masks come out ONCE at finalize (survivors'
+self-masks subtracted, dropped clients' orphaned pair masks cancelled from
+their Shamir-reconstructed seeds) — never by re-buffering the cohort.  This
+module holds the codec- and server-side primitives shared by the Shamir
+cross-silo protocol (``cross_silo/secagg_shamir.py``) and the simulated-
+cohort soak (``cross_silo/secagg_soak.py``):
+
+- **Ring sizing** (:func:`ring_bits_for`): the masking ring is sized to the
+  quantizer's value width plus the cohort's carry headroom, so the modular
+  sum of every upload is EXACT — ``streaming masked sum == exact unmasked
+  sum`` is an integer identity, not an FMA-tolerance claim.
+- **Minimal wire dtypes** (:func:`pack_ring`/:func:`unpack_ring`): masked
+  field elements ship as the smallest unsigned dtype that holds the ring
+  (u8/u16/u32, plus a packed 3-byte form for rings up to 2^24) instead of
+  the historical int64 — dense+mask drops 8 -> 4 bytes/element for free.
+- **Quantize-then-mask** (:func:`quantize_stochastic_int8`): the qsgd8
+  composition.  Per-block adaptive scales (the plain-wire qsgd8 codec) are
+  incompatible with additive masking — the server would need each client's
+  scales to unscale a masked SUM it cannot decompose — so the secure form
+  uses qsgd8's stochastic-rounding grid at a FIXED, config-shared scale
+  (``2^-frac_bits``), which keeps the sum exact in the ring and the upload
+  at int8 width.  ``comm_compression=qsgd8`` and SecAgg stack instead of
+  excluding each other.
+- :class:`StreamingMaskedSum`: the server-side fold.  Wraps the
+  :class:`~fedml_tpu.parallel.stream_fold.FieldStreamAccumulator` (the
+  field-domain sibling of the f32 streaming accumulator every other fold
+  rides) and tracks the peak-buffered bound.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from .field import DEFAULT_PRIME, dequantize_from_field
+
+__all__ = [
+    "DENSE_RING_BITS",
+    "MaskedRing",
+    "StreamingMaskedSum",
+    "mask_vector",
+    "pack_ring",
+    "quantize_stochastic_int8",
+    "ring_bits_for",
+    "ring_for",
+    "ring_mask",
+    "unmask_ring_total",
+    "unpack_ring",
+]
+
+#: the dense (full-precision fixed-point) path keeps the historical prime
+#: field M31 — its quantize/unmask math stays bit-identical to the buffered
+#: protocol; only the wire width shrinks (int64 -> u32)
+DENSE_RING_BITS = 31
+
+#: int8-grid value width of the qsgd8 composition (values in [-127, 127])
+Q8_VALUE_BITS = 8
+
+
+def ring_bits_for(value_bits: int, n_clients: int) -> int:
+    """Bits of the power-of-two masking ring for sums of ``n_clients``
+    values of ``value_bits`` signed width: the true sum must stay strictly
+    inside (-ring/2, ring/2) so the centered decode is exact."""
+    return value_bits + int(math.ceil(math.log2(max(int(n_clients), 1)))) + 1
+
+
+class MaskedRing:
+    """One masking ring: modulus, wire width, and the quantizer it carries.
+
+    ``codec`` is ``"dense"`` (fixed-point at ``frac_bits`` over the M31
+    prime field — the historical math) or ``"qsgd8"`` (stochastic int8 grid
+    at ``frac_bits`` over a cohort-sized power-of-two ring)."""
+
+    __slots__ = ("codec", "modulus", "bits", "frac_bits", "n_clients")
+
+    def __init__(self, codec: str, n_clients: int, frac_bits: int):
+        self.codec = str(codec)
+        self.n_clients = int(n_clients)
+        self.frac_bits = int(frac_bits)
+        if self.codec == "dense":
+            self.bits = DENSE_RING_BITS
+            self.modulus = DEFAULT_PRIME
+        elif self.codec == "qsgd8":
+            self.bits = ring_bits_for(Q8_VALUE_BITS, n_clients)
+            self.modulus = 1 << self.bits
+        else:
+            raise ValueError(f"unknown secagg stream codec {self.codec!r}")
+
+    def meta(self, length: int) -> dict:
+        """Control-plane description of an upload (cross-checked server-side
+        so a ring mismatch is a loud reject, not silent corruption)."""
+        return {"codec": self.codec, "ring_bits": int(self.bits),
+                "frac_bits": int(self.frac_bits), "length": int(length)}
+
+    def matches(self, meta: dict) -> bool:
+        return (meta.get("codec") == self.codec
+                and int(meta.get("ring_bits", -1)) == self.bits
+                and int(meta.get("frac_bits", -1)) == self.frac_bits)
+
+    def wire_nbytes(self, length: int) -> int:
+        return length * (1 if self.bits <= 8 else
+                         2 if self.bits <= 16 else
+                         3 if self.bits <= 24 else 4)
+
+
+def ring_for(codec: Optional[str], n_clients: int, *, q_bits: int,
+             q8_frac_bits: int) -> MaskedRing:
+    """The ring a config implies: ``comm_compression=qsgd8`` selects the
+    quantize-then-mask composition, anything else the dense fixed-point
+    field (``q_bits`` fractional bits, the historical ``secagg_q_bits``)."""
+    if codec == "qsgd8":
+        return MaskedRing("qsgd8", n_clients, q8_frac_bits)
+    return MaskedRing("dense", n_clients, q_bits)
+
+
+def quantize_stochastic_int8(flat: np.ndarray, frac_bits: int, seed) -> np.ndarray:
+    """f32 vector -> int8-range integers on the fixed grid ``2^-frac_bits``
+    with unbiased stochastic rounding (``E[floor(x*s + u)] = x*s`` for
+    ``u ~ U[0,1)`` — the same rounding rule as the qsgd8 Pallas kernel,
+    host-side and at a shared scale so masked sums stay decodable).
+    Values beyond the grid clip to [-127, 127]."""
+    scaled = np.asarray(flat, np.float64) * float(1 << int(frac_bits))
+    u = np.random.default_rng(seed).random(scaled.shape)
+    q = np.floor(scaled + u)
+    return np.clip(q, -127, 127).astype(np.int64)
+
+
+def dequantize_sum(total_signed: np.ndarray, ring: MaskedRing,
+                   n_summands: int) -> np.ndarray:
+    """Centered ring total -> float mean over ``n_summands`` uploads."""
+    return (dequantize_from_field(total_signed, n_summands, p=ring.modulus,
+                                  bits=ring.frac_bits)
+            / max(int(n_summands), 1)).astype(np.float64)
+
+
+# -- mask expansion -----------------------------------------------------------
+#
+# The legacy buffer-all protocol expands masks with MT19937
+# (``shamir.pairwise_mask``) and keeps doing so.  The streaming protocol
+# derives the SAME per-round seeds (the secrets Shamir protects) but expands
+# them through PCG64: the server regenerates O(cohort) mask vectors at
+# finalize, and MT19937 state setup makes that the finalize wall (~4x
+# slower than PCG64 at 4k elements).  Both ends of a run are gated by the
+# same ``secagg_stream`` flag, so the PRG is a protocol constant, never
+# mixed within a round.
+
+def ring_mask(seed: int, d: int, modulus: int) -> np.ndarray:
+    """Deterministic mask vector over the ring from a shared seed (the
+    streaming protocol's PRG — see note above)."""
+    return np.random.default_rng(int(seed) % (2**31)).integers(
+        0, int(modulus), size=d, dtype=np.int64)
+
+
+def mask_vector(x_field: np.ndarray, client_id: int, peer_seeds: dict,
+                self_seed: int, modulus: int) -> np.ndarray:
+    """The SecAgg masking equation over the ring (streaming form of
+    ``shamir.masked_input``): ``y = x + PRG(b) + sum_{j>i} PRG(s_ij)
+    - sum_{j<i} PRG(s_ij)  (mod ring)``."""
+    d = len(x_field)
+    y = (np.asarray(x_field, np.int64) + ring_mask(self_seed, d, modulus)) % modulus
+    for j, s in peer_seeds.items():
+        m = ring_mask(s, d, modulus)
+        if j > client_id:
+            y = (y + m) % modulus
+        elif j < client_id:
+            y = (y - m) % modulus
+    return y
+
+
+def unmask_ring_total(total: np.ndarray, self_seeds: dict,
+                      dropped_pair_seeds: dict, modulus: int) -> np.ndarray:
+    """Unmask a pre-summed ring total (streaming form of
+    ``shamir.unmask_streamed``, same sign conventions)."""
+    total = np.asarray(total, np.int64) % modulus
+    d = total.shape[0]
+    for _u, b in self_seeds.items():
+        total = (total - ring_mask(b, d, modulus)) % modulus
+    for (i, j), s in dropped_pair_seeds.items():
+        m = ring_mask(s, d, modulus)
+        # survivor j's upload carries the uncancelled half of the (i, j)
+        # pair mask: for j > i it added -m, for j < i it added +m
+        if j > i:
+            total = (total + m) % modulus
+        else:
+            total = (total - m) % modulus
+    return total
+
+
+# -- wire packing -------------------------------------------------------------
+
+def pack_ring(vec: np.ndarray, bits: int) -> np.ndarray:
+    """Field elements in [0, 2^bits) -> the smallest little-endian unsigned
+    wire array that holds them (u8 / u16 / packed-3-byte / u32)."""
+    v = np.asarray(vec, np.int64)
+    if bits <= 8:
+        return v.astype("<u1")
+    if bits <= 16:
+        return v.astype("<u2")
+    if bits <= 24:
+        quads = np.ascontiguousarray(v.astype("<u4")).view(np.uint8)
+        return np.ascontiguousarray(quads.reshape(-1, 4)[:, :3]).reshape(-1)
+    if bits <= 32:
+        return v.astype("<u4")
+    raise ValueError(f"ring of {bits} bits exceeds the 32-bit wire limit")
+
+
+def unpack_ring(raw: np.ndarray, bits: int, length: int) -> np.ndarray:
+    """Inverse of :func:`pack_ring` -> int64 field elements."""
+    a = np.asarray(raw)
+    if bits <= 8 or bits <= 16:
+        out = a.view(f"<u{1 if bits <= 8 else 2}").astype(np.int64)
+    elif bits <= 24:
+        trip = a.view(np.uint8).reshape(-1, 3)
+        quads = np.zeros((trip.shape[0], 4), np.uint8)
+        quads[:, :3] = trip
+        out = quads.reshape(-1).view("<u4").astype(np.int64)
+    elif bits <= 32:
+        out = a.view("<u4").astype(np.int64)
+    else:
+        raise ValueError(f"ring of {bits} bits exceeds the 32-bit wire limit")
+    if out.shape[0] != int(length):
+        raise ValueError(f"packed length {out.shape[0]} != declared {length}")
+    return out
+
+
+# -- the server-side streaming fold -------------------------------------------
+
+class StreamingMaskedSum:
+    """Fold masked field vectors one at a time; unmask ONCE at finalize.
+
+    Rides the :class:`~fedml_tpu.parallel.stream_fold.FieldStreamAccumulator`
+    — lazy modular reduction (int64 headroom carries ~2^63/modulus folds
+    before a reduce, far past any cohort), so a fold costs one vector add.
+    ``peak_buffered`` counts what the <=2 acceptance bound tracks: the
+    running total plus the one in-flight upload being folded."""
+
+    def __init__(self, dim: int, ring: MaskedRing):
+        from ...parallel.stream_fold import FieldStreamAccumulator
+
+        self.ring = ring
+        self.dim = int(dim)
+        self._acc = FieldStreamAccumulator(
+            [np.zeros(self.dim, np.int64)], ring.modulus)
+        self.folded = 0
+        self.peak_buffered = 0
+
+    def fold(self, vec: np.ndarray) -> None:
+        v = np.asarray(vec, np.int64)
+        if v.shape != (self.dim,):
+            raise ValueError(f"masked vector shape {v.shape} != ({self.dim},)")
+        self.peak_buffered = max(self.peak_buffered,
+                                 (1 if self.folded else 0) + 1)
+        self._acc.fold_leaf(0, v)
+        self.folded += 1
+
+    def masked_total(self) -> np.ndarray:
+        """The reduced field total of everything folded so far."""
+        return self._acc.host_sums()[0]
+
+    def finalize(self, self_seeds: dict, dropped_pair_seeds: dict) -> np.ndarray:
+        """Unmask the streamed total (centered signed int64): subtract every
+        survivor's reconstructed self-mask, cancel the orphaned halves of
+        dropped clients' pair masks — the same reconstruction the buffered
+        protocol ran, minus the cohort-sized buffer."""
+        total = unmask_ring_total(self.masked_total(), self_seeds,
+                                  dropped_pair_seeds, self.ring.modulus)
+        half = self.ring.modulus // 2
+        return np.where(total > half, total - self.ring.modulus, total)
