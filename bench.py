@@ -419,6 +419,42 @@ def bench_async_soak():
     )
 
 
+def bench_slo():
+    """SLO watchdog on a clean leg (ISSUE 16): the buffered-async soak with
+    a declarative SLO suite live on the server's timer wheel — thresholds
+    generous enough that a HEALTHY run cannot breach them.  The guarded
+    numbers: the engine actually ticked (evaluations > 0) and recorded ZERO
+    breaches — a breach here is either a real regression or a broken
+    default, both of which must fail the bench, not pass silently.
+
+    Platform independent (host-side server path + registry snapshots)."""
+    from fedml_tpu.cross_silo.async_soak import run_soak
+
+    specs = {
+        # streaming fold keeps peak buffered <= 2; 64 is "the fold broke"
+        "buffered_peak": {"metric": "fedml_crosssilo_buffered_updates_peak",
+                          "stat": "value", "op": "<=", "threshold": 64},
+        # fold lag p95 in the seconds, not minutes
+        "fold_lag_p95": {"metric": "fedml_async_fold_lag_seconds",
+                         "stat": "p95", "op": "<=", "threshold": 120.0},
+        # dedup pressure: re-uploads must stay a small fraction of arrivals
+        "dedup_ratio": {"metric": "fedml_crosssilo_uploads_deduped_total",
+                        "per": "fedml_async_arrivals_total",
+                        "stat": "value", "op": "<=", "threshold": 0.9},
+        # exercises the rate stat (two-tick delta) without ever firing
+        "versions_rate": {"metric": "fedml_async_virtual_rounds_total",
+                          "stat": "rate", "op": ">=", "threshold": 0.0},
+    }
+    res = run_soak(
+        n_clients=int(os.environ.get("BENCH_SLO_CLIENTS", "2000")),
+        concurrency=256, buffer_k=32,
+        versions=int(os.environ.get("BENCH_SLO_VERSIONS", "10")),
+        drop_prob=0.02, latency_mean_s=0.003, redispatch_timeout_s=2.0,
+        seed=0, timeout_s=600.0,
+        extra_flags={"slo_specs": specs, "slo_interval_s": 0.2})
+    return res
+
+
 def bench_chaos():
     """Crash recovery under chaos (ISSUE 10): the same buffered-async shape
     run twice — CLEAN (no journal, no chaos) and KILL-AND-RECOVER (recovery
@@ -1017,6 +1053,8 @@ def _run_one(mode):
         result = bench_async_soak()
     elif mode == "chaos":
         result = bench_chaos()
+    elif mode == "slo":
+        result = bench_slo()
     elif mode == "serving":
         result = bench_serving()
     elif mode == "federated_lora":
@@ -1214,6 +1252,28 @@ def _multi_tenant_violations(res) -> list:
     return v
 
 
+def _slo_violations(res) -> list:
+    """Checks for the slo section (shared by the full bench and
+    `--mode slo`): the watchdog must have actually ticked, and a CLEAN leg
+    must record zero breaches — generous thresholds mean any breach is a
+    regression (or a broken spec default), never noise."""
+    v = []
+    slo = res.get("slo") or {}
+    if not slo:
+        v.append("slo engine never armed (extra.slo_specs did not take)")
+        return v
+    if slo.get("evaluations", 0) <= 0:
+        v.append("slo engine armed but never evaluated (timer wheel tick "
+                 "missing)")
+    if slo.get("breaches", 0) != 0:
+        v.append(f"slo clean leg recorded {slo['breaches']} breach(es) on "
+                 f"{slo.get('breached_slos')} (healthy runs must be "
+                 "breach-free)")
+    if res.get("unaccounted_drops", 0) != 0:
+        v.append(f"slo leg lost {res['unaccounted_drops']} drops unaccounted")
+    return v
+
+
 def _mode_violations(mode, result) -> list:
     if mode == "federated_lora":
         return _federated_lora_violations(result)
@@ -1221,6 +1281,8 @@ def _mode_violations(mode, result) -> list:
         return _multi_tenant_violations(result)
     if mode == "secagg":
         return _secagg_violations(result)
+    if mode == "slo":
+        return _slo_violations(result)
     return []
 
 
@@ -1324,6 +1386,13 @@ def main():
     if _secagg_violations(secagg):
         # same one-retry policy as the other wall-clock floors
         secagg = _subprocess_bench("secagg")
+    # ISSUE-16 SLO watchdog: the async soak with declarative SLOs live on
+    # the server's timer wheel — evaluations > 0, zero breaches on a clean
+    # leg (generous thresholds: any breach is a regression, not noise)
+    slo_bench = _subprocess_bench("slo")
+    if _slo_violations(slo_bench):
+        # same one-retry policy as the other wall-clock floors
+        slo_bench = _subprocess_bench("slo")
     # ISSUE-7 cold_start: two fresh processes share one AOT program store +
     # compilation cache root; the first populates it, the second must
     # deserialize every program (misses == 0) and start in <= 0.5x the time
@@ -1448,6 +1517,7 @@ def main():
     violations += _federated_lora_violations(federated_lora)
     violations += _multi_tenant_violations(multi_tenant)
     violations += _secagg_violations(secagg)
+    violations += _slo_violations(slo_bench)
     pop_rss = population.get("rss_multiple")
     if pop_rss is not None and pop_rss > POPULATION_RSS_MULTIPLE_FLOOR:
         violations.append(
@@ -1490,6 +1560,7 @@ def main():
             "federated_lora": federated_lora,
             "multi_tenant": multi_tenant,
             "secagg": secagg,
+            "slo": slo_bench,
             "aot": aot,
             "lint": lint_section,
         },

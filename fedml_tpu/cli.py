@@ -344,6 +344,26 @@ def cmd_obs(args) -> int:
         print(json.dumps(summary))
         failed = summary["spans_failed"] + summary["metric_points_failed"]
         return 0 if failed == 0 else 1
+    if args.obs_cmd == "postmortem":
+        from fedml_tpu.obs import postmortem as obs_postmortem
+
+        if not Path(args.path).exists():
+            print(f"error: no such path {args.path}", file=sys.stderr)
+            return 2
+        stitched = obs_postmortem.stitch_bundles(args.path)
+        if not stitched["bundles"]:
+            print(f"error: no readable flight bundles under {args.path}",
+                  file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(stitched))
+        else:
+            print(obs_postmortem.render_postmortem(stitched, limit=args.limit))
+        # the postmortem's own verdict drives the exit code so CI can gate
+        # on it: an unaccounted loss or an unattributable lost upload fails
+        bad = (stitched.get("unaccounted") or 0) + \
+            stitched["uploads"]["unattributed_lost"]
+        return 0 if bad == 0 else 1
     if args.obs_cmd == "serve":
         from fedml_tpu.obs.registry import REGISTRY, MetricsHTTPServer
 
@@ -561,6 +581,14 @@ def main(argv=None) -> int:
     oexp.add_argument("--timeout", type=float, default=10.0)
     oserve = osub.add_parser("serve", help="serve /metrics + /healthz for this process")
     oserve.add_argument("--port", type=int, default=9109)
+    opm = osub.add_parser(
+        "postmortem",
+        help="stitch flight-recorder bundles into one causal failure timeline")
+    opm.add_argument("path", help="flight bundle directory (recursive) or one .flight file")
+    opm.add_argument("--json", action="store_true", dest="as_json",
+                     help="emit the stitched structure as JSON instead of text")
+    opm.add_argument("--limit", type=int, default=40,
+                     help="timeline events to render (<=0 = all; default 40)")
     p.set_defaults(fn=cmd_obs)
 
     p = sub.add_parser("lint", help="AST invariant checker (GL001-GL009) over fedml_tpu/")

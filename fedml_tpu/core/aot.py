@@ -98,6 +98,17 @@ AOT_BUILD_TIME = obsreg.REGISTRY.histogram(
     "fedml_aot_build_seconds",
     "Wall time to build (trace + lower + export) a program on a store miss.",
 )
+PROGRAM_FLOPS = obsreg.REGISTRY.gauge(
+    "fedml_program_flops",
+    "XLA cost-model FLOPs of one compiled program (extra.cost_model_gauges).",
+    labels=("program",),
+)
+PROGRAM_BYTES = obsreg.REGISTRY.gauge(
+    "fedml_program_bytes_accessed",
+    "XLA cost-model bytes accessed (HBM traffic) of one compiled program "
+    "(extra.cost_model_gauges).",
+    labels=("program",),
+)
 
 #: memory-address hex in default reprs would break cross-process fingerprint
 #: stability; scrub it before hashing
@@ -172,6 +183,12 @@ _VOLATILE_CFG_KEYS = {
     # programs — stripping these is what makes the shared store a cross-job
     # warm start instead of N cold ones
     "mt_job_id", "mt_weight", "mt_priority", "mt_slots", "mt_shared_aot_dir",
+    # observability-only knobs (ISSUE 16): recorders, SLO watchdogs, and
+    # export encodings never change what gets traced — two runs that differ
+    # only in telemetry must share the same stored programs
+    "otlp_protocol", "flight_recorder", "flight_dir", "flight_capacity",
+    "flight_window_s", "slo_specs", "slo_interval_s", "slo_flight_dump",
+    "cost_model_gauges",
 }
 
 
@@ -240,6 +257,30 @@ def export_program(jitted: Callable, example_args: tuple):
         )(*example_args)
 
 
+def record_program_cost(compiled, key: str) -> Optional[dict]:
+    """Publish the XLA cost model's flops / bytes-accessed for one compiled
+    program as ``fedml_program_flops`` / ``fedml_program_bytes_accessed``
+    gauges labeled ``program=key`` (ISSUE 16 satellite: the SLO engine can
+    then watch MFU-style ratios, and a perf regression shows up as a cost
+    delta next to the wall-clock delta instead of a mystery).
+
+    Returns ``{"flops", "bytes_accessed"}`` or ``None`` when the runtime
+    exposes no cost analysis (interpreters, some CPU paths) — callers treat
+    the gauges as best-effort."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        bytes_accessed = float(ca.get("bytes accessed",
+                                      ca.get("bytes_accessed", 0.0)))
+    except Exception:
+        return None
+    PROGRAM_FLOPS.set(flops, program=key)
+    PROGRAM_BYTES.set(bytes_accessed, program=key)
+    return {"flops": flops, "bytes_accessed": bytes_accessed}
+
+
 def default_store_dir() -> str:
     """``<repo>/.jax_cache-<host>/aot_programs`` — the same host-fingerprinted
     repo-root cache dir as the XLA persistent compilation cache, so the two
@@ -291,10 +332,15 @@ class ProgramStore:
     caller then falls back to its plain jitted path.
     """
 
-    def __init__(self, root: str, trail: Optional[Callable[[dict], None]] = None):
+    def __init__(self, root: str, trail: Optional[Callable[[dict], None]] = None,
+                 cost_gauges: bool = False):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.trail = trail  # obs-trail sink: one record per load/build
+        # extra.cost_model_gauges: publish XLA cost-model flops/bytes per
+        # program at bind time (forces the AOT compile at load, so the cost
+        # is attributable there — same trade as cached_jit's eager flag)
+        self.cost_gauges = bool(cost_gauges)
 
     # -- paths ---------------------------------------------------------------
     def _path(self, key: str) -> str:
@@ -361,7 +407,12 @@ class ProgramStore:
             key, lambda: export_program(jax.jit(fn), example_args))
         if prog is None:
             return jax.jit(fn, donate_argnums=tuple(donate_argnums))
-        return prog.bind(example_args if eager else None, donate_argnums)
+        bound = prog.bind(
+            example_args if (eager or self.cost_gauges) else None,
+            donate_argnums)
+        if self.cost_gauges:
+            record_program_cost(bound, key)
+        return bound
 
     # -- on-disk format ------------------------------------------------------
     def _load(self, key: str) -> Optional[StoredProgram]:
@@ -482,7 +533,8 @@ def store_from_config(cfg, trail: Optional[Callable[[dict], None]] = None
         return None
     root = cfg_extra(cfg, "aot_programs_dir") or default_store_dir()
     try:
-        return ProgramStore(str(root), trail=trail)
+        return ProgramStore(str(root), trail=trail,
+                            cost_gauges=bool(cfg_extra(cfg, "cost_model_gauges")))
     except OSError as e:
         log.warning("aot: store root %s unusable (%s) — running without the "
                     "program store", root, e)

@@ -37,7 +37,20 @@ class RoundCheckpointer:
     def save(self, round_idx: int, state: dict) -> None:
         """state: pytree dict (global_vars, server_state, client_states, key...)."""
         state = jax.device_get(state)
-        self.mngr.save(round_idx, args=self._ocp.args.StandardSave(state))
+        try:
+            self.mngr.save(round_idx, args=self._ocp.args.StandardSave(state))
+        except ValueError:
+            # Two managers over one directory (a lingering pre-crash writer's
+            # retention GC racing the restarted server): the other writer can
+            # delete a step this manager still has cached, which fails save()'s
+            # old-step bookkeeping AFTER the write itself was initiated.
+            # Re-sync the cached step list with the directory and retry; when
+            # the initiated write already committed in the background, the
+            # step is on disk and the retry is skipped.
+            self.mngr.wait_until_finished()
+            self.mngr.reload()
+            if round_idx not in set(self.mngr.all_steps()):
+                self.mngr.save(round_idx, args=self._ocp.args.StandardSave(state))
         self.mngr.wait_until_finished()
 
     def _step_intact(self, step: int) -> bool:

@@ -311,6 +311,47 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "over the FL transport."),
     FlagSpec("obs_jsonl_path", "str", None,
              "Server-side collector JSONL trail path (obs report input)."),
+    FlagSpec("otlp_protocol", "str", "json",
+             "OTLP/HTTP encoding: json (proto3-JSON, the default), protobuf "
+             "(stdlib binary proto writer), or auto (start JSON, fall back "
+             "to protobuf for the rest of the run when the collector "
+             "rejects the JSON body with 415/400)."),
+    FlagSpec("flight_recorder", "bool", False,
+             "Per-process flight recorder: a bounded ring of recent spans, "
+             "metric deltas, comm/chaos events, and journal/epoch "
+             "transitions that dumps an atomic black-box bundle on trigger "
+             "(unhandled exception, SIGTERM, SLO breach, accounting "
+             "violation, hard kill, finish); unset = no ring, no taps, no "
+             "bundles — the default path is bit-identical to before the "
+             "flag existed."),
+    FlagSpec("flight_dir", "str", None,
+             "Directory black-box bundles are dumped into; derived: "
+             "<cwd>/flight_bundles."),
+    FlagSpec("flight_capacity", "int", 4096,
+             "Flight-recorder ring capacity in events (oldest evicted "
+             "first — the bound that keeps black-box memory constant under "
+             "sustained load)."),
+    FlagSpec("flight_window_s", "float", 60.0,
+             "Seconds of ring history a bundle includes (0 = everything "
+             "still in the ring)."),
+    FlagSpec("slo_specs", "dict", None,
+             "Declarative SLO specs evaluated on registry snapshots via the "
+             "server runtime's timer wheel: {name: {metric, stat, op, "
+             "threshold[, per][, labels]}} — stat is value|sum|count|rate|"
+             "mean|pNN; breaches land in the collector trail, OTLP, and "
+             "fedml_slo_breaches_total{slo} (unset = no engine, no timer)."),
+    FlagSpec("slo_interval_s", "float", 1.0,
+             "SLO evaluation cadence on the timer wheel."),
+    FlagSpec("slo_flight_dump", "bool", False,
+             "An SLO breach additionally triggers a flight-recorder bundle "
+             "dump (once per SLO, requires flight_recorder)."),
+    FlagSpec("cost_model_gauges", "bool", False,
+             "Run XLA cost_analysis() on AOT-store programs at build/load "
+             "and export fedml_program_flops / "
+             "fedml_program_bytes_accessed gauges per program, plus the "
+             "derived per-round achieved-FLOPS/MFU gauges in sim/engine.py "
+             "(forces an eager compile at program resolve time; unset = no "
+             "cost analysis, bit-identical default path)."),
     # -- multi-host ----------------------------------------------------------
     FlagSpec("coordinator_address", "str", None,
              "jax.distributed coordinator host:port "

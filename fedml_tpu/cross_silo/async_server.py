@@ -223,6 +223,9 @@ class AsyncFedMLServerManager(FedMLServerManager):
             if upload_key is not None and self._is_duplicate_upload(sender, upload_key):
                 self.deduped_uploads += 1
                 DEDUPED_UPLOADS.inc()
+                if self.flight is not None:
+                    self.flight.note("upload", path="dedup", client=sender,
+                                     key=upload_key)
                 return
             # control-only reads: a plain get() of a missing key would
             # materialize the tensor section and defeat the streaming fold
@@ -245,8 +248,20 @@ class AsyncFedMLServerManager(FedMLServerManager):
                     if not accept:
                         self.rejected_stale += 1
                         REJECTED_STALE.inc(reason="epoch")
+                        if self.flight is not None:
+                            self.flight.note("upload", path="stale",
+                                             client=sender, key=upload_key,
+                                             upload_epoch=epoch,
+                                             epoch=self.session_epoch)
                         return
                     del self._prev_epoch_inflight[sender]
+                    if self.flight is not None:
+                        # the one-shot prev-epoch refold: pre-crash work
+                        # surviving the epoch fence via the in-flight table
+                        self.flight.note("upload", path="refold",
+                                         client=sender, key=upload_key,
+                                         upload_epoch=epoch,
+                                         epoch=self.session_epoch)
             staleness = max(0, self.server_version - client_version)
             sent_at = self._sent_at.pop(sender, None)
             if sent_at is not None:
@@ -261,6 +276,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
             scale = staleness_scale(staleness, self.staleness_exponent)
             if self.aggregator.fold(sender, msg, n_samples, is_delta, scale=scale):
                 ARRIVALS.inc(path="folded")
+                if self.flight is not None:
+                    self.flight.note("upload", path="fold", client=sender,
+                                     key=upload_key, version=client_version,
+                                     staleness=int(staleness))
             else:
                 # exact-mode fallback (custom aggregate, or a trust pipeline
                 # that needs the stacked matrix — attack/defense/LDP; a
@@ -272,6 +291,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 self.aggregator.add_local_trained_result(
                     sender, params, n_samples * scale, is_delta=is_delta)
                 ARRIVALS.inc(path="buffered")
+                if self.flight is not None:
+                    self.flight.note("upload", path="buffer", client=sender,
+                                     key=upload_key, version=client_version,
+                                     staleness=int(staleness))
             self._note_upload_key(sender, upload_key)
             self.total_arrivals += 1
             self._arrivals_in_round += 1
@@ -305,6 +328,9 @@ class AsyncFedMLServerManager(FedMLServerManager):
         AGGREGATE_TIME.observe(agg_span.duration_s)
         BUFFERED_PEAK.set(self.aggregator.peak_buffered_updates)
         VIRTUAL_ROUNDS.inc()
+        if self.flight is not None:
+            self.flight.note("virtual_round", version=self.server_version,
+                             arrivals=arrivals, epoch=self.session_epoch)
         stal = self._round_staleness
         metrics = {
             "round": self.server_version,
@@ -396,6 +422,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
         try:
             self._sent_at[cid] = time.perf_counter()
             self._outstanding[cid] = (self.server_version, time.monotonic())
+            if self.flight is not None:
+                self.flight.note("dispatch", client=cid,
+                                 version=self.server_version,
+                                 epoch=self.session_epoch)
             self.send_message(msg)
         except Exception:
             # one unreachable peer must not kill the receive/timer thread;
@@ -463,6 +493,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
                     self.health.record_deadline_breach(cid)
                     self.timeout_redispatches += 1
                     REDISPATCHES.inc(reason="timeout")
+                    if self.flight is not None:
+                        self.flight.note("redispatch", reason="timeout",
+                                         client=cid,
+                                         version=self.server_version)
                     self._dispatch(self._next_client(fallback=cid))
                 self._refill()
             self._arm_watchdog()
@@ -499,6 +533,11 @@ class AsyncFedMLServerManager(FedMLServerManager):
         self.aggregator.restore_stream_state(p, snap["arrays"])
         self._restore_folded_keys(p)
         self.health.import_state(p.get("health") or {})
+        if self.flight is not None:
+            self.flight.note(
+                "epoch", event="recovery", step=self.recovered_step,
+                version=self.server_version, epoch=self.session_epoch,
+                inflight_rearmed=sorted(self._recovered_outstanding))
         log.info("recovered from journal step %d (version %d, session epoch "
                  "%d, %d in-flight re-armed)", self.recovered_step,
                  self.server_version, self.session_epoch,
@@ -534,6 +573,17 @@ class AsyncFedMLServerManager(FedMLServerManager):
         teardown bookkeeping.  Everything not already committed to the
         journal is lost, exactly like a SIGKILL; only the process (which a
         real SIGKILL would reclaim) stays alive for the test to inspect."""
+        if self.flight is not None:
+            # the black-box moment (racy reads by design — a real SIGKILL
+            # takes no locks either): which dispatches were in flight, and
+            # which pre-crash in-flight uploads a successor may still refold
+            self.flight.trigger(
+                "hard_kill", server_version=self.server_version,
+                epoch=self.session_epoch,
+                outstanding={str(c): int(v)
+                             for c, (v, _t) in list(self._outstanding.items())},
+                prev_epoch_inflight={str(c): int(v) for c, v in
+                                     list(self._prev_epoch_inflight.items())})
         self._finished = True
         self._runtime.cancel(self)
         self.com_manager.stop_receive_message()

@@ -29,6 +29,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import flight as obsflight
+
 
 def _percentile_from_hist(hist, q: float, base_counts: Optional[list] = None) -> Optional[float]:
     """Approximate quantile from a registry histogram family (upper bucket
@@ -116,6 +118,17 @@ def _upload_chaos_sender(router, chaos_flags: Optional[dict], seed: int):
     return wrapper, wrapper
 
 
+def _note_chaos(flight, mgr, leg: str) -> None:
+    """Post-hoc (ISSUE 16): ring a chaos wrapper's injection schedule —
+    (fault, target rank, nonce) per event — into a flight recorder, so the
+    postmortem can attribute every silent loss to the specific injected
+    fault instead of a bulk counter."""
+    if flight is None or mgr is None:
+        return
+    for fault, rid, nonce in list(getattr(mgr, "schedule", ())):
+        flight.note("chaos", fault=fault, client=rid, nonce=nonce, leg=leg)
+
+
 class _TaggedQueue:
     """Queue-shaped proxy: every ``put`` lands in the shared fan-in queue
     tagged with the simulated client's rank."""
@@ -157,7 +170,8 @@ class _SimulatedFleet:
 
     def __init__(self, router, md, template_params, *, drop_prob: float,
                  latency_mean_s: float, latency_sigma: float, seed: int,
-                 workers: int = 4, sender=None, upload_keys: bool = False):
+                 workers: int = 4, sender=None, upload_keys: bool = False,
+                 flight=None):
         self.router = router
         # upload-leg send path (ISSUE 13 satellite): model replies go through
         # ``sender`` — the chaos wrapper when the soak enables upload chaos —
@@ -168,6 +182,10 @@ class _SimulatedFleet:
         #: nonce is the per-dispatch ordinal, so a chaos-DUPLICATED frame
         #: reuses its key and the server's dedup reconciles it
         self.upload_keys = bool(upload_keys)
+        #: fleet-side flight recorder (ISSUE 16): rings every injected drop
+        #: and every reply (with its idempotence key) so the postmortem can
+        #: pair the fleet's sends against the server's fold/dedup ledger
+        self.flight = flight
         self.md = md
         self.template = template_params
         self.drop_prob = float(drop_prob)
@@ -241,6 +259,10 @@ class _SimulatedFleet:
                 if rng.random() < self.drop_prob:
                     with self._lock:
                         self.drops_injected += 1
+                    if self.flight is not None:
+                        self.flight.note(
+                            "drop", client=rid, version=version, nonce=nonce,
+                            epoch=None if epoch is None else int(epoch))
                     continue  # the upload is lost; the watchdog must recover
                 latency = float(rng.lognormal(self.mu, self.sigma))
                 with self._cond:
@@ -281,23 +303,28 @@ class _SimulatedFleet:
         reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, version)
         if epoch is not None:
             reply.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, int(epoch))
+        upload_key = None
         if self.upload_keys:
-            reply.add_params(
-                md.MSG_ARG_KEY_UPLOAD_KEY,
+            upload_key = (
                 f"{rid}:{version}:{-1 if epoch is None else int(epoch)}:{nonce}")
+            reply.add_params(md.MSG_ARG_KEY_UPLOAD_KEY, upload_key)
         try:
             self.sender.send_message(reply)
         except Exception:
             return
         with self._lock:
             self.replies_sent += 1
+        if self.flight is not None:
+            self.flight.note("reply", client=rid, version=version,
+                             nonce=nonce, key=upload_key,
+                             epoch=None if epoch is None else int(epoch))
 
 
 def attach_sim_fleet(server, *, drop_prob: float = 0.0,
                      latency_mean_s: float = 0.003, latency_sigma: float = 1.0,
                      seed: int = 0, workers: int = 4,
                      upload_chaos: Optional[dict] = None,
-                     upload_keys: bool = False):
+                     upload_keys: bool = False, flight=None):
     """Swap an already-built in-proc server's fabric for the fan-in
     simulated fleet and start it; returns ``(fleet, shared_queue)`` —
     ``fleet.stop(shared_queue)`` tears it down.  Shared by :func:`run_soak`
@@ -320,7 +347,8 @@ def attach_sim_fleet(server, *, drop_prob: float = 0.0,
     fleet = _SimulatedFleet(
         router, md, template, drop_prob=drop_prob,
         latency_mean_s=latency_mean_s, latency_sigma=latency_sigma,
-        seed=seed, workers=workers, sender=sender, upload_keys=upload_keys)
+        seed=seed, workers=workers, sender=sender, upload_keys=upload_keys,
+        flight=flight)
     fleet.upload_chaos = chaos_wrapper
     fleet.start(shared)
     return fleet, shared
@@ -395,9 +423,15 @@ def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64
     server = build_server(cfg, ds, model, backend="INPROC")
     fold_lag_base = _hist_counts(FOLD_LAG)
     stal_base = _hist_counts(STALENESS)
+    # ISSUE 16: the fleet gets its own flight ring (the server built one for
+    # itself in its constructor) so drop/reply events land beside the
+    # server's upload/dispatch notes in the postmortem timeline
+    fleet_flight = obsflight.recorder_from_config(
+        cfg, name="fleet", meta={"role": "fleet"})
     fleet, shared = attach_sim_fleet(
         server, drop_prob=drop_prob, latency_mean_s=latency_mean_s,
-        latency_sigma=latency_sigma, seed=seed, workers=workers)
+        latency_sigma=latency_sigma, seed=seed, workers=workers,
+        flight=fleet_flight)
     t0 = time.monotonic()
     server.run_in_thread()
     server.start()
@@ -406,6 +440,10 @@ def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64
     summary = server.async_summary()
     peak = int(server.aggregator.peak_buffered_updates)
     server.finish()
+    # SLO watchdog verdict (ISSUE 16) — read AFTER finish(): stop() runs the
+    # engine's final evaluation pass, so even a sub-tick run evaluates once.
+    # None unless extra.slo_specs armed it
+    slo_summary = server.slo.summary() if server.slo is not None else None
     fleet.stop(shared)
     InProcRouter.reset(run_id)
     if not completed:
@@ -420,6 +458,13 @@ def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64
     # else means the dispatch ledger silently lost work
     unaccounted = max(0, drops - summary["timeout_redispatches"]
                       - summary["outstanding_at_end"])
+    if fleet_flight is not None:
+        reason = "accounting_violation" if unaccounted else "soak_finish"
+        fleet_flight.trigger(reason, drops_injected=drops,
+                             unaccounted=unaccounted,
+                             timeout_redispatches=summary["timeout_redispatches"],
+                             outstanding_at_end=summary["outstanding_at_end"])
+        fleet_flight.close()
     stal_counts = [c - (stal_base[i] if i < len(stal_base) else 0)
                    for i, c in enumerate(_hist_counts(STALENESS))]
     return {
@@ -447,6 +492,7 @@ def run_soak(n_clients: int = 10000, concurrency: int = 1024, buffer_k: int = 64
         "unaccounted_drops": unaccounted,
         "comm_pressure": {"drops": server.health.comm_drops,
                           "retries": server.health.comm_retries},
+        **({"slo": slo_summary} if slo_summary is not None else {}),
     }
 
 
@@ -474,6 +520,7 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
                           workers: int = 4, journal_dir: Optional[str] = None,
                           chaos: Optional[dict] = None,
                           client_chaos: Optional[dict] = None,
+                          extra_flags: Optional[dict] = None,
                           timeout_s: float = 300.0) -> dict:
     """Kill-and-recover soak (ISSUE 10): run the buffered-async server under
     seeded chaos with the recovery journal on, HARD-KILL it mid-run (abrupt
@@ -493,7 +540,14 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
     Chaos-DUPLICATED uploads carry their original's idempotence key and must
     come back as server-side dedups, never as double folds
     (``client_chaos`` defaults to the same fault mix as the dispatch leg;
-    pass ``{}`` to disable upload-leg chaos)."""
+    pass ``{}`` to disable upload-leg chaos).
+
+    ``extra_flags`` merges additional ``cfg.extra`` flags on top of the
+    journal + chaos flags (caller wins) — the flight-recorder dryrun stage
+    and the postmortem test pass ``{"flight_recorder": True,
+    "flight_dir": ...}`` here so both server lifetimes, the fleet, and the
+    chaos schedules leave black-box bundles the ``fedml-tpu obs postmortem``
+    CLI can stitch into one causal timeline."""
     import shutil
     import tempfile
 
@@ -518,7 +572,8 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
     cfg = _soak_config(run_id, n_clients, concurrency, buffer_k, versions,
                        staleness_exponent, redispatch_timeout_s,
                        extra_flags={"server_journal_dir": journal_dir,
-                                    **chaos_flags})
+                                    **chaos_flags,
+                                    **(extra_flags or {})})
     fedml_tpu.init(cfg)
     ds_cfg = dataclasses.replace(cfg, client_num_in_total=8, client_num_per_round=8)
     ds = loader.load(ds_cfg)
@@ -537,10 +592,13 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
         # corrupt hit uploads exactly like they hit dispatches
         upload_flags = dict(chaos_flags if client_chaos is None else client_chaos)
         sender, upload_chaos = _upload_chaos_sender(router, upload_flags, seed)
+        fleet_flight = obsflight.recorder_from_config(
+            cfg, name="fleet", meta={"role": "fleet"})
         fleet = _SimulatedFleet(
             router, md, template, drop_prob=drop_prob,
             latency_mean_s=latency_mean_s, latency_sigma=latency_sigma,
-            seed=seed, workers=workers, sender=sender, upload_keys=True)
+            seed=seed, workers=workers, sender=sender, upload_keys=True,
+            flight=fleet_flight)
         fleet.start(shared)
 
         t0 = time.monotonic()
@@ -615,6 +673,30 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
                      + max(0, a_summary["outstanding_at_end"] - recovered_inflight))
         unaccounted = max(0, losses - accounted)
         wall = (t_kill - t0) + (t_done - t_restart)
+        if fleet_flight is not None:
+            # post-hoc chaos attribution: every injected fault — dispatch leg
+            # through both server lifetimes' wrappers, upload leg through the
+            # fleet's — becomes a ring event the postmortem can match to a
+            # specific lost/deduped upload by (client, nonce)
+            _note_chaos(fleet_flight,
+                        server_a.com_manager if isinstance(
+                            server_a.com_manager, ChaosCommManager) else None,
+                        "dispatch")
+            _note_chaos(fleet_flight,
+                        server_b.com_manager if isinstance(
+                            server_b.com_manager, ChaosCommManager) else None,
+                        "dispatch")
+            _note_chaos(fleet_flight, upload_chaos, "upload")
+            reason = "accounting_violation" if unaccounted else "soak_finish"
+            fleet_flight.trigger(
+                reason, unaccounted=unaccounted, losses=losses,
+                accounted=accounted, fleet_drops=fleet.drops_injected,
+                dispatch_chaos=a_chaos + b_chaos, upload_chaos=upload_losses,
+                timeout_redispatches=total_redisp,
+                rejected_stale=b_summary["rejected_stale"],
+                deduped=b_summary["deduped"],
+                recovered_version=recovered_version)
+            fleet_flight.close()
         return {
             "clients": n_clients,
             "concurrency": concurrency,

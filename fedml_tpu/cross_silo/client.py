@@ -214,6 +214,17 @@ class ClientMasterManager(FedMLCommManager):
             min_elems = getattr(trainer, "comm_compress_min_elems", None)
         self._comm_min_elems = int(
             min_elems if min_elems is not None else codecs.DEFAULT_MIN_COMPRESS_ELEMS)
+        # flight recorder (ISSUE 16, extra.flight_recorder): the client's
+        # own black box — train/upload/journal/epoch events, dumped on
+        # hard_kill / finish so the postmortem can pair every upload key it
+        # sent against the server's fold/dedup/stale ledger.  The comm-event
+        # tap stays off here: in-process harnesses run many clients per
+        # process and the process-wide sink would cross-pollinate rings
+        # (the server and fleet recorders own comm events).
+        from ..obs import flight as obsflight
+
+        self.flight = obsflight.recorder_from_config(
+            cfg, name=f"client_r{rank}", meta={"role": "client", "rank": rank})
         # resume mid-conversation: restore residuals/epoch/attempts from the
         # newest intact journal snapshot (after the codec state above exists)
         if self.client_journal is not None:
@@ -294,14 +305,20 @@ class ClientMasterManager(FedMLCommManager):
         if epoch is not None:
             if self._last_epoch is not None and int(epoch) != self._last_epoch:
                 self.server_restarts_seen += 1
+                if self.flight is not None:
+                    self.flight.note("epoch", event="server_restart_seen",
+                                     prev=self._last_epoch, epoch=int(epoch))
                 log.info("client %d: server session epoch %s -> %s "
                          "(server restarted; resuming)",
                          self.rank, self._last_epoch, epoch)
-            self._last_epoch = int(epoch)
+            self._last_epoch = int(epoch)  # graftlint: disable=GL008(single-writer: only the receive-loop thread writes; the cross-thread readers are hard_kill/finish flight-bundle context where a stale snapshot is acceptable — the bundle records "around the kill", and a CPython int attribute read is atomic)
         params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
         client_idx = int(msg.get(md.MSG_ARG_KEY_CLIENT_INDEX, self.rank - 1))
+        if self.flight is not None:
+            self.flight.note("train", round_idx=round_idx,
+                             epoch=None if epoch is None else int(epoch))
         new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key, client_idx)
-        self.rounds_trained += 1
+        self.rounds_trained += 1  # graftlint: disable=GL008(same single-writer invariant as _last_epoch above: receive-loop-only writes; hard_kill/finish read it solely as flight-bundle context)
         reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         payload, is_delta = self._maybe_compress(new_vars, params, round_idx)
         reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, payload)
@@ -319,10 +336,13 @@ class ClientMasterManager(FedMLCommManager):
             # deterministically and re-sends under the same key.
             attempt = self._next_upload_attempt(round_idx, epoch)
             self._client_journal_snapshot(round_idx, epoch)
-            reply.add_params(
-                md.MSG_ARG_KEY_UPLOAD_KEY,
-                f"{self.rank}:{round_idx}:"
-                f"{-1 if epoch is None else int(epoch)}:{attempt}")
+            upload_key = (f"{self.rank}:{round_idx}:"
+                          f"{-1 if epoch is None else int(epoch)}:{attempt}")
+            reply.add_params(md.MSG_ARG_KEY_UPLOAD_KEY, upload_key)
+            if self.flight is not None:
+                self.flight.note("upload_sent", key=upload_key,
+                                 round_idx=round_idx,
+                                 epoch=None if epoch is None else int(epoch))
         self._send_with_reconnect(reply, seed_extra=round_idx)
 
     # -- crash-recovery journal (ISSUE 13) ------------------------------------
@@ -386,6 +406,10 @@ class ClientMasterManager(FedMLCommManager):
                 restorer(state["trainer_state"])
         self.resumed_from_journal = True
         CLIENT_RESUMES.inc(result="resumed")
+        if self.flight is not None:
+            self.flight.note("journal", event="client_resume",
+                             step=snap["step"], round_idx=state["round_idx"],
+                             epoch=state["session_epoch"])
         log.info("client %d: resumed from journal step %d (round %s, epoch "
                  "%s, %d rounds trained)", self.rank, snap["step"],
                  state["round_idx"], state["session_epoch"],
@@ -399,6 +423,10 @@ class ClientMasterManager(FedMLCommManager):
         alive for the harness to inspect.  A mid-train handler finishes its
         XLA call but its send/journal sites observe ``_killed`` and drop the
         result."""
+        if self.flight is not None:
+            self.flight.trigger("hard_kill", rank=self.rank,
+                                rounds_trained=self.rounds_trained,
+                                epoch=self._last_epoch)
         self._killed = True
         self.com_manager.stop_receive_message()
 
@@ -480,6 +508,11 @@ class ClientMasterManager(FedMLCommManager):
             self._pallas_sink = None
         if self.obs is not None:
             self.obs.close()  # final flush while the transport is still up
+        if self.flight is not None and not self.flight._closed:
+            self.flight.trigger("finish", rank=self.rank,
+                                rounds_trained=self.rounds_trained,
+                                epoch=self._last_epoch)
+            self.flight.close()
         try:
             self.send_message(Message(md.MSG_TYPE_C2S_FINISHED, self.rank, 0))
         except OSError:

@@ -576,9 +576,14 @@ class FedMLServerManager(FedMLCommManager):
 
             # the exporter tees on collector ingest, so rank 0 exports the
             # whole distributed round tree (its own spans + every
-            # client-shipped span under one trace_id per round)
+            # client-shipped span under one trace_id per round).  Under the
+            # multi-tenant control plane every record is stamped with the
+            # job id so N tenants' trails stay distinct series downstream
+            # instead of collapsing by metric name.
+            mt_job = cfg_extra(cfg, "mt_job_id")
             self.obs_collector = ObsCollector(
-                cfg_extra(cfg, "obs_jsonl_path") or None, otlp=self.otlp
+                cfg_extra(cfg, "obs_jsonl_path") or None, otlp=self.otlp,
+                stamp={"job": str(mt_job)} if mt_job else None,
             ).attach(self)
         # per-client health ledger (obs/health.py): EWMA RTT, deadline
         # breaches, comm failures -> fedml_client_health_* gauges.  Always
@@ -597,6 +602,29 @@ class FedMLServerManager(FedMLCommManager):
         self._round_payload_bytes = 0
         # Prometheus exposition, gated on extra['metrics_port']
         self.metrics_server = obsreg.maybe_start_metrics_server(cfg)
+        # flight recorder (ISSUE 16), gated on extra.flight_recorder: a
+        # bounded black-box ring of recent spans, comm events, metric deltas,
+        # and journal/epoch transitions, dumped atomically on hard_kill /
+        # finish / unhandled exception / SIGTERM / SLO breach — the input to
+        # `fedml-tpu obs postmortem`
+        from ..obs import flight as obsflight
+
+        self.flight = obsflight.recorder_from_config(
+            cfg, name="server", meta={"role": "server"})
+        if self.flight is not None:
+            self.flight.attach_comm()
+            self.flight.install_signal_handlers()
+        # SLO watchdog (ISSUE 16), gated on extra.slo_specs: declarative
+        # specs evaluated on registry snapshots via THIS manager's timer
+        # wheel (no new threads); breaches land in the collector trail,
+        # fedml_slo_breaches_total, and (optionally) a flight dump
+        from ..obs import slo as obsslo
+
+        self.slo = obsslo.engine_from_config(
+            cfg, runtime=self._runtime, collector=self.obs_collector,
+            otlp=self.otlp, flight=self.flight)
+        if self.slo is not None:
+            self.slo.start()
         # durable recovery journal (cross_silo/journal.py), gated on
         # extra.server_journal_dir: snapshot full protocol state at round
         # boundaries, recover on restart under a bumped session epoch.
@@ -924,6 +952,12 @@ class FedMLServerManager(FedMLCommManager):
             # record per known client, per round (obs report renders it)
             records += self.health.records(trace_id=round_span.trace_id)
             self.obs_collector.ingest(0, records)
+        if self.flight is not None:
+            for s in child_spans:
+                if s is not None:
+                    self.flight.span_sink(s.to_record())
+            self.flight.span_sink(round_span.to_record())
+            self.flight.record_metric_deltas()
         self._round_rtts.clear()
         self._round_span = None
 
@@ -1034,6 +1068,9 @@ class FedMLServerManager(FedMLCommManager):
         self.aggregator.restore_stream_state(proto, snap["arrays"])
         self._restore_folded_keys(proto)
         self.health.import_state(proto.get("health") or {})
+        if self.flight is not None:
+            self.flight.note("epoch", event="recovery", step=self.recovered_step,
+                             round_idx=self.round_idx, epoch=self.session_epoch)
         log.info("recovered from journal step %d (round %d, session epoch %d, "
                  "%d folds carried)", self.recovered_step, self.round_idx,
                  self.session_epoch, self.aggregator._stream_folded)
@@ -1059,6 +1096,9 @@ class FedMLServerManager(FedMLCommManager):
             step, {**self._journal_protocol_state(), **stream_proto},
             arrays, model_state=self.aggregator.model_state())
         self._last_model_step = step
+        if self.flight is not None:
+            self.flight.note("journal", event="snapshot", step=step,
+                             epoch=self.session_epoch)
 
     def _journal_midround_snapshot(self) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: receive-handler fold-cadence site)
         """Commit the in-progress round's partial streaming fold (ISSUE 13):
@@ -1079,6 +1119,14 @@ class FedMLServerManager(FedMLCommManager):
         committed to the journal (including a mid-round partial fold past
         the last fold-cadence snapshot) is lost, exactly like a SIGKILL;
         only the process stays alive for the test to inspect."""
+        if self.flight is not None:
+            # the black-box moment: what was in flight when the axe fell
+            # (racy reads by design — a SIGKILL takes no locks either)
+            self.flight.trigger(
+                "hard_kill", round_idx=self.round_idx,
+                epoch=self.session_epoch,
+                awaiting=[c for c in self.selected
+                          if not self.aggregator.has_received(c)])
         self._runtime.cancel(self)
         self.com_manager.stop_receive_message()
 
@@ -1120,6 +1168,14 @@ class FedMLServerManager(FedMLCommManager):
         if self.round_gate is not None:
             # never strand a held mesh slot on an abnormal teardown
             self.round_gate.release(self)
+        if self.slo is not None:
+            self.slo.stop()
+        if self.flight is not None and not self.flight._closed:
+            # one terminal bundle per run (close() latches, so the racing
+            # straggler-timer finish can't dump twice)
+            self.flight.trigger("finish", round_idx=self.round_idx,
+                                epoch=self.session_epoch, failed=self.failed)
+            self.flight.close()
         super().finish()
         if self.obs_collector is not None:
             self.obs_collector.close()  # release the JSONL append handle
@@ -1143,5 +1199,7 @@ class FedMLServerManager(FedMLCommManager):
             raise TimeoutError(f"cross-silo run did not finish in {timeout}s (round {self.round_idx})")
         thread.join(timeout=5.0)
         if self.failed:
+            if self.flight is not None:
+                self.flight.trigger("run_failed", reason=self.failed)
             raise RuntimeError(f"cross-silo run failed: {self.failed}")
         return self.history

@@ -90,6 +90,12 @@ def main() -> int:
     from fedml_tpu.cross_silo import build_client
 
     client = build_client(cfg, ds, model, rank=rank, backend="TCP")
+    if client.flight is not None:
+        # real-process client: one rank per process, so the process-wide
+        # SIGTERM/excepthook taps are safe here (the in-process harnesses
+        # deliberately leave them uninstalled — many clients share one
+        # process there and the taps would cross-pollinate rings)
+        client.flight.install_signal_handlers()
     prior_boots = glob.glob(os.path.join(workdir, f"boot_r{rank}_*.json"))
     _atomic_write_json(
         os.path.join(workdir, f"boot_r{rank}_{os.getpid()}.json"),

@@ -1,0 +1,378 @@
+"""Flight recorder — bounded per-process black-box capture (ISSUE 16).
+
+Every subsystem the repo grew (journals, chaos injection, SIGKILL soaks,
+multi-tenant gang scheduling) made failures *survivable*; none made them
+*self-explaining* — diagnosis still meant hand-trawling per-process JSONL
+trails.  This module is the black box: a bounded ring buffer of recent
+spans, registry metric deltas, comm events (drops/retries/chunk
+reassembly), chaos injections, and journal/epoch transitions, dumped as an
+atomic bundle when something goes wrong.
+
+Shape, deliberately minimal:
+
+- :meth:`FlightRecorder.note` appends one dict to a ``deque(maxlen=N)``
+  under a lock — O(1), allocation-bounded, safe from any thread, and it
+  NEVER raises into the caller (telemetry must not take down a receive
+  loop).
+- ``span_sink`` plugs straight into ``obs.trace.traced(sink=...)``;
+  ``attach_comm`` subscribes to the comm layer's process-wide event sinks
+  (the same hook the client-health ledger uses), so transport drops and
+  chunk-stream evictions land in the ring without new plumbing.
+- ``record_metric_deltas`` scalarizes a ``MetricsRegistry.snapshot()`` and
+  rings only what CHANGED since the last capture — a cheap round-boundary
+  call that turns the registry into a time series inside the black box.
+- **Triggers**: unhandled exception (``sys.excepthook`` +
+  ``threading.excepthook`` chained), SIGTERM (main thread, chained),
+  accounting-identity violation / SLO breach / hard kill / finish (explicit
+  :meth:`trigger` calls wired into the servers, clients, soak harnesses,
+  control plane, and serving worker).
+- **Bundles** are atomic: the journal/AOT-store envelope pattern (MAGIC +
+  one sorted-keys JSON meta line + payload, ``tempfile.mkstemp`` + fsync +
+  ``os.replace``) — a reader sees an old bundle or a complete new one,
+  never a torn one.  When LOCKSAN is on, the current lock-sanitizer report
+  rides in the bundle.
+
+Gating is absolute: :func:`recorder_from_config` returns ``None`` unless
+``extra.flight_recorder`` is set — no ring, no taps, no signal handlers,
+default path bit-identical.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from ..core.flags import cfg_extra
+from . import registry as obsreg
+
+log = logging.getLogger("fedml_tpu.obs.flight")
+
+__all__ = [
+    "FlightRecorder", "recorder_from_config", "read_bundle", "list_bundles",
+    "FLIGHT_DUMPS",
+]
+
+#: on-disk bundle envelope: MAGIC + one sorted-keys JSON meta line + the
+#: JSON body.  Bump the magic when the envelope changes — old bundles are
+#: then rejected as foreign, never misread.
+_MAGIC = b"FMLFLT1\n"
+
+FLIGHT_DUMPS = obsreg.REGISTRY.counter(
+    "fedml_flight_dumps_total",
+    "Black-box bundles dumped by the flight recorder, by trigger reason.",
+    labels=("reason",),
+)
+FLIGHT_EVENTS = obsreg.REGISTRY.counter(
+    "fedml_flight_events_total",
+    "Events appended to flight-recorder rings (evictions not subtracted).",
+)
+
+
+def _scalarize(snapshot: list[dict]) -> dict[str, float]:
+    """Flatten a registry snapshot to ``{"family{k=v,...}": value}`` —
+    counters/gauges by value, histograms by ``_count`` and ``_sum`` (the
+    delta-friendly scalars)."""
+    out: dict[str, float] = {}
+    for fam in snapshot:
+        name = fam["name"]
+        for s in fam.get("samples", ()):
+            labels = ",".join(f"{k}={v}" for k, v in sorted(s["labels"].items()))
+            key = f"{name}{{{labels}}}" if labels else name
+            if fam.get("kind") == "histogram":
+                out[key + "_count"] = float(s["count"])
+                out[key + "_sum"] = float(s["sum"])
+            else:
+                out[key] = float(s["value"])
+    return out
+
+
+class FlightRecorder:
+    """One process-local black box: bounded ring + atomic dump on trigger."""
+
+    def __init__(self, out_dir: str, *, name: str = "proc",
+                 capacity: int = 4096, window_s: float = 60.0,
+                 registry: Optional[obsreg.MetricsRegistry] = None,
+                 meta: Optional[dict] = None):
+        self.out_dir = os.path.abspath(str(out_dir))
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.name = str(name)
+        self.capacity = max(16, int(capacity))
+        self.window_s = float(window_s)
+        self.registry = registry or obsreg.REGISTRY
+        self.meta = dict(meta or {})
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._metric_last: Optional[dict[str, float]] = None
+        self._comm_sink = None
+        self._prev_excepthook = None
+        self._prev_thread_hook = None
+        self._prev_sigterm = None
+        self._closed = False
+
+    # -- intake ---------------------------------------------------------------
+    def note(self, kind: str, **fields: Any) -> None:
+        """Append one event to the ring.  Never raises; non-serializable
+        field values are stringified at dump time, not here (hot path)."""
+        try:
+            ev = {"ts": round(time.time(), 6), "kind": str(kind)}
+            ev.update(fields)
+            with self._lock:
+                self._ring.append(ev)
+            FLIGHT_EVENTS.inc()
+        except Exception:
+            pass
+
+    def span_sink(self, record: dict) -> None:
+        """``obs.trace.traced(sink=recorder.span_sink)`` tap: finished spans
+        land in the ring as ``kind: span`` events."""
+        try:
+            self.note("span", **{k: v for k, v in record.items() if k != "kind"})
+        except Exception:
+            pass
+
+    def record_metric_deltas(self) -> int:
+        """Scalarize the registry snapshot and ring only what changed since
+        the last capture.  Returns the number of changed series (0 on the
+        first call, which just sets the baseline)."""
+        try:
+            current = _scalarize(self.registry.snapshot())
+        except Exception:
+            return 0
+        with self._lock:
+            last, self._metric_last = self._metric_last, current
+        if last is None:
+            return 0
+        delta = {k: round(v - last.get(k, 0.0), 9)
+                 for k, v in current.items() if v != last.get(k, 0.0)}
+        if delta:
+            self.note("metrics_delta", delta=delta)
+        return len(delta)
+
+    def attach_comm(self) -> "FlightRecorder":
+        """Subscribe to the comm layer's process-wide drop/retry/chunk
+        events (``comm.base.add_comm_event_sink``); idempotent."""
+        if self._comm_sink is None:
+            from ..comm import base as comm_base
+
+            def sink(event: str, **info):
+                self.note("comm", event=event,
+                          **{k: v for k, v in info.items() if v is not None})
+
+            self._comm_sink = comm_base.add_comm_event_sink(sink)
+        return self
+
+    def detach_comm(self) -> None:
+        if self._comm_sink is not None:
+            from ..comm import base as comm_base
+
+            comm_base.remove_comm_event_sink(self._comm_sink)
+            self._comm_sink = None
+
+    # -- triggers -------------------------------------------------------------
+    def install_signal_handlers(self) -> "FlightRecorder":
+        """Chain SIGTERM (main thread only — ``signal.signal`` refuses
+        elsewhere) and the process/thread excepthooks so a terminating or
+        crashing process leaves a bundle behind.  Idempotent."""
+        if self._prev_excepthook is None:
+            prev = sys.excepthook
+
+            def hook(exc_type, exc, tb):
+                self.trigger("unhandled_exception",
+                             exc_type=getattr(exc_type, "__name__", str(exc_type)),
+                             exc=str(exc))
+                prev(exc_type, exc, tb)
+
+            self._prev_excepthook = prev
+            sys.excepthook = hook
+        if self._prev_thread_hook is None and hasattr(threading, "excepthook"):
+            prev_t = threading.excepthook
+
+            def thook(args):
+                self.trigger(
+                    "unhandled_exception",
+                    thread=getattr(args.thread, "name", None),
+                    exc_type=getattr(args.exc_type, "__name__", str(args.exc_type)),
+                    exc=str(args.exc_value))
+                prev_t(args)
+
+            self._prev_thread_hook = prev_t
+            threading.excepthook = thook
+        if (self._prev_sigterm is None
+                and threading.current_thread() is threading.main_thread()):
+            try:
+                prev_s = signal.getsignal(signal.SIGTERM)
+
+                def on_term(signum, frame):
+                    self.trigger("sigterm")
+                    if callable(prev_s):
+                        prev_s(signum, frame)
+                    elif prev_s == signal.SIG_DFL:
+                        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                        os.kill(os.getpid(), signal.SIGTERM)
+
+                signal.signal(signal.SIGTERM, on_term)
+                self._prev_sigterm = prev_s
+            except (ValueError, OSError):
+                pass
+        return self
+
+    def uninstall_signal_handlers(self) -> None:
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        if self._prev_thread_hook is not None:
+            threading.excepthook = self._prev_thread_hook
+            self._prev_thread_hook = None
+        if self._prev_sigterm is not None:
+            with contextlib.suppress(ValueError, OSError):
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+
+    def trigger(self, reason: str, **context: Any) -> Optional[str]:
+        """Note the trigger, dump a bundle, return its path (``None`` when
+        the dump itself failed — triggers must never raise)."""
+        try:
+            self.note("trigger", reason=reason)
+            return self.dump(reason, context=context)
+        except Exception as e:
+            log.warning("flight: dump for %r failed (%s: %s)",
+                        reason, type(e).__name__, e)
+            return None
+
+    # -- the bundle -----------------------------------------------------------
+    def events(self, window_s: Optional[float] = None) -> list[dict]:
+        """The ring's events within the last ``window_s`` seconds (the
+        recorder's configured window by default; <= 0 = everything)."""
+        win = self.window_s if window_s is None else float(window_s)
+        with self._lock:
+            ring = list(self._ring)
+        if win > 0:
+            cutoff = time.time() - win
+            ring = [e for e in ring if e.get("ts", 0.0) >= cutoff]
+        return ring
+
+    def dump(self, reason: str, context: Optional[dict] = None) -> str:
+        """Write one atomic black-box bundle; returns its path."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        events = self.events()
+        try:
+            metrics = _scalarize(self.registry.snapshot())
+        except Exception:
+            metrics = {}
+        locksan = None
+        try:
+            from ..analysis import sanitizer
+
+            san = sanitizer.active()
+            if san is not None:
+                locksan = san.report()
+        except Exception:
+            locksan = None
+        body = {
+            "events": events,
+            "metrics": metrics,
+            "context": context or {},
+            "recorder": dict(self.meta),
+        }
+        if locksan is not None:
+            body["locksan"] = locksan
+        meta = {
+            "format": "fedml-flight-v1",
+            "name": self.name,
+            "pid": os.getpid(),
+            "seq": seq,
+            "reason": str(reason),
+            "ts": round(time.time(), 6),
+            "n_events": len(events),
+        }
+        payload = json.dumps(body, sort_keys=True, default=str).encode()
+        blob = _MAGIC + json.dumps(meta, sort_keys=True).encode() + b"\n" + payload
+        fname = f"{self.name}.{os.getpid()}.{seq:04d}.{reason}.flight"
+        fname = "".join(c if c.isalnum() or c in "._-" else "_" for c in fname)
+        path = os.path.join(self.out_dir, fname)
+        fd, tmp = tempfile.mkstemp(dir=self.out_dir, prefix=".tmp_", suffix=".flight")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic: readers see a complete bundle or none
+        except OSError:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            raise
+        FLIGHT_DUMPS.inc(reason=str(reason))
+        return path
+
+    def close(self) -> None:
+        """Detach every tap/hook; the ring stays readable (no final dump —
+        finish-time dumps are the owner's explicit trigger)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.detach_comm()
+        self.uninstall_signal_handlers()
+
+
+# ---------------------------------------------------------------------------
+# bundle IO
+
+
+def read_bundle(path: str) -> dict:
+    """Parse one ``.flight`` bundle -> ``{"meta": {...}, "events": [...],
+    "metrics": {...}, "context": {...}, ...}``.  Raises ``ValueError`` on a
+    foreign or torn file (callers skip those)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if not blob.startswith(_MAGIC):
+        raise ValueError(f"{path}: not a flight bundle (bad magic)")
+    rest = blob[len(_MAGIC):]
+    nl = rest.find(b"\n")
+    if nl < 0:
+        raise ValueError(f"{path}: truncated header")
+    meta = json.loads(rest[:nl].decode())
+    body = json.loads(rest[nl + 1:].decode())
+    body["meta"] = meta
+    body["path"] = path
+    return body
+
+
+def list_bundles(root: str) -> list[str]:
+    """Every ``.flight`` file under ``root`` (recursive), sorted."""
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        out.extend(os.path.join(dirpath, f) for f in files
+                   if f.endswith(".flight") and not f.startswith(".tmp_"))
+    return sorted(out)
+
+
+def recorder_from_config(cfg, *, name: str, meta: Optional[dict] = None
+                         ) -> Optional[FlightRecorder]:
+    """The one gate: ``extra.flight_recorder`` unset/falsy -> ``None``
+    (no ring, no taps, bit-identical default path)."""
+    if cfg is None or not cfg_extra(cfg, "flight_recorder"):
+        return None
+    out_dir = cfg_extra(cfg, "flight_dir") or os.path.join(
+        os.getcwd(), "flight_bundles")
+    try:
+        return FlightRecorder(
+            str(out_dir), name=name,
+            capacity=int(cfg_extra(cfg, "flight_capacity")),
+            window_s=float(cfg_extra(cfg, "flight_window_s")),
+            meta={"run_id": str(getattr(cfg, "run_id", "")), **(meta or {})})
+    except OSError as e:
+        log.warning("flight: recorder dir %s unusable (%s) — running without "
+                    "the black box", out_dir, e)
+        return None

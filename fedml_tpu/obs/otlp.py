@@ -230,17 +230,25 @@ def trail_metrics_to_otlp(records: Iterable[dict], service_name: str = "fedml-tp
 def post_otlp(url: str, payload: dict, timeout_s: float = 10.0,
               max_retries: int = 4, backoff_base_s: float = 0.25,
               backoff_max_s: float = 10.0, headers: Optional[dict] = None,
-              on_retry=None) -> Optional[int]:
-    """POST one OTLP JSON body; exponential-backoff retry on 429/5xx and
+              on_retry=None, protocol: str = "json") -> Optional[int]:
+    """POST one OTLP body — ``protocol="json"`` (proto3-JSON, the default)
+    or ``"protobuf"`` (binary wire format via :mod:`.otlp_proto`, for
+    collectors that reject JSON); exponential-backoff retry on 429/5xx and
     connection errors.  Returns the final HTTP status, or None when every
     attempt failed at the connection level."""
-    body = json.dumps(payload).encode("utf-8")
+    if protocol == "protobuf":
+        from . import otlp_proto
+        body = otlp_proto.encode_request(payload)
+        content_type = "application/x-protobuf"
+    else:
+        body = json.dumps(payload).encode("utf-8")
+        content_type = "application/json"
     delay = backoff_base_s
     status: Optional[int] = None
     for attempt in range(max_retries + 1):
         req = urllib.request.Request(
             url, data=body, method="POST",
-            headers={"Content-Type": "application/json", **(headers or {})},
+            headers={"Content-Type": content_type, **(headers or {})},
         )
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:
@@ -282,11 +290,18 @@ class OTLPExporter:
                  queue_size: int = 4096, batch_size: int = 256,
                  flush_interval_s: float = 1.0, max_retries: int = 4,
                  backoff_base_s: float = 0.25, backoff_max_s: float = 10.0,
-                 timeout_s: float = 5.0, headers: Optional[dict] = None):
+                 timeout_s: float = 5.0, headers: Optional[dict] = None,
+                 protocol: str = "json"):
+        if protocol not in ("json", "protobuf", "auto"):
+            raise ValueError(f"otlp protocol must be json|protobuf|auto, got {protocol!r}")
         self.endpoint = endpoint.rstrip("/")
         self.registry = registry or obsreg.REGISTRY
         self.service_name = service_name
         self.resource_attributes = dict(resource_attributes or {})
+        self.protocol = protocol
+        # "auto" starts on JSON and falls back to protobuf the first time a
+        # collector rejects the encoding (415/400); sticky once flipped
+        self._wire = "protobuf" if protocol == "protobuf" else "json"
         self.queue_size = int(queue_size)
         self.batch_size = int(batch_size)
         self.flush_interval_s = float(flush_interval_s)
@@ -320,13 +335,22 @@ class OTLPExporter:
                 self.enqueue_span({"sender": sender, **rec})
 
     # -- shipping -------------------------------------------------------------
+    def _post(self, url: str, payload: dict) -> Optional[int]:
+        status = post_otlp(url, payload, on_retry=OTLP_RETRIED.inc,
+                           protocol=self._wire, **self._post_kw)
+        if (self.protocol == "auto" and self._wire == "json"
+                and status in (400, 415)):
+            self._wire = "protobuf"  # graftlint: disable=GL008(monotone one-way flip json->protobuf, idempotent under races: two threads flipping concurrently write the same value, and the worst stale read costs one extra JSON POST that the collector 415s and this branch re-sends)
+            status = post_otlp(url, payload, on_retry=OTLP_RETRIED.inc,
+                               protocol=self._wire, **self._post_kw)
+        return status
+
     def _send_spans(self, batch: list[dict]) -> None:
         payload, n = spans_to_otlp(batch, service_name=self.service_name,
                                    resource_attributes=self.resource_attributes)
         if not n:
             return
-        status = post_otlp(self.endpoint + "/v1/traces", payload,
-                           on_retry=OTLP_RETRIED.inc, **self._post_kw)
+        status = self._post(self.endpoint + "/v1/traces", payload)
         if status is not None and 200 <= status < 300:
             OTLP_SHIPPED.inc(n, signal="traces")
         else:
@@ -341,8 +365,7 @@ class OTLPExporter:
             service_name=self.service_name,
             resource_attributes=self.resource_attributes,
         )
-        status = post_otlp(self.endpoint + "/v1/metrics", payload,
-                           on_retry=OTLP_RETRIED.inc, **self._post_kw)
+        status = self._post(self.endpoint + "/v1/metrics", payload)
         ok = status is not None and 200 <= status < 300
         if ok:
             OTLP_SHIPPED.inc(max(n, 1), signal="metrics")
@@ -400,12 +423,24 @@ class OTLPExporter:
 def exporter_from_config(cfg, **kwargs) -> Optional[OTLPExporter]:
     """The gate: an exporter (and its worker thread) exists ONLY when
     ``cfg.extra['otlp_endpoint']`` or ``$FEDML_TPU_OTLP_ENDPOINT`` is set;
-    otherwise None and the default path is byte-for-byte unchanged."""
+    otherwise None and the default path is byte-for-byte unchanged.
+
+    Multi-tenant configs (``extra.mt_job_id`` set by ``tenant_config``)
+    stamp the job onto the exporter's OTLP *resource* — without it, every
+    tenant's exporter shipped an identical ``service.name=fedml-tpu``
+    resource and per-job series collapsed at the collector."""
     from ..core.flags import cfg_extra
 
     endpoint = cfg_extra(cfg, "otlp_endpoint") or os.environ.get("FEDML_TPU_OTLP_ENDPOINT")
     if not endpoint:
         return None
+    kwargs.setdefault("protocol", str(cfg_extra(cfg, "otlp_protocol") or "json"))
+    job = cfg_extra(cfg, "mt_job_id")
+    if job:
+        attrs = dict(kwargs.get("resource_attributes") or {})
+        attrs.setdefault("job", str(job))
+        attrs.setdefault("service.instance.id", f"job_{job}")
+        kwargs["resource_attributes"] = attrs
     return OTLPExporter(str(endpoint), **kwargs)
 
 

@@ -1,0 +1,278 @@
+"""Minimal OTLP protobuf wire-format writer — stdlib only (ISSUE 16).
+
+Some collectors reject OTLP/HTTP JSON outright (``415 Unsupported Media
+Type``) or mangle it (strict proto3-JSON parsers balk at our payloads'
+int64-as-string fields); the protocol's mandatory encoding is binary
+protobuf.  Pulling in ``protobuf``/``opentelemetry-proto`` would break the
+repo's no-new-deps rule, so this module hand-encodes the exact two request
+shapes :mod:`fedml_tpu.obs.otlp` already builds — the proto3-JSON dicts
+from ``spans_to_otlp`` / ``metrics_snapshot_to_otlp`` /
+``trail_metrics_to_otlp`` — into ``ExportTraceServiceRequest`` /
+``ExportMetricsServiceRequest`` wire bytes.
+
+Field numbers are transcribed from opentelemetry-proto v1 (``trace.proto``,
+``metrics.proto``, ``common.proto``, ``resource.proto``); a golden-bytes
+test pins the output against a hand-decoded fixture so a transcription
+slip cannot land silently.
+
+Encoding rules (what a conformant decoder expects):
+
+- scalar fields at their proto3 default (0 / "" / false) are omitted,
+  EXCEPT oneof members (``AnyValue`` variants, data-point ``as_double`` /
+  ``as_int``) and ``optional``-marked fields (``HistogramDataPoint.sum``),
+  which are emitted whenever the JSON payload carries them;
+- 64-bit timestamp fields arrive as decimal strings (proto3-JSON int64)
+  and leave as fixed64;
+- hex trace/span ids become raw bytes;
+- ``bucket_counts`` / ``explicit_bounds`` use packed encoding.
+"""
+
+from __future__ import annotations
+
+import struct
+
+__all__ = ["encode_trace_request", "encode_metrics_request", "encode_request"]
+
+_MASK64 = (1 << 64) - 1
+
+
+# ---------------------------------------------------------------------------
+# wire primitives
+
+
+def _varint(n: int) -> bytes:
+    n &= _MASK64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _str_field(field: int, s, *, always: bool = False) -> bytes:
+    data = str(s).encode("utf-8") if s is not None else b""
+    if not data and not always:
+        return b""
+    return _len_field(field, data)
+
+
+def _varint_field(field: int, n: int, *, always: bool = False) -> bytes:
+    n = int(n)
+    if not n and not always:
+        return b""
+    return _tag(field, 0) + _varint(n)
+
+
+def _fixed64_field(field: int, n: int, *, always: bool = False) -> bytes:
+    n = int(n) & _MASK64
+    if not n and not always:
+        return b""
+    return _tag(field, 1) + struct.pack("<Q", n)
+
+
+def _double_field(field: int, v: float, *, always: bool = False) -> bytes:
+    v = float(v)
+    if v == 0.0 and not always:
+        return b""
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _i64(v) -> int:
+    """proto3-JSON int64 fields arrive as decimal strings (or ints)."""
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _id_bytes(hex_id) -> bytes:
+    s = str(hex_id or "")
+    try:
+        return bytes.fromhex(s)
+    except ValueError:
+        return b""
+
+
+# ---------------------------------------------------------------------------
+# common.proto / resource.proto
+
+
+def _any_value(av: dict) -> bytes:
+    # oneof: the set member is serialized even at its default value
+    if "stringValue" in av:
+        return _str_field(1, av["stringValue"], always=True)
+    if "boolValue" in av:
+        return _varint_field(2, 1 if av["boolValue"] else 0, always=True)
+    if "intValue" in av:
+        return _varint_field(3, _i64(av["intValue"]), always=True)
+    if "doubleValue" in av:
+        return _double_field(4, av["doubleValue"], always=True)
+    if "arrayValue" in av:
+        inner = b"".join(_len_field(1, _any_value(v))
+                         for v in av["arrayValue"].get("values", ()))
+        return _len_field(5, inner)
+    if "kvlistValue" in av:
+        inner = b"".join(_len_field(1, _key_value(kv))
+                         for kv in av["kvlistValue"].get("values", ()))
+        return _len_field(6, inner)
+    if "bytesValue" in av:
+        import base64
+        return _len_field(7, base64.b64decode(av["bytesValue"]))
+    return b""
+
+
+def _key_value(kv: dict) -> bytes:
+    return _str_field(1, kv.get("key", "")) + _len_field(2, _any_value(kv.get("value", {})))
+
+
+def _attributes(field: int, attrs) -> bytes:
+    return b"".join(_len_field(field, _key_value(kv)) for kv in (attrs or ()))
+
+
+def _resource(res: dict) -> bytes:
+    return _attributes(1, res.get("attributes"))
+
+
+def _scope(scope: dict) -> bytes:
+    return _str_field(1, scope.get("name", ""))
+
+
+# ---------------------------------------------------------------------------
+# trace.proto
+
+
+def _span(span: dict) -> bytes:
+    out = [
+        _len_field(1, _id_bytes(span.get("traceId"))),
+        _len_field(2, _id_bytes(span.get("spanId"))),
+    ]
+    parent = _id_bytes(span.get("parentSpanId"))
+    if parent:
+        out.append(_len_field(4, parent))
+    out.append(_str_field(5, span.get("name", "")))
+    out.append(_varint_field(6, int(span.get("kind", 0))))
+    out.append(_fixed64_field(7, _i64(span.get("startTimeUnixNano"))))
+    out.append(_fixed64_field(8, _i64(span.get("endTimeUnixNano"))))
+    out.append(_attributes(9, span.get("attributes")))
+    return b"".join(out)
+
+
+def _scope_spans(ss: dict) -> bytes:
+    out = [_len_field(1, _scope(ss.get("scope", {})))]
+    out += [_len_field(2, _span(s)) for s in ss.get("spans", ())]
+    return b"".join(out)
+
+
+def _resource_spans(rs: dict) -> bytes:
+    out = [_len_field(1, _resource(rs.get("resource", {})))]
+    out += [_len_field(2, _scope_spans(ss)) for ss in rs.get("scopeSpans", ())]
+    return b"".join(out)
+
+
+def encode_trace_request(payload: dict) -> bytes:
+    """``spans_to_otlp`` JSON body -> ``ExportTraceServiceRequest`` bytes."""
+    return b"".join(_len_field(1, _resource_spans(rs))
+                    for rs in payload.get("resourceSpans", ()))
+
+
+# ---------------------------------------------------------------------------
+# metrics.proto
+
+
+def _number_data_point(dp: dict) -> bytes:
+    out = [
+        _fixed64_field(2, _i64(dp.get("startTimeUnixNano"))),
+        _fixed64_field(3, _i64(dp.get("timeUnixNano"))),
+    ]
+    if "asDouble" in dp:  # oneof value
+        out.append(_double_field(4, dp["asDouble"], always=True))
+    elif "asInt" in dp:
+        out.append(_tag(6, 1) + struct.pack("<q", _i64(dp["asInt"])))
+    out.append(_attributes(7, dp.get("attributes")))
+    return b"".join(out)
+
+
+def _histogram_data_point(dp: dict) -> bytes:
+    out = [
+        _fixed64_field(2, _i64(dp.get("startTimeUnixNano"))),
+        _fixed64_field(3, _i64(dp.get("timeUnixNano"))),
+        _fixed64_field(4, _i64(dp.get("count"))),
+    ]
+    if "sum" in dp:  # optional field: present in JSON -> emitted
+        out.append(_double_field(5, dp["sum"], always=True))
+    counts = dp.get("bucketCounts") or ()
+    if counts:
+        packed = b"".join(struct.pack("<Q", _i64(c) & _MASK64) for c in counts)
+        out.append(_len_field(6, packed))
+    bounds = dp.get("explicitBounds") or ()
+    if bounds:
+        packed = b"".join(struct.pack("<d", float(b)) for b in bounds)
+        out.append(_len_field(7, packed))
+    out.append(_attributes(9, dp.get("attributes")))
+    return b"".join(out)
+
+
+def _metric(m: dict) -> bytes:
+    out = [_str_field(1, m.get("name", "")),
+           _str_field(2, m.get("description", "")),
+           _str_field(3, m.get("unit", ""))]
+    if "gauge" in m:
+        inner = b"".join(_len_field(1, _number_data_point(dp))
+                         for dp in m["gauge"].get("dataPoints", ()))
+        out.append(_len_field(5, inner))
+    elif "sum" in m:
+        s = m["sum"]
+        inner = b"".join(_len_field(1, _number_data_point(dp))
+                         for dp in s.get("dataPoints", ()))
+        inner += _varint_field(2, int(s.get("aggregationTemporality", 0)))
+        inner += _varint_field(3, 1 if s.get("isMonotonic") else 0)
+        out.append(_len_field(7, inner))
+    elif "histogram" in m:
+        h = m["histogram"]
+        inner = b"".join(_len_field(1, _histogram_data_point(dp))
+                         for dp in h.get("dataPoints", ()))
+        inner += _varint_field(2, int(h.get("aggregationTemporality", 0)))
+        out.append(_len_field(9, inner))
+    return b"".join(out)
+
+
+def _scope_metrics(sm: dict) -> bytes:
+    out = [_len_field(1, _scope(sm.get("scope", {})))]
+    out += [_len_field(2, _metric(m)) for m in sm.get("metrics", ())]
+    return b"".join(out)
+
+
+def _resource_metrics(rm: dict) -> bytes:
+    out = [_len_field(1, _resource(rm.get("resource", {})))]
+    out += [_len_field(2, _scope_metrics(sm)) for sm in rm.get("scopeMetrics", ())]
+    return b"".join(out)
+
+
+def encode_metrics_request(payload: dict) -> bytes:
+    """``metrics_snapshot_to_otlp`` JSON body ->
+    ``ExportMetricsServiceRequest`` bytes."""
+    return b"".join(_len_field(1, _resource_metrics(rm))
+                    for rm in payload.get("resourceMetrics", ()))
+
+
+def encode_request(payload: dict) -> bytes:
+    """Dispatch on the payload's top-level key — the two request shapes are
+    disjoint, so the transport can stay signal-agnostic."""
+    if "resourceSpans" in payload:
+        return encode_trace_request(payload)
+    if "resourceMetrics" in payload:
+        return encode_metrics_request(payload)
+    raise ValueError("not an OTLP export payload: "
+                     f"keys={sorted(payload)[:4]}")

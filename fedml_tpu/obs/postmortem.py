@@ -1,0 +1,368 @@
+"""Causal postmortem over flight-recorder bundles (ISSUE 16 tentpole, part c).
+
+One crashed run leaves several black boxes behind: the killed server's
+``hard_kill`` bundle, the recovered server's ``finish`` bundle, the fleet's
+(or each client's) bundle, every ``accounting_violation`` / ``slo_breach``
+dump.  Each ring only knows its own process.  :func:`stitch_bundles` joins
+them — by upload idempotence key, session epoch, and wall-clock — into one
+causal picture that answers the questions a human asks first after a
+failure:
+
+- **What was in flight at the kill?**  The ``hard_kill`` trigger context
+  carries the dispatch ledger snapshot; the timeline shows which of those
+  slots later refolded under the next epoch, which came back as
+  deterministic stale rejections, and which were re-issued by the watchdog.
+- **Which uploads were lost, and why?**  Every upload key a sender recorded
+  (fleet ``reply`` / client ``upload_sent``) is matched against the server's
+  ``upload`` notes (fold / buffer / refold / dedup / stale).  Keys the
+  server never saw are attributed: in the killed server's dispatch ledger,
+  sent into the kill→recovery gap, sent under a session epoch a kill
+  terminated (in transit or unjournaled when the process died), a
+  final-round straggler the closing round outran, eaten by an injected
+  silent chaos fault (drop / corrupt / partition_lost), or — the red flag
+  the whole exercise exists to catch — unattributed.
+- **Which SLO broke first?**  Breach notes across all bundles, ordered.
+
+The output of :func:`stitch_bundles` is a plain JSON-able dict;
+:func:`render_postmortem` formats it for terminals.  ``fedml-tpu obs
+postmortem <dir>`` wires both to the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Union
+
+from . import flight
+
+__all__ = ["stitch_bundles", "render_postmortem"]
+
+#: upload-note paths meaning "the bytes reached the aggregator"
+_ARRIVED_PATHS = ("fold", "buffer", "refold")
+
+#: chaos faults that silently eat a frame (must mirror
+#: ``comm.chaos.SILENT_LOSS_FAULTS``; duplicated here so the postmortem can
+#: read bundles without importing the comm stack)
+_SILENT_FAULTS = ("drop", "corrupt", "partition_lost")
+
+
+def _load(source: Union[str, list]) -> list[dict]:
+    """Bundles from a directory (recursive), one file path, or a pre-read
+    list of bundle dicts.  Unreadable/corrupt bundles are skipped — a
+    postmortem must work on whatever survived."""
+    if isinstance(source, list) and source and isinstance(source[0], dict):
+        return list(source)
+    paths = ([source] if isinstance(source, str) and os.path.isfile(source)
+             else flight.list_bundles(str(source)))
+    bundles = []
+    for p in paths:
+        try:
+            bundles.append(flight.read_bundle(p))
+        except (OSError, ValueError):
+            continue
+    return bundles
+
+
+def _epoch_of(rec: dict) -> Optional[int]:
+    try:
+        return int(rec.get("epoch"))
+    except (TypeError, ValueError):
+        return None
+
+
+def _src(bundle: dict) -> str:
+    m = bundle.get("meta", {})
+    return f"{m.get('name', '?')}[{m.get('reason', '?')}]"
+
+
+def stitch_bundles(source: Union[str, list],
+                   window_s: float = 0.0) -> dict:
+    """Join every readable bundle under ``source`` into one causal summary.
+
+    ``window_s`` > 0 trims the merged timeline to the trailing window before
+    the newest event (the ledger always uses every event)."""
+    bundles = _load(source)
+    timeline: list[dict] = []
+    sent: dict[str, dict] = {}          # upload key -> sender-side record
+    outcome: dict[str, dict] = {}       # upload key -> server-side record
+    kills: list[dict] = []
+    recoveries: list[dict] = []
+    breaches: list[dict] = []
+    triggers: list[dict] = []
+    chaos: dict[str, dict[str, int]] = {}
+    dispatches: list[dict] = []
+    drops: list[dict] = []
+    accounting: Optional[dict] = None
+
+    for b in bundles:
+        src = _src(b)
+        reason = b.get("meta", {}).get("reason")
+        ctx = b.get("context") or {}
+        if reason == "hard_kill":
+            kills.append({"src": src, "ts": b.get("meta", {}).get("ts"),
+                          "context": ctx})
+        if reason in ("soak_finish", "accounting_violation"):
+            accounting = dict(ctx)
+        for e in b.get("events", []):
+            kind = e.get("kind")
+            if kind == "chaos":
+                # post-hoc notes: end-of-run timestamps — ledger only
+                leg = str(e.get("leg", "?"))
+                fault = str(e.get("fault", "?"))
+                chaos.setdefault(leg, {})
+                chaos[leg][fault] = chaos[leg].get(fault, 0) + 1
+                continue
+            timeline.append({**e, "src": src})
+            if kind in ("reply", "upload_sent"):
+                key = e.get("key")
+                if key is not None:
+                    sent.setdefault(str(key), {**e, "src": src})
+            elif kind == "upload":
+                key = e.get("key")
+                if key is not None:
+                    outcome[str(key)] = {**e, "src": src}
+            elif kind == "epoch" and e.get("event") == "recovery":
+                recoveries.append({**e, "src": src})
+            elif kind == "slo_breach":
+                breaches.append({**e, "src": src})
+            elif kind == "trigger":
+                triggers.append({**e, "src": src})
+            elif kind == "dispatch":
+                dispatches.append(e)
+            elif kind == "drop":
+                drops.append({**e, "src": src})
+
+    timeline.sort(key=lambda e: e.get("ts", 0.0))
+    if window_s and window_s > 0 and timeline:
+        cut = timeline[-1].get("ts", 0.0) - window_s
+        timeline = [e for e in timeline if e.get("ts", 0.0) >= cut]
+    breaches.sort(key=lambda e: e.get("ts", 0.0))
+    recoveries.sort(key=lambda e: e.get("ts", 0.0))
+
+    # -- kill → recovery gaps: an upload sent into a gap reached nobody ------
+    kill_triggers = sorted(
+        (t for t in triggers if t.get("reason") == "hard_kill"),
+        key=lambda t: t.get("ts", 0.0))
+    gaps: list[tuple[float, float]] = []
+    for kt in kill_triggers:
+        t0 = float(kt.get("ts", 0.0))
+        t1 = min((float(r.get("ts", 0.0)) for r in recoveries
+                  if float(r.get("ts", 0.0)) >= t0), default=float("inf"))
+        gaps.append((t0, t1))
+
+    # -- upload ledger --------------------------------------------------------
+    arrived = {p: 0 for p in _ARRIVED_PATHS}
+    deduped = stale = 0
+    for rec in outcome.values():
+        path = rec.get("path")
+        if path in arrived:
+            arrived[path] += 1
+        elif path == "dedup":
+            deduped += 1
+        elif path == "stale":
+            stale += 1
+    # dedup/stale notes name keys whose FIRST copy may live only in the
+    # server's journaled key table (pre-crash folds): count them as seen
+    lost_keys = [k for k in sent if k not in outcome]
+    # the dispatch ledger a killed server dumped in its trigger context:
+    # those (client, version) slots were awaiting an answer when the process
+    # died — an upload matching one of them vanished WITH the server
+    kill_ledger: set[tuple] = set()
+    kill_epochs: set[int] = set()
+    for k in kills:
+        ctx = k.get("context") or {}
+        for table in ("outstanding", "prev_epoch_inflight"):
+            for cid, ver in (ctx.get(table) or {}).items():
+                kill_ledger.add((int(cid), int(ver)))
+        try:
+            kill_epochs.add(int(ctx.get("epoch")))
+        except (TypeError, ValueError):
+            pass
+    # the run's end: after the final virtual-round close the server ignores
+    # stragglers by design (`_finished` latches before the finish broadcast
+    # reaches anyone still training).  The final round's own version matters
+    # too: a reply for that version which never arrived can only be a
+    # straggler the closing round outran — the round reached quorum on other
+    # clients' arrivals while this one was still in transit, so its sent ts
+    # lands a few ms BEFORE the close event (wall clock alone misses it)
+    vr_events = [e for e in timeline if e.get("kind") == "virtual_round"]
+    end_ts = max((float(e.get("ts", 0.0)) for e in vr_events),
+                 default=float("inf"))
+    final_version: Optional[int] = None
+    if vr_events:
+        last_vr = max(vr_events, key=lambda e: float(e.get("ts", 0.0)))
+        try:
+            final_version = int(last_vr.get("version"))
+        except (TypeError, ValueError):
+            final_version = None
+    # only UPLOAD-leg silent faults eat a sent key; dispatch-leg faults mean
+    # the client never got work, so no reply existed to lose
+    silent_budget = sum(n for f, n in chaos.get("upload", {}).items()
+                        if f in _SILENT_FAULTS)
+    lost: list[dict] = []
+    for k in sorted(lost_keys, key=lambda k: sent[k].get("ts", 0.0)):
+        rec = sent[k]
+        ts = float(rec.get("ts", 0.0))
+        client = rec.get("client", rec.get("rank"))
+        version = rec.get("version", rec.get("round_idx"))
+        try:
+            slot = (int(client), int(version))
+        except (TypeError, ValueError):
+            slot = None
+        if slot in kill_ledger:
+            attribution = "in_flight_at_kill"
+        elif any(g[0] <= ts <= g[1] for g in gaps):
+            attribution = "in_kill_gap"
+        elif _epoch_of(rec) in kill_epochs:
+            # sent under a session epoch a kill terminated and never seen by
+            # the server: either still in transit when the process died (the
+            # dispatch ledger misses superseded versions — a v reply in
+            # flight after the client was re-dispatched v+1), or folded into
+            # state the kill destroyed before a journal snapshot.  Both are
+            # the kill's doing — the journal fence makes everything
+            # unjournaled in a killed epoch an expected casualty
+            attribution = "in_killed_epoch"
+        elif ts >= end_ts or (final_version is not None
+                              and slot is not None
+                              and slot[1] >= final_version):
+            attribution = "post_finish"
+        elif silent_budget > 0:
+            silent_budget -= 1
+            attribution = "chaos_silent_loss"
+        else:
+            attribution = "unattributed"
+        lost.append({"key": k, "client": client, "version": version,
+                     "epoch": rec.get("epoch"), "ts": ts,
+                     "attribution": attribution})
+    unattributed = sum(1 for r in lost if r["attribution"] == "unattributed")
+
+    # -- dispatch ledger: dispatches that never produced a reply --------------
+    replied = {(r.get("client"), r.get("version"))
+               for r in sent.values() if r.get("kind") == "reply"}
+    unanswered = [d for d in dispatches
+                  if (d.get("client"), d.get("version")) not in replied]
+
+    return {
+        "bundles": [{"path": b.get("path"), **{k: b.get("meta", {}).get(k)
+                     for k in ("name", "reason", "pid", "seq", "ts",
+                               "n_events")}} for b in bundles],
+        "timeline": timeline,
+        "kills": kills,
+        "recoveries": recoveries,
+        "slo_breaches": breaches,
+        "first_breach": breaches[0] if breaches else None,
+        "uploads": {
+            "sent": len(sent),
+            "arrived": arrived,
+            "deduped": deduped,
+            "rejected_stale": stale,
+            "lost": lost,
+            "unattributed_lost": unattributed,
+        },
+        "chaos": chaos,
+        "drops_at_sender": len(drops),
+        "dispatches": {"total": len(dispatches),
+                       "unanswered": len(unanswered)},
+        "accounting": accounting,
+        "unaccounted": (accounting or {}).get("unaccounted"),
+    }
+
+
+def _fmt_event(e: dict, t0: float) -> str:
+    ts = e.get("ts", 0.0) - t0
+    kind = e.get("kind", "?")
+    skip = {"ts", "kind", "src", "delta"}
+    fields = " ".join(f"{k}={e[k]}" for k in sorted(e)
+                      if k not in skip and not isinstance(e[k], (dict, list)))
+    if kind == "metrics_delta":
+        fields = f"{len(e.get('delta') or {})} series moved"
+    return f"  +{ts:9.3f}s  {e.get('src', '?'):<24} {kind:<14} {fields}"
+
+
+def render_postmortem(stitched: dict, limit: int = 40) -> str:
+    """Terminal rendering of a stitched postmortem (most recent ``limit``
+    timeline events; ``limit <= 0`` renders the whole timeline)."""
+    out: list[str] = []
+    bundles = stitched.get("bundles", [])
+    out.append(f"flight postmortem: {len(bundles)} bundle(s)")
+    for b in bundles:
+        out.append(f"  {b.get('name')}.{b.get('pid')}.{b.get('seq', 0):04d} "
+                   f"reason={b.get('reason')} events={b.get('n_events')}")
+    timeline = stitched.get("timeline", [])
+    if timeline:
+        t0 = timeline[0].get("ts", 0.0)
+        shown = timeline if limit <= 0 else timeline[-limit:]
+        out.append("")
+        out.append(f"timeline ({len(shown)}/{len(timeline)} events, "
+                   f"t0={t0:.3f}):")
+        out.extend(_fmt_event(e, t0) for e in shown)
+
+    kills = stitched.get("kills", [])
+    if kills:
+        out.append("")
+        out.append("kills:")
+        for k in kills:
+            ctx = k.get("context") or {}
+            inflight = ctx.get("outstanding") or ctx.get("awaiting") or {}
+            n = len(inflight)
+            out.append(f"  {k.get('src')}: {n} in flight at the kill "
+                       f"(epoch {ctx.get('epoch')}, "
+                       f"version {ctx.get('server_version', ctx.get('round_idx'))})")
+    for r in stitched.get("recoveries", []):
+        out.append(f"  recovered: {r.get('src')} step={r.get('step')} "
+                   f"version={r.get('version', r.get('round_idx'))} "
+                   f"epoch={r.get('epoch')}")
+
+    up = stitched.get("uploads", {})
+    out.append("")
+    arrived = up.get("arrived", {})
+    out.append(f"upload ledger: {up.get('sent', 0)} sent — "
+               f"{sum(arrived.values())} arrived "
+               f"({', '.join(f'{k}={v}' for k, v in sorted(arrived.items()))}), "
+               f"{up.get('deduped', 0)} deduped, "
+               f"{up.get('rejected_stale', 0)} stale-rejected, "
+               f"{len(up.get('lost', []))} lost")
+    for rec in up.get("lost", []):
+        out.append(f"  lost {rec['key']} (client {rec['client']}, "
+                   f"version {rec['version']}, epoch {rec['epoch']}) "
+                   f"-> {rec['attribution']}")
+    chaos = stitched.get("chaos", {})
+    if chaos:
+        parts = [f"{leg}: " + ", ".join(f"{f}={n}" for f, n in sorted(v.items()))
+                 for leg, v in sorted(chaos.items())]
+        out.append(f"chaos injected — {'; '.join(parts)}")
+    if stitched.get("drops_at_sender"):
+        out.append(f"sender-side drops (never sent): "
+                   f"{stitched['drops_at_sender']}")
+    disp = stitched.get("dispatches", {})
+    if disp.get("total"):
+        out.append(f"dispatch ledger: {disp['total']} dispatches, "
+                   f"{disp['unanswered']} never answered "
+                   f"(redispatched, throttled, or in flight at a kill)")
+
+    fb = stitched.get("first_breach")
+    if fb is not None:
+        out.append("")
+        out.append(f"FIRST SLO BREACH: {fb.get('slo')} — "
+                   f"{fb.get('metric')} {fb.get('stat')} {fb.get('op')} "
+                   f"{fb.get('threshold')} (value {fb.get('value')}) "
+                   f"at ts={fb.get('ts')}")
+    elif stitched.get("slo_breaches") is not None:
+        out.append("slo: no breaches recorded")
+
+    acc = stitched.get("accounting")
+    if acc is not None:
+        out.append("")
+        verdict = ("OK — every loss accounted"
+                   if not acc.get("unaccounted") else
+                   f"VIOLATION — {acc.get('unaccounted')} loss(es) unaccounted")
+        out.append(f"accounting: {verdict}")
+        fields = " ".join(f"{k}={v}" for k, v in sorted(acc.items())
+                          if not isinstance(v, (dict, list)))
+        out.append(f"  {fields}")
+    unattributed = up.get("unattributed_lost", 0)
+    if unattributed:
+        out.append(f"WARNING: {unattributed} lost upload(s) have no cause — "
+                   f"not in a kill gap, beyond the injected chaos budget")
+    return "\n".join(out)

@@ -171,11 +171,18 @@ class ObsCollector:
     :class:`~fedml_tpu.obs.otlp.OTLPExporter`) tees every span record of
     every ingested batch — the server's own rank-0 records AND the
     client-shipped ones — so rank 0 exports the WHOLE distributed round
-    tree to a standard OpenTelemetry collector."""
+    tree to a standard OpenTelemetry collector.
 
-    def __init__(self, jsonl_path: Optional[str] = None, otlp=None):
+    ``stamp`` is merged into every ingested record (record keys win).  The
+    multi-tenant control plane stamps ``{"job": <id>}`` so trail metric
+    records from different tenants stay distinct series through
+    ``trail_metrics_to_otlp`` instead of collapsing by metric name."""
+
+    def __init__(self, jsonl_path: Optional[str] = None, otlp=None,
+                 stamp: Optional[dict] = None):
         self.jsonl_path = jsonl_path
         self.otlp = otlp
+        self.stamp = dict(stamp) if stamp else None
         self.by_sender: dict[int, list[dict]] = {}
         self._lock = threading.Lock()
         self._fh = open(jsonl_path, "a") if jsonl_path else None
@@ -197,6 +204,9 @@ class ObsCollector:
         server's own entry point: rank 0 records its round/aggregate spans
         into the same trail its clients ship to, so one JSONL holds the whole
         distributed round."""
+        if self.stamp:
+            batch = [{**self.stamp, **rec} if isinstance(rec, dict) else rec
+                     for rec in batch]
         with self._lock:
             self.by_sender.setdefault(sender, []).extend(batch)
             if self._fh:

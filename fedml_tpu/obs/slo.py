@@ -1,0 +1,323 @@
+"""Declarative SLO watchdog over registry snapshots (ISSUE 16).
+
+The registry already *measures* everything that matters (round latency,
+async throughput, fold lag, buffered peak, dedup pressure, canary health,
+prefetch overlap) — but nothing *watched* it: a regression only surfaced
+when a soak's final assertion tripped.  :class:`SLOEngine` evaluates
+declarative specs against ``MetricsRegistry.snapshot()`` on the existing
+``cross_silo/runtime.py`` timer wheel — NO new threads; the engine is one
+more ``(owner, name)`` timer on the server's (or control plane's shared)
+runtime.
+
+A spec is data, not code::
+
+    {"round_latency": {"metric": "fedml_crosssilo_round_seconds",
+                       "stat": "p95", "op": "<=", "threshold": 2.0},
+     "versions_per_sec": {"metric": "fedml_async_virtual_rounds_total",
+                          "stat": "rate", "op": ">=", "threshold": 0.5},
+     "dedup_ratio": {"metric": "fedml_crosssilo_uploads_deduped_total",
+                     "per": "fedml_async_arrivals_total",
+                     "stat": "value", "op": "<=", "threshold": 0.2}}
+
+``stat``: ``value`` (sum of matching counter/gauge samples), ``sum`` /
+``count`` / ``mean`` (histogram scalars), ``rate`` (per-second delta of
+``value`` between ticks), or ``pNN`` (bucket-interpolated percentile).
+``per`` divides by a second metric's ``value`` (ratios: dedup/arrivals,
+compressed/raw bytes).  ``labels`` restricts matching samples; an engine
+built with ``job=<id>`` adds that filter to every spec — the multi-tenant
+scoping path (``ScopedRegistry`` writes carry the ``job`` label, so a
+per-job engine sees only its tenant's series).
+
+Breach handling is edge-triggered: entering breach emits one alert record
+into the collector trail (and from there OTLP), increments
+``fedml_slo_breaches_total{slo}``, optionally triggers a flight-recorder
+dump (once per SLO), and flips ``fedml_slo_healthy{slo}`` to 0; recovery
+flips it back.  A healthy run records ZERO breaches.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+from ..core.flags import cfg_extra
+from . import registry as obsreg
+
+log = logging.getLogger("fedml_tpu.obs.slo")
+
+__all__ = ["SLOEngine", "engine_from_config", "evaluate_spec"]
+
+SLO_BREACHES = obsreg.REGISTRY.counter(
+    "fedml_slo_breaches_total",
+    "SLO breach transitions (edge-triggered: one per entry into breach), "
+    "by SLO name and tenant job ('' outside multi-tenant).",
+    labels=("slo", "job"),
+)
+SLO_EVALUATIONS = obsreg.REGISTRY.counter(
+    "fedml_slo_evaluations_total",
+    "SLO engine evaluation ticks, by tenant job ('' outside multi-tenant).",
+    labels=("job",),
+)
+SLO_HEALTHY = obsreg.REGISTRY.gauge(
+    "fedml_slo_healthy",
+    "1 while the SLO holds, 0 while breached, by SLO name and tenant job.",
+    labels=("slo", "job"),
+)
+
+_OPS: dict[str, Callable[[float, float], bool]] = {
+    "<=": lambda v, t: v <= t,
+    "<": lambda v, t: v < t,
+    ">=": lambda v, t: v >= t,
+    ">": lambda v, t: v > t,
+}
+
+
+def _matches(sample_labels: dict, want: dict) -> bool:
+    return all(str(sample_labels.get(k)) == str(v) for k, v in want.items())
+
+
+def _family(snapshot: list[dict], name: str) -> Optional[dict]:
+    for fam in snapshot:
+        if fam.get("name") == name:
+            return fam
+    return None
+
+
+def _scalar(fam: dict, labels: dict, field: str) -> float:
+    """Sum one scalar field over matching samples (counters/gauges use
+    ``value``; histograms expose ``count``/``sum``)."""
+    total = 0.0
+    for s in fam.get("samples", ()):
+        if _matches(s.get("labels", {}), labels):
+            total += float(s.get(field, 0.0))
+    return total
+
+
+def _percentile(fam: dict, labels: dict, q: float) -> Optional[float]:
+    """Bucket-interpolated percentile over the matching histogram samples
+    (aggregated counts; returns the bucket upper bound at the quantile)."""
+    buckets = fam.get("buckets")
+    if not buckets:
+        return None
+    counts = [0] * len(buckets)
+    for s in fam.get("samples", ()):
+        if _matches(s.get("labels", {}), labels):
+            for i, c in enumerate(s.get("counts", ())):
+                counts[i] += int(c)
+    total = sum(counts)
+    if total == 0:
+        return None
+    target = q * total
+    cumulative = 0
+    for bound, c in zip(buckets, counts):
+        cumulative += c
+        if cumulative >= target:
+            return float(bound)
+    return float(buckets[-1])
+
+
+def evaluate_spec(spec: dict, snapshot: list[dict], *,
+                  extra_labels: Optional[dict] = None,
+                  rate_state: Optional[dict] = None,
+                  now: Optional[float] = None) -> Optional[float]:
+    """Resolve one spec's observed value against a snapshot; ``None`` when
+    the metric has no matching data yet (no data = no breach — an SLO must
+    not fire before the subsystem it watches has run)."""
+    fam = _family(snapshot, str(spec["metric"]))
+    if fam is None:
+        return None
+    labels = {**(spec.get("labels") or {}), **(extra_labels or {})}
+    # drop filter keys the family does not declare (a job-scoped engine can
+    # still watch global single-series families like the buffered peak)
+    declared = set(fam.get("labels", ()))
+    labels = {k: v for k, v in labels.items() if k in declared}
+    stat = str(spec.get("stat", "value")).lower()
+    hist = fam.get("kind") == "histogram"
+    if stat.startswith("p") and stat[1:].isdigit():
+        return _percentile(fam, labels, int(stat[1:]) / 100.0) if hist else None
+    if stat == "mean":
+        if not hist:
+            return None
+        n = _scalar(fam, labels, "count")
+        return (_scalar(fam, labels, "sum") / n) if n else None
+    if stat in ("sum", "count"):
+        if not hist:
+            return None
+        v = _scalar(fam, labels, stat)
+    elif stat == "rate":
+        if rate_state is None:
+            return None
+        v = _scalar(fam, labels, "count" if hist else "value")
+    else:  # "value"
+        v = _scalar(fam, labels, "count" if hist else "value")
+    if stat == "rate":
+        t = now if now is not None else time.monotonic()
+        prev = rate_state.get("prev")
+        rate_state["prev"] = (t, v)
+        if prev is None:
+            return None
+        dt = t - prev[0]
+        if dt <= 0:
+            return None
+        v = (v - prev[1]) / dt
+    per = spec.get("per")
+    if per:
+        per_fam = _family(snapshot, str(per))
+        if per_fam is None:
+            return None
+        denom = _scalar(per_fam, labels if set(per_fam.get("labels", ())) >= set(labels) else {},
+                        "count" if per_fam.get("kind") == "histogram" else "value")
+        if denom == 0:
+            return None
+        v = v / denom
+    return float(v)
+
+
+class SLOEngine:
+    """Evaluate declarative SLO specs on the timer wheel; emit breaches."""
+
+    def __init__(self, specs: dict, *, runtime=None, interval_s: float = 1.0,
+                 registry: Optional[obsreg.MetricsRegistry] = None,
+                 collector=None, otlp=None, flight=None, job: str = ""):
+        self.specs = {str(k): dict(v) for k, v in dict(specs or {}).items()}
+        for name, spec in self.specs.items():
+            op = str(spec.get("op", "<="))
+            if op not in _OPS:
+                raise ValueError(f"SLO {name!r}: unknown op {op!r}")
+            if "metric" not in spec or "threshold" not in spec:
+                raise ValueError(f"SLO {name!r}: needs 'metric' and 'threshold'")
+        self.runtime = runtime
+        self.interval_s = max(0.05, float(interval_s))
+        self.registry = registry or obsreg.REGISTRY
+        self.collector = collector
+        self.otlp = otlp
+        self.flight = flight
+        self.job = str(job or "")
+        self._rate_state: dict[str, dict] = {n: {} for n in self.specs}
+        self._breached: dict[str, bool] = {n: False for n in self.specs}
+        self._dumped: set[str] = set()
+        self.evaluations = 0
+        self.breach_records: list[dict] = []
+        self._started = False
+        self._stopped = False
+
+    # -- timer-wheel lifecycle ------------------------------------------------
+    def start(self) -> "SLOEngine":
+        if self.runtime is None:
+            raise ValueError("SLOEngine.start needs a ServerRuntime")
+        self._started = True
+        self.runtime.arm(self, "slo_tick", self.interval_s, self._tick)
+        return self
+
+    def _tick(self) -> None:
+        if self._stopped:
+            return
+        try:
+            self.evaluate_now()
+        except Exception:
+            log.exception("slo: evaluation tick failed")
+        if not self._stopped:
+            self.runtime.arm(self, "slo_tick", self.interval_s, self._tick)
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        try:
+            # final pass: even a run shorter than one tick interval gets one
+            # end-of-run evaluation, and a breach that lands between the last
+            # tick and teardown still gets caught before the registry goes
+            # quiet
+            self.evaluate_now()
+        except Exception:
+            log.exception("slo: final evaluation failed")
+        if self._started and self.runtime is not None:
+            self.runtime.cancel(self)
+
+    close = stop
+
+    # -- evaluation -----------------------------------------------------------
+    def evaluate_now(self, snapshot: Optional[list[dict]] = None) -> list[dict]:
+        """One evaluation pass; returns this pass's NEW breach records
+        (edge-triggered).  Public so tests and harnesses can drive the
+        engine without a timer."""
+        snap = snapshot if snapshot is not None else self.registry.snapshot()
+        self.evaluations += 1
+        SLO_EVALUATIONS.inc(job=self.job)
+        extra = {"job": self.job} if self.job else None
+        new_breaches: list[dict] = []
+        for name, spec in self.specs.items():
+            value = evaluate_spec(spec, snap, extra_labels=extra,
+                                  rate_state=self._rate_state[name])
+            if value is None:
+                continue
+            ok = _OPS[str(spec.get("op", "<="))](value, float(spec["threshold"]))
+            SLO_HEALTHY.set(1.0 if ok else 0.0, slo=name, job=self.job)
+            was = self._breached[name]
+            self._breached[name] = not ok
+            if ok or was:
+                continue
+            # entering breach: alert once per transition
+            SLO_BREACHES.inc(slo=name, job=self.job)
+            rec = {"kind": "slo_breach", "slo": name, "ts": round(time.time(), 6),
+                   "metric": spec["metric"], "stat": spec.get("stat", "value"),
+                   "op": spec.get("op", "<="), "threshold": float(spec["threshold"]),
+                   "value": round(value, 9)}
+            if self.job:
+                rec["job"] = self.job
+            new_breaches.append(rec)
+            self.breach_records.append(rec)
+            self._emit(rec)
+        return new_breaches
+
+    def _emit(self, rec: dict) -> None:
+        if self.collector is not None:
+            try:
+                self.collector.ingest(0, [dict(rec)])
+            except Exception:
+                pass
+        if self.otlp is not None and self.collector is None:
+            # collector-less processes still ship the breach (the collector
+            # path already tees into its own exporter)
+            try:
+                self.otlp.export_metrics_now()
+            except Exception:
+                pass
+        if self.flight is not None:
+            try:
+                self.flight.note("slo_breach", **{k: v for k, v in rec.items()
+                                                  if k not in ("kind", "ts")})
+                if rec["slo"] not in self._dumped:
+                    self._dumped.add(rec["slo"])
+                    self.flight.trigger("slo_breach", breach=dict(rec))
+            except Exception:
+                pass
+
+    def summary(self) -> dict:
+        return {
+            "job": self.job,
+            "evaluations": self.evaluations,
+            "breaches": len(self.breach_records),
+            "breached_slos": sorted({r["slo"] for r in self.breach_records}),
+        }
+
+
+def engine_from_config(cfg, *, runtime, collector=None, otlp=None,
+                       flight=None) -> Optional[SLOEngine]:
+    """The gate: ``extra.slo_specs`` unset/empty -> ``None`` (no engine, no
+    timer, bit-identical default path).  Multi-tenant configs scope the
+    engine to their ``mt_job_id`` automatically."""
+    specs = cfg_extra(cfg, "slo_specs")
+    if not specs:
+        return None
+    use_flight = flight if cfg_extra(cfg, "slo_flight_dump") else None
+    try:
+        return SLOEngine(
+            specs, runtime=runtime,
+            interval_s=float(cfg_extra(cfg, "slo_interval_s")),
+            collector=collector, otlp=otlp, flight=use_flight,
+            job=str(cfg_extra(cfg, "mt_job_id") or ""))
+    except (ValueError, TypeError) as e:
+        log.warning("slo: invalid specs (%s) — engine disabled", e)
+        return None

@@ -103,6 +103,12 @@ def tenant_config(cfg, job_id: str, *, journal_root: Optional[str] = None,
     pub = cfg_extra(cfg, "model_publish_dir")
     if pub:
         overrides["model_publish_dir"] = os.path.join(str(pub), f"job_{jid}")
+    # flight bundles (ISSUE 16): each tenant's black boxes land under its
+    # own job dir, so one crashed tenant's postmortem never mixes with a
+    # sibling's
+    fd = cfg_extra(cfg, "flight_dir")
+    if fd:
+        overrides["flight_dir"] = os.path.join(str(fd), f"job_{jid}")
     shared_aot = aot_dir or cfg_extra(cfg, "mt_shared_aot_dir")
     if shared_aot:
         overrides["aot_programs"] = True

@@ -67,7 +67,8 @@ class ServingWorker:
                  flush_ms: float = 2.0, canary_fraction: float = 0.0,
                  canary_min_batches: int = 8, poll_s: float = 0.05,
                  feature_shape=None, aot_dir: Optional[str] = None,
-                 bootstrap_timeout_s: float = 60.0):
+                 bootstrap_timeout_s: float = 60.0,
+                 flight_dir: Optional[str] = None):
         from ..arguments import Config
         from ..models import model_hub
         from .batcher import MicroBatcher
@@ -80,6 +81,13 @@ class ServingWorker:
         self._watcher: Optional[ManifestWatcher] = None
         self._stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
+        self.flight = None
+        if flight_dir:
+            from ..obs.flight import FlightRecorder
+
+            self.flight = FlightRecorder(
+                str(flight_dir), name="serving",
+                meta={"role": "serving", "model": model_name})
 
         version = 0
         if params is None and params_path:
@@ -133,6 +141,10 @@ class ServingWorker:
             self._watch_thread = watch_and_swap(
                 self._watcher, self.swap, self._load_version, self._stop,
                 poll_s=poll_s)
+        if self.flight is not None:
+            self.flight.note("serving_boot", version=version,
+                             canary_fraction=canary_fraction,
+                             aot=bool(aot_dir), publish_dir=bool(publish_dir))
 
     # -- hot swap -------------------------------------------------------------
     def _load_version(self, version: int, path: str, _manifest: dict):
@@ -141,6 +153,12 @@ class ServingWorker:
         params = load_params(path)
         pred = self.predictor.clone_with(params)
         pred.warm()
+        if self.flight is not None:
+            # versions the watcher hands us; whether each one PROMOTED or
+            # rolled back shows up in the stop-dump's swap stats
+            self.flight.note("swap", version=int(version),
+                             prev=int(self.swap.version))
+            self.flight.record_metric_deltas()
         return pred
 
     # -- lifecycle ------------------------------------------------------------
@@ -154,6 +172,11 @@ class ServingWorker:
             self._watch_thread.join(timeout=5.0)
         self.batcher.stop()
         self.runner.stop()
+        if self.flight is not None:
+            self.flight.record_metric_deltas()
+            self.flight.trigger("serving_stop", stats=self.stats(),
+                                version=int(self.swap.version))
+            self.flight.close()
 
     def stats(self) -> dict:
         return {**self.batcher.stats(), **self.swap.stats()}
@@ -194,6 +217,9 @@ def main(argv=None) -> int:
     ap.add_argument("--aot-dir", default=None,
                     help="AOT program store dir: deserialize the exported "
                          "inference apply instead of re-tracing on restart")
+    ap.add_argument("--flight-dir", default=None,
+                    help="flight-recorder bundle dir: record swaps/rollbacks "
+                         "and dump a black box on SIGTERM, crash, or stop")
     args = ap.parse_args(argv)
 
     worker = ServingWorker(
@@ -203,7 +229,11 @@ def main(argv=None) -> int:
         flush_ms=args.flush_ms, canary_fraction=args.canary_fraction,
         canary_min_batches=args.canary_min_batches, poll_s=args.poll_s,
         feature_shape=parse_feature_dim(args.feature_dim),
-        aot_dir=args.aot_dir)
+        aot_dir=args.aot_dir, flight_dir=args.flight_dir)
+    if worker.flight is not None:
+        # one replica per process: the process-wide SIGTERM/excepthook taps
+        # are this worker's to take
+        worker.flight.install_signal_handlers()
     worker.start(block=True)
     return 0
 

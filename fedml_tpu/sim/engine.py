@@ -78,6 +78,48 @@ FUSED_BLOCKS = obsreg.REGISTRY.gauge(
     "1 when the simulator's model routes conv epilogues through the fused "
     "Pallas BasicBlock kernel (extra.fused_blocks), else 0.",
 )
+ACHIEVED_FLOPS = obsreg.REGISTRY.gauge(
+    "fedml_sim_achieved_flops_per_sec",
+    "XLA cost-model FLOPs of the last executed chunk divided by its wall "
+    "time (extra.cost_model_gauges).",
+)
+SIM_MFU = obsreg.REGISTRY.gauge(
+    "fedml_sim_mfu",
+    "Model FLOP utilization of the last executed chunk: achieved FLOP/s "
+    "over the device peak (0 when the device kind has no known peak — "
+    "CPU runs report achieved FLOP/s only).  extra.cost_model_gauges.",
+)
+
+#: dense peak FLOP/s by TPU generation (bf16 MXU throughput, per chip) —
+#: the MFU denominator.  Unlisted device kinds (CPU, GPU backends reached
+#: through the portability shim) report MFU 0 rather than a made-up ratio.
+_PEAK_FLOPS_BY_KIND = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v4i": 138e12,
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _device_peak_flops() -> float:
+    """Aggregate peak FLOP/s across local devices, 0.0 when unknown.  The
+    longest matching kind prefix wins so 'TPU v5 lite' beats 'TPU v5'."""
+    import jax
+
+    try:
+        kind = str(getattr(jax.devices()[0], "device_kind", ""))
+        per_chip = 0.0
+        best = -1
+        for k, v in _PEAK_FLOPS_BY_KIND.items():
+            if kind.lower().startswith(k.lower()) and len(k) > best:
+                per_chip, best = v, len(k)
+        return per_chip * jax.device_count()
+    except Exception:
+        return 0.0
 
 
 from ..core.checkpoint import RoundCheckpointMixin
@@ -113,6 +155,11 @@ class MeshSimulator(RoundCheckpointMixin):
         # server deserializes instead of re-tracing.  Flag unset -> None and
         # every jit below runs the exact pre-store path (bit-identical).
         self._aot = aotlib.store_from_config(cfg, trail=self.logger.log)
+        # cost-model gauges (ISSUE 16 satellite): per-program flops/bytes at
+        # compile, achieved-FLOP/s + MFU per executed chunk.  Flag unset ->
+        # zero extra work on any hot path.
+        self._cost_gauges = bool(cfg_extra(cfg, "cost_model_gauges"))
+        self._chunk_flops: dict = {}
 
         # ---- data: pad + stack, shard over the clients axis ----
         stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
@@ -621,6 +668,10 @@ class MeshSimulator(RoundCheckpointMixin):
                 # AOT unsupported for these inputs — the lazy jit still works
                 fn = jitted
             CHUNK_COMPILE_TIME.observe(time.perf_counter() - t0)
+            if self._cost_gauges:
+                cost = aotlib.record_program_cost(fn, f"sim.multi_round.{n}")
+                if cost is not None:
+                    self._chunk_flops[n] = cost["flops"]
         self._multi_round_fns[n] = fn
         return fn
 
@@ -664,6 +715,11 @@ class MeshSimulator(RoundCheckpointMixin):
             ) from e
         execute_s = time.perf_counter() - t0
         CHUNK_EXECUTE_TIME.observe(execute_s)
+        if self._cost_gauges and self._chunk_flops.get(n):
+            achieved = self._chunk_flops[n] / max(execute_s, 1e-9)
+            ACHIEVED_FLOPS.set(achieved)
+            peak = _device_peak_flops()
+            SIM_MFU.set(achieved / peak if peak else 0.0)
         for _ in range(n):
             ROUND_TIME.observe(execute_s / n)
         self.global_vars, self.server_state, self.client_states = gv, ss, cs

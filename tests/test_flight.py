@@ -1,0 +1,208 @@
+"""Flight recorder (ISSUE 16): bounded black-box ring + atomic bundles.
+
+- the ring is allocation-bounded under sustained load (tracemalloc: peak
+  does not scale with the number of events pushed, only with capacity);
+- bundle envelope roundtrip (MAGIC + meta line + JSON body, atomic
+  tmp+os.replace — no torn/tmp files left behind) and foreign-file
+  rejection;
+- the dump window filter, metric-delta capture, and trigger accounting
+  (``fedml_flight_dumps_total{reason}``);
+- excepthook/SIGTERM chaining installs and uninstalls cleanly;
+- the config gate: ``extra.flight_recorder`` unset -> ``None`` (no ring,
+  no taps, no handlers — the bit-identical-default half lives in
+  test_postmortem's A/B run).
+"""
+
+import json
+import os
+import sys
+import threading
+import tracemalloc
+
+import pytest
+
+from fedml_tpu.obs import flight as flightlib
+from fedml_tpu.obs import registry as obsreg
+
+
+def _recorder(tmp_path, **kw):
+    kw.setdefault("capacity", 64)
+    kw.setdefault("window_s", 0.0)  # <= 0: dump everything in the ring
+    return flightlib.FlightRecorder(str(tmp_path), name="t", **kw)
+
+
+# ---------------------------------------------------------------------------
+# bounded memory
+
+
+def test_ring_memory_is_capacity_bounded_not_load_bounded(tmp_path):
+    """Push 40k events through a 256-slot ring: traced peak must track the
+    ring capacity, not the event count.  The comparison run pushes 10x
+    fewer events — a leaky ring scales ~10x; a bounded one stays flat."""
+    payload = "x" * 200
+
+    def pump(n_events):
+        rec = _recorder(tmp_path / f"r{n_events}", capacity=256)
+        tracemalloc.start()
+        for i in range(n_events):
+            rec.note("load", i=i, payload=payload, client=i % 7)
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert len(rec.events()) == 256
+        return peak
+
+    small = pump(4_000)
+    large = pump(40_000)
+    # bounded: 10x the traffic must not cost anywhere near 10x the memory
+    assert large < small * 3 + 1_000_000, (small, large)
+
+
+def test_note_never_raises_even_from_threads(tmp_path):
+    rec = _recorder(tmp_path, capacity=32)
+    errs = []
+
+    def hammer():
+        try:
+            for i in range(2_000):
+                rec.note("t", i=i, obj=object())  # non-serializable is fine
+        except BaseException as e:  # noqa: BLE001 — the assertion target
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(rec.events()) == 32
+
+
+# ---------------------------------------------------------------------------
+# bundles
+
+
+def test_bundle_envelope_roundtrip_and_atomicity(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.note("upload", client=3, key="3:0:-1:0")
+    rec.note("epoch", event="recovery", step=2)
+    path = rec.dump("unit_test", context={"why": "roundtrip"})
+    assert os.path.dirname(path) == str(tmp_path)
+
+    bundle = flightlib.read_bundle(path)
+    assert bundle["meta"]["format"] == "fedml-flight-v1"
+    assert bundle["meta"]["reason"] == "unit_test"
+    assert bundle["meta"]["name"] == "t"
+    assert bundle["meta"]["n_events"] == 2
+    assert bundle["context"] == {"why": "roundtrip"}
+    kinds = [e["kind"] for e in bundle["events"]]
+    assert kinds == ["upload", "epoch"]
+    # atomic write: no tmp droppings, and list_bundles skips them anyway
+    assert not [f for f in os.listdir(tmp_path) if f.startswith(".tmp_")]
+    assert flightlib.list_bundles(str(tmp_path)) == [path]
+
+
+def test_read_bundle_rejects_foreign_and_torn_files(tmp_path):
+    foreign = tmp_path / "x.flight"
+    foreign.write_bytes(b"not a bundle")
+    with pytest.raises(ValueError):
+        flightlib.read_bundle(str(foreign))
+    torn = tmp_path / "y.flight"
+    torn.write_bytes(b"FMLFLT1\n" + b'{"no": "newline"')
+    with pytest.raises(ValueError):
+        flightlib.read_bundle(str(torn))
+
+
+def test_dump_window_filters_old_events(tmp_path):
+    rec = _recorder(tmp_path, window_s=60.0)
+    rec.note("old")
+    with rec._lock:  # age the event past the window
+        rec._ring[0]["ts"] -= 120.0
+    rec.note("fresh")
+    events = rec.events()
+    assert [e["kind"] for e in events] == ["fresh"]
+    # window <= 0 keeps everything
+    assert len(rec.events(window_s=0)) == 2
+
+
+def test_trigger_counts_and_sequences_bundles(tmp_path):
+    rec = _recorder(tmp_path)
+    before = flightlib.FLIGHT_DUMPS.value(reason="unit_seq")
+    p1 = rec.trigger("unit_seq", detail=1)
+    p2 = rec.trigger("unit_seq", detail=2)
+    assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+    assert flightlib.FLIGHT_DUMPS.value(reason="unit_seq") == before + 2
+    # the trigger note itself rides in the bundle
+    b2 = flightlib.read_bundle(p2)
+    assert [e for e in b2["events"] if e["kind"] == "trigger"]
+    assert b2["context"]["detail"] == 2
+    assert b2["meta"]["seq"] == flightlib.read_bundle(p1)["meta"]["seq"] + 1
+
+
+def test_metric_deltas_ring_only_changes(tmp_path):
+    reg = obsreg.MetricsRegistry()
+    c = reg.counter("fedml_test_flight_events_total", "t")
+    rec = _recorder(tmp_path, registry=reg)
+    assert rec.record_metric_deltas() == 0  # first call: baseline only
+    c.inc(3)
+    assert rec.record_metric_deltas() == 1
+    assert rec.record_metric_deltas() == 0  # nothing moved
+    deltas = [e for e in rec.events() if e["kind"] == "metrics_delta"]
+    assert len(deltas) == 1
+    assert deltas[0]["delta"]["fedml_test_flight_events_total"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# triggers: hooks + signal chaining
+
+
+def test_excepthook_chain_installs_and_uninstalls(tmp_path):
+    rec = _recorder(tmp_path)
+    prev_hook, prev_thook = sys.excepthook, threading.excepthook
+    rec.install_signal_handlers()
+    try:
+        assert sys.excepthook is not prev_hook
+        sys.excepthook(ValueError, ValueError("boom"), None)
+        bundles = flightlib.list_bundles(str(tmp_path))
+        assert len(bundles) == 1
+        b = flightlib.read_bundle(bundles[0])
+        assert b["meta"]["reason"] == "unhandled_exception"
+        assert b["context"] == {"exc_type": "ValueError", "exc": "boom"}
+    finally:
+        rec.uninstall_signal_handlers()
+    assert sys.excepthook is prev_hook
+    assert threading.excepthook is prev_thook
+
+
+def test_close_is_idempotent_and_detaches(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.attach_comm()
+    rec.install_signal_handlers()
+    prev = sys.excepthook
+    rec.close()
+    rec.close()
+    assert sys.excepthook is not prev or rec._prev_excepthook is None
+    assert rec._comm_sink is None
+
+
+# ---------------------------------------------------------------------------
+# the gate
+
+
+def test_recorder_from_config_gate(tmp_path):
+    from .conftest import tiny_config
+
+    cfg = tiny_config()
+    cfg.extra = {}
+    assert flightlib.recorder_from_config(cfg, name="x") is None
+    assert flightlib.recorder_from_config(None, name="x") is None
+
+    cfg.extra = {"flight_recorder": True, "flight_dir": str(tmp_path / "fd"),
+                 "flight_capacity": 128, "flight_window_s": 5.0}
+    rec = flightlib.recorder_from_config(cfg, name="x", meta={"role": "test"})
+    assert rec is not None
+    assert rec.capacity == 128 and rec.window_s == 5.0
+    assert rec.meta["role"] == "test"
+    assert os.path.isdir(tmp_path / "fd")
+    path = rec.trigger("gate_check")
+    assert json.loads(b"{}") == {} and path is not None  # bundle landed
+    rec.close()

@@ -23,16 +23,23 @@ from fedml_tpu.analysis.rules.gl005_metrics import (
 #: list when instrumenting a new layer
 INSTRUMENTED_MODULES = [
     "fedml_tpu.comm.base",
+    "fedml_tpu.comm.chaos",
     "fedml_tpu.comm.codecs",
+    "fedml_tpu.core.aot",
+    "fedml_tpu.cross_silo.async_server",
     "fedml_tpu.cross_silo.client_journal",
     "fedml_tpu.cross_silo.journal",
     "fedml_tpu.cross_silo.runtime",
     "fedml_tpu.cross_silo.server",
     "fedml_tpu.sched.multi_tenant",
+    "fedml_tpu.obs.flight",
     "fedml_tpu.obs.health",
     "fedml_tpu.obs.otlp",
     "fedml_tpu.obs.remote",
+    "fedml_tpu.obs.slo",
     "fedml_tpu.ops.pallas.timing",
+    "fedml_tpu.population.cohorts",
+    "fedml_tpu.population.store",
     "fedml_tpu.serving.batcher",
     "fedml_tpu.serving.publisher",
     "fedml_tpu.sim.engine",

@@ -1,0 +1,217 @@
+"""Causal postmortem (ISSUE 16 tentpole, part c) + the default-path
+acceptance criterion.
+
+- ``stitch_bundles`` over the bundles a REAL ``run_kill_recover_soak``
+  leaves behind: the fleet and both server incarnations stitch into one
+  timeline, the kill and the recovery are both visible, and EVERY lost
+  upload gets a cause (``unattributed_lost == 0`` — the acceptance bar);
+- ``render_postmortem`` says what a human asks first (what was in flight,
+  what was lost and why, accounting verdict);
+- ``fedml-tpu obs postmortem`` exit codes: 0 on a fully-attributed run,
+  1 on no bundles, 2 on a missing path; ``--json`` emits the stitched dict;
+- corrupt bundles are skipped, not fatal;
+- the A/B half of the acceptance criterion: the same seeded INPROC
+  cross-silo run with flight + SLO + cost-model gauges ON converges to the
+  BITWISE-identical global model as the all-defaults run, with zero SLO
+  breaches recorded along the way.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fedml_tpu.obs import flight as flightlib
+from fedml_tpu.obs.postmortem import render_postmortem, stitch_bundles
+
+_ATTRIBUTIONS = {"in_flight_at_kill", "in_kill_gap", "in_killed_epoch",
+                 "post_finish", "chaos_silent_loss"}
+
+
+@pytest.fixture(scope="module")
+def kill_run(tmp_path_factory, eight_devices):
+    """One real kill-and-recover soak with flight recording on; every test
+    below reads the same bundle set."""
+    from fedml_tpu.cross_silo.async_soak import run_kill_recover_soak
+
+    flight_dir = str(tmp_path_factory.mktemp("flight"))
+    res = run_kill_recover_soak(
+        n_clients=16, concurrency=8, buffer_k=4, versions=3,
+        drop_prob=0.05, latency_mean_s=0.002, redispatch_timeout_s=1.0,
+        seed=0, timeout_s=180.0,
+        extra_flags={"flight_recorder": True, "flight_dir": flight_dir})
+    assert res["monotone"] and res["unaccounted"] == 0, res
+    return flight_dir, res
+
+
+def test_stitch_joins_kill_recovery_and_attributes_every_loss(kill_run):
+    flight_dir, _ = kill_run
+    stitched = stitch_bundles(flight_dir)
+
+    names = {b["name"] for b in stitched["bundles"]}
+    reasons = {b["reason"] for b in stitched["bundles"]}
+    assert "fleet" in names, stitched["bundles"]
+    assert "hard_kill" in reasons, stitched["bundles"]
+
+    # the merged timeline interleaves sources and is time-ordered
+    assert stitched["timeline"]
+    ts = [e["ts"] for e in stitched["timeline"]]
+    assert ts == sorted(ts)
+    assert len({e["src"] for e in stitched["timeline"]}) >= 2
+
+    assert stitched["kills"], "hard_kill bundle carried no kill context"
+    assert stitched["recoveries"], "no recovery event in any ring"
+    # the kill context names the in-flight dispatch ledger
+    assert any((k["context"] or {}).get("outstanding") is not None
+               or (k["context"] or {}).get("prev_epoch_inflight") is not None
+               for k in stitched["kills"])
+
+    # the acceptance bar: nothing unaccounted, nothing unattributable
+    assert (stitched["unaccounted"] or 0) == 0, stitched["accounting"]
+    up = stitched["uploads"]
+    assert up["sent"] > 0
+    assert sum(up["arrived"].values()) > 0
+    assert up["unattributed_lost"] == 0, up["lost"]
+    for rec in up["lost"]:
+        assert rec["attribution"] in _ATTRIBUTIONS, rec
+
+
+def test_render_answers_the_human_questions(kill_run):
+    flight_dir, _ = kill_run
+    stitched = stitch_bundles(flight_dir)
+    text = render_postmortem(stitched, limit=10)
+    assert f"{len(stitched['bundles'])} bundle(s)" in text
+    assert "in flight at the kill" in text
+    assert "recovered:" in text
+    assert "upload ledger:" in text
+    assert "OK — every loss accounted" in text
+    assert "WARNING" not in text
+    # limit trims the timeline but keeps the ledger
+    assert f"timeline (10/{len(stitched['timeline'])} events" in text
+
+
+def test_cli_exit_codes_and_json(kill_run, tmp_path, capsys):
+    from fedml_tpu.cli import main as cli_main
+
+    flight_dir, _ = kill_run
+    assert cli_main(["obs", "postmortem", flight_dir]) == 0
+    assert "upload ledger:" in capsys.readouterr().out
+
+    assert cli_main(["obs", "postmortem", flight_dir, "--json"]) == 0
+    stitched = json.loads(capsys.readouterr().out)
+    assert stitched["uploads"]["unattributed_lost"] == 0
+
+    assert cli_main(["obs", "postmortem", str(tmp_path / "nope")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_main(["obs", "postmortem", str(empty)]) == 1
+    capsys.readouterr()
+
+
+def test_corrupt_bundles_are_skipped_not_fatal(kill_run, tmp_path):
+    flight_dir, _ = kill_run
+    good = stitch_bundles(flight_dir)
+    # drop garbage next to the real bundles: same stitch must come out
+    (tmp_path / "d").mkdir()
+    for p in flightlib.list_bundles(flight_dir):
+        data = open(p, "rb").read()
+        open(tmp_path / "d" / os.path.basename(p), "wb").write(data)
+    (tmp_path / "d" / "zz.flight").write_bytes(b"FMLFLT1\ngarbage")
+    (tmp_path / "d" / "aa.flight").write_bytes(b"not a bundle at all")
+    dirty = stitch_bundles(str(tmp_path / "d"))
+    assert len(dirty["bundles"]) == len(good["bundles"])
+    assert dirty["uploads"] == good["uploads"]
+
+
+def test_stitch_attributes_unknown_loss_as_unattributed(tmp_path):
+    """The red-flag path: a sender-recorded key the server never saw, with
+    no kill, no gap, no chaos budget — MUST come out unattributed (that is
+    the postmortem's whole alarm)."""
+    rec = flightlib.FlightRecorder(str(tmp_path), name="fleet")
+    rec.note("reply", client=1, version=0, key="1:0:-1:0")
+    rec.note("virtual_round", version=99, arrivals=1)  # run "ended" after
+    rec.dump("soak_finish", context={"unaccounted": 1})
+    stitched = stitch_bundles(str(tmp_path))
+    assert stitched["uploads"]["unattributed_lost"] == 1
+    assert stitched["unaccounted"] == 1
+    text = render_postmortem(stitched)
+    assert "VIOLATION" in text and "WARNING" in text
+
+    from fedml_tpu.cli import main as cli_main
+
+    assert cli_main(["obs", "postmortem", str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# acceptance: all-flags-on run is bitwise the default run
+
+
+def _cross_silo_run(run_id, extra):
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    from .conftest import tiny_config
+
+    cfg = tiny_config(training_type="cross_silo", run_id=run_id,
+                      client_num_in_total=2, client_num_per_round=2,
+                      comm_round=2, frequency_of_the_test=0)
+    cfg.extra = dict(extra)
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    InProcRouter.reset(run_id)
+    clients = [build_client(cfg, ds, model, rank=r, backend="INPROC")
+               for r in (1, 2)]
+    for c in clients:
+        c.run_in_thread()
+    server = build_server(cfg, ds, model, backend="INPROC")
+    try:
+        history = server.run_until_done(timeout=120.0)
+    finally:
+        for c in clients:
+            c.finish()
+    slo_summary = server.slo.summary() if server.slo is not None else None
+    return (history, jax.device_get(server.aggregator.global_vars),
+            slo_summary)
+
+
+def test_observability_on_is_bitwise_identical_and_breach_free(
+        eight_devices, tmp_path):
+    """Flight recorder + SLO watchdog + cost-model gauges all ON must not
+    perturb training by one bit, and a healthy run records ZERO breaches."""
+    hist_off, vars_off, slo_off = _cross_silo_run("pm_obs_off", {})
+    assert slo_off is None  # default path: no engine at all
+
+    obs_extra = {
+        "flight_recorder": True,
+        "flight_dir": str(tmp_path / "flt"),
+        "slo_flight_dump": True,
+        "cost_model_gauges": True,
+        "slo_interval_s": 0.2,
+        "slo_specs": {
+            "round_p95": {"metric": "fedml_crosssilo_round_seconds",
+                          "stat": "p95", "op": "<=", "threshold": 120.0},
+            "rounds_done": {"metric": "fedml_crosssilo_rounds_total",
+                            "op": "<=", "threshold": 1e9},
+        },
+    }
+    hist_on, vars_on, slo_on = _cross_silo_run("pm_obs_on", obs_extra)
+
+    import jax
+
+    assert [h["round"] for h in hist_off] == [h["round"] for h in hist_on]
+    leaves_off = jax.tree_util.tree_leaves(vars_off)
+    leaves_on = jax.tree_util.tree_leaves(vars_on)
+    assert len(leaves_off) == len(leaves_on)
+    for a, b in zip(leaves_off, leaves_on):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    assert slo_on is not None
+    assert slo_on["evaluations"] >= 1
+    assert slo_on["breaches"] == 0 and slo_on["breached_slos"] == []

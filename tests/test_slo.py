@@ -1,0 +1,230 @@
+"""SLO watchdog (ISSUE 16): declarative specs over registry snapshots.
+
+- ``evaluate_spec`` stat resolution (value/sum/count/mean/percentile/rate,
+  the ``per`` ratio, label filters, and the no-data -> no-verdict rule);
+- edge-triggered breach semantics: one alert record + one counter bump per
+  transition, ``fedml_slo_healthy`` flips and recovers, breaches land in
+  the collector trail and (once per SLO) a flight dump;
+- per-job scoping: a ``job=``-bound engine over ``ScopedRegistry`` series
+  sees only its tenant's samples;
+- the config gate (``extra.slo_specs`` unset -> ``None``, invalid specs ->
+  disabled loudly, not a crash);
+- the healthy e2e half of the acceptance criterion: a clean async soak
+  with generous SLOs records >= 1 evaluation and ZERO breaches.
+"""
+
+import pytest
+
+from fedml_tpu.obs import registry as obsreg
+from fedml_tpu.obs.slo import (
+    SLO_BREACHES,
+    SLO_HEALTHY,
+    SLOEngine,
+    engine_from_config,
+    evaluate_spec,
+)
+
+
+def _counter_snap(name, samples, labels=()):
+    return {"name": name, "kind": "counter", "labels": list(labels),
+            "samples": samples}
+
+
+def _gauge_snap(name, samples, labels=()):
+    return {"name": name, "kind": "gauge", "labels": list(labels),
+            "samples": samples}
+
+
+def _hist_snap(name, buckets, samples, labels=()):
+    return {"name": name, "kind": "histogram", "labels": list(labels),
+            "buckets": list(buckets), "samples": samples}
+
+
+# ---------------------------------------------------------------------------
+# evaluate_spec
+
+
+def test_value_sums_matching_samples_and_filters_labels():
+    snap = [_counter_snap("fedml_t_total", [
+        {"labels": {"path": "fold"}, "value": 7.0},
+        {"labels": {"path": "buffer"}, "value": 2.0},
+    ], labels=("path",))]
+    assert evaluate_spec({"metric": "fedml_t_total", "threshold": 0}, snap) == 9.0
+    assert evaluate_spec({"metric": "fedml_t_total", "threshold": 0,
+                          "labels": {"path": "fold"}}, snap) == 7.0
+    # undeclared filter keys are dropped, not silently non-matching: a
+    # job-scoped engine can still watch global single-series families
+    assert evaluate_spec({"metric": "fedml_t_total", "threshold": 0},
+                         snap, extra_labels={"job": "1"}) == 9.0
+
+
+def test_no_data_means_no_verdict():
+    assert evaluate_spec({"metric": "fedml_absent", "threshold": 1}, []) is None
+    hist = [_hist_snap("fedml_h", [1.0, float("inf")], [])]
+    assert evaluate_spec({"metric": "fedml_h", "stat": "p95", "threshold": 1},
+                         hist) is None  # zero observations -> no percentile
+    assert evaluate_spec({"metric": "fedml_h", "stat": "mean", "threshold": 1},
+                         hist) is None
+
+
+def test_histogram_stats_mean_count_sum_percentile():
+    snap = [_hist_snap("fedml_h_seconds", [0.1, 1.0, float("inf")], [
+        {"labels": {}, "count": 10, "sum": 4.0, "counts": [8, 2, 0]},
+    ])]
+    spec = {"metric": "fedml_h_seconds", "threshold": 0}
+    assert evaluate_spec({**spec, "stat": "count"}, snap) == 10.0
+    assert evaluate_spec({**spec, "stat": "sum"}, snap) == 4.0
+    assert evaluate_spec({**spec, "stat": "mean"}, snap) == pytest.approx(0.4)
+    assert evaluate_spec({**spec, "stat": "p50"}, snap) == pytest.approx(0.1)
+    assert evaluate_spec({**spec, "stat": "p95"}, snap) == pytest.approx(1.0)
+
+
+def test_rate_needs_two_ticks_and_divides_by_wall():
+    state = {}
+    snap1 = [_counter_snap("fedml_r_total", [{"labels": {}, "value": 10.0}])]
+    snap2 = [_counter_snap("fedml_r_total", [{"labels": {}, "value": 25.0}])]
+    spec = {"metric": "fedml_r_total", "stat": "rate", "threshold": 0}
+    assert evaluate_spec(spec, snap1, rate_state=state, now=100.0) is None
+    assert evaluate_spec(spec, snap2, rate_state=state, now=105.0) == pytest.approx(3.0)
+
+
+def test_per_ratio_and_zero_denominator():
+    snap = [
+        _counter_snap("fedml_dedup_total", [{"labels": {}, "value": 3.0}]),
+        _counter_snap("fedml_arrivals_total", [{"labels": {}, "value": 12.0}]),
+    ]
+    spec = {"metric": "fedml_dedup_total", "per": "fedml_arrivals_total",
+            "threshold": 0}
+    assert evaluate_spec(spec, snap) == pytest.approx(0.25)
+    snap[1]["samples"][0]["value"] = 0.0
+    assert evaluate_spec(spec, snap) is None  # no denominator -> no verdict
+
+
+# ---------------------------------------------------------------------------
+# the engine: edge-triggered breaches
+
+
+class _TrailStub:
+    def __init__(self):
+        self.records = []
+
+    def ingest(self, sender, batch):
+        self.records.extend(batch)
+
+
+def _engine(specs, **kw):
+    return SLOEngine(specs, registry=obsreg.MetricsRegistry(), **kw)
+
+
+def test_breach_is_edge_triggered_once_and_recovers(tmp_path):
+    from fedml_tpu.obs.flight import FlightRecorder, list_bundles
+
+    trail = _TrailStub()
+    flight = FlightRecorder(str(tmp_path), name="slo_t")
+    eng = _engine({"lag": {"metric": "fedml_lag", "stat": "value",
+                           "op": "<=", "threshold": 5.0}},
+                  collector=trail, flight=flight)
+    breached = [_gauge_snap("fedml_lag", [{"labels": {}, "value": 9.0}])]
+    healthy = [_gauge_snap("fedml_lag", [{"labels": {}, "value": 1.0}])]
+    before = SLO_BREACHES.value(slo="lag", job="")
+
+    assert eng.evaluate_now(healthy) == []
+    new = eng.evaluate_now(breached)
+    assert len(new) == 1 and new[0]["slo"] == "lag" and new[0]["value"] == 9.0
+    assert eng.evaluate_now(breached) == []  # still breached: no re-alert
+    assert SLO_BREACHES.value(slo="lag", job="") == before + 1
+    assert SLO_HEALTHY.value(slo="lag", job="") == 0.0
+
+    assert eng.evaluate_now(healthy) == []  # recovery flips healthy back
+    assert SLO_HEALTHY.value(slo="lag", job="") == 1.0
+    new2 = eng.evaluate_now(breached)  # NEW transition -> alerts again
+    assert len(new2) == 1
+    assert SLO_BREACHES.value(slo="lag", job="") == before + 2
+
+    # both transitions hit the collector trail; the flight dump fired ONCE
+    assert [r["slo"] for r in trail.records] == ["lag", "lag"]
+    assert all(r["kind"] == "slo_breach" for r in trail.records)
+    dumps = [p for p in list_bundles(str(tmp_path)) if "slo_breach" in p]
+    assert len(dumps) == 1
+    assert eng.summary()["breaches"] == 2
+    assert eng.summary()["breached_slos"] == ["lag"]
+
+
+def test_job_scoped_engine_sees_only_its_tenant():
+    reg = obsreg.MetricsRegistry()
+    fam = reg.counter("fedml_t_rounds_total", "t", labels=("job",))
+    fam.inc(100, job="1")  # tenant 1 is way over
+    fam.inc(1, job="2")    # tenant 2 is fine
+    spec = {"metric": "fedml_t_rounds_total", "op": "<=", "threshold": 10}
+    e1 = SLOEngine({"rounds": spec}, registry=reg, job="1")
+    e2 = SLOEngine({"rounds": spec}, registry=reg, job="2")
+    assert len(e1.evaluate_now()) == 1
+    assert e2.evaluate_now() == []
+    assert SLO_HEALTHY.value(slo="rounds", job="1") == 0.0
+    assert SLO_HEALTHY.value(slo="rounds", job="2") == 1.0
+    # the breach record carries the job for downstream attribution
+    assert e1.breach_records[0]["job"] == "1"
+
+
+def test_scoped_registry_writes_feed_job_scoped_specs():
+    """The multi-tenant path end to end: ScopedRegistry stamps the job
+    label on write, and the per-job engine filters on it."""
+    reg = obsreg.MetricsRegistry()
+    s1 = reg.scoped(job="a").counter("fedml_t_scoped_total", "t")
+    s2 = reg.scoped(job="b").counter("fedml_t_scoped_total", "t")
+    s1.inc(50)
+    s2.inc(2)
+    spec = {"metric": "fedml_t_scoped_total", "op": "<=", "threshold": 10}
+    assert len(SLOEngine({"x": spec}, registry=reg, job="a").evaluate_now()) == 1
+    assert SLOEngine({"x": spec}, registry=reg, job="b").evaluate_now() == []
+
+
+def test_engine_rejects_bad_specs_loudly():
+    with pytest.raises(ValueError):
+        _engine({"x": {"metric": "m", "op": "!=", "threshold": 1}})
+    with pytest.raises(ValueError):
+        _engine({"x": {"metric": "m"}})  # no threshold
+    with pytest.raises(ValueError):
+        _engine({"x": {"threshold": 1}})  # no metric
+
+
+def test_engine_from_config_gate():
+    from .conftest import tiny_config
+
+    cfg = tiny_config()
+    cfg.extra = {}
+    assert engine_from_config(cfg, runtime=None) is None
+    # invalid specs disable the engine instead of crashing the server
+    cfg.extra = {"slo_specs": {"x": {"metric": "m", "op": "!=", "threshold": 1}}}
+    assert engine_from_config(cfg, runtime=None) is None
+    cfg.extra = {"slo_specs": {"x": {"metric": "fedml_lag", "threshold": 5}},
+                 "slo_interval_s": 0.25, "mt_job_id": "7"}
+    eng = engine_from_config(cfg, runtime=None)
+    assert eng is not None and eng.interval_s == 0.25 and eng.job == "7"
+    # slo_flight_dump unset -> the flight recorder is NOT handed over
+    assert eng.flight is None
+
+
+# ---------------------------------------------------------------------------
+# healthy e2e: zero breaches on a clean run (acceptance criterion)
+
+
+def test_clean_async_soak_records_zero_breaches(eight_devices):
+    from fedml_tpu.cross_silo.async_soak import run_soak
+
+    specs = {
+        "buffered_peak": {"metric": "fedml_crosssilo_buffered_updates_peak",
+                          "stat": "value", "op": "<=", "threshold": 64},
+        "fold_lag_p95": {"metric": "fedml_async_fold_lag_seconds",
+                         "stat": "p95", "op": "<=", "threshold": 120.0},
+        "versions_rate": {"metric": "fedml_async_virtual_rounds_total",
+                          "stat": "rate", "op": ">=", "threshold": 0.0},
+    }
+    res = run_soak(n_clients=32, concurrency=8, buffer_k=4, versions=3,
+                   drop_prob=0.0, latency_mean_s=0.001,
+                   redispatch_timeout_s=1.0, seed=0, timeout_s=120.0,
+                   extra_flags={"slo_specs": specs, "slo_interval_s": 0.1})
+    assert res["versions"] == 3
+    slo = res["slo"]
+    assert slo["evaluations"] >= 1  # the engine ran (timer wheel or final pass)
+    assert slo["breaches"] == 0 and slo["breached_slos"] == []
