@@ -54,6 +54,12 @@ Three benches, one JSON line:
    shared event-driven runtime) vs the 8x-sequential baseline — aggregate
    versions/s ratio (floor >= 0.5x, exit 3, one-retry) plus the p95
    round-latency interference of sharing the pool.
+11. **Hierarchical aggregation tree** (ISSUE 17): 16 clients flat vs a
+   fanout-8 edge tree, qsgd8 on every hop — root ingress bytes ratio
+   (floor >= 4x, exit 3, one-retry), peak buffered <= 2 per hop, and an
+   edge-SIGKILL leg whose journal recovery must close the accounting
+   identity and reproduce the clean tree run's final global bitwise;
+   `--mode hierarchy` runs just this section.
 
 The reference publishes no numeric baselines (BASELINE.md) and has no MFU
 accounting at all; the 0.35 target comes from BASELINE.json's north star.
@@ -975,6 +981,64 @@ def bench_secagg():
     }
 
 
+def bench_hierarchy():
+    """Hierarchical aggregation tree (ISSUE 17): O(edges) root fan-in.
+
+    Three legs on one 16-client fleet, all over the qsgd8 client wire:
+    (1) the flat protocol — every upload lands on rank 0; (2) a fanout-8
+    edge tree with qsgd8 re-encode on the edge->root hop — the root sees
+    ceil(16/8)=2 pre-folded partials per round, so its ingress bytes must
+    drop >= HIER_ROOT_BYTES_RATIO_FLOOR; (3) the same tree with one edge
+    SIGKILLed mid-round — the journal-restored replacement dedups the
+    re-sent uploads, the accounting identity closes, and the final global
+    is BITWISE the clean tree run's."""
+    from fedml_tpu.cross_silo.async_soak import run_edge_kill_soak
+
+    n = int(os.environ.get("BENCH_HIER_CLIENTS", "16"))
+    fanout = int(os.environ.get("BENCH_HIER_FANOUT", "8"))
+    rounds = int(os.environ.get("BENCH_HIER_ROUNDS", "2"))
+    flat = run_edge_kill_soak(n_clients=n, fanout=0, rounds=rounds,
+                              kill=None, seed=0, codec="qsgd8",
+                              timeout_s=180.0)
+    tree = run_edge_kill_soak(n_clients=n, fanout=fanout, rounds=rounds,
+                              kill=None, seed=0, codec="qsgd8",
+                              hop_codec="qsgd8", timeout_s=180.0)
+    kill = run_edge_kill_soak(n_clients=n, fanout=fanout, rounds=rounds,
+                              kill=(0, 0, 1), seed=0, codec="qsgd8",
+                              hop_codec="qsgd8", timeout_s=180.0)
+    import numpy as np
+
+    kill_bitwise_clean = all(
+        np.array_equal(a, b) for a, b in zip(tree["global_leaves"],
+                                             kill["global_leaves"]))
+    for leg in (flat, tree, kill):
+        leg.pop("global_leaves", None)  # arrays are not bench-JSON material
+    return {
+        "clients": n,
+        "fanout": fanout,
+        "rounds": rounds,
+        "root_ingress_bytes_flat": flat["root_ingress_bytes"],
+        "root_ingress_bytes_tree": tree["root_ingress_bytes"],
+        "root_bytes_ratio": round(
+            flat["root_ingress_bytes"]
+            / max(tree["root_ingress_bytes"], 1), 3),
+        "root_fan_in_flat": n,
+        "root_fan_in_tree": tree["edges"],
+        "partials_per_round": tree["partials_sent"] // max(rounds, 1),
+        "peak_buffered_root": max(tree["peak_buffered_root"],
+                                  kill["peak_buffered_root"]),
+        "peak_buffered_edge": max(tree["peak_buffered_edge"],
+                                  kill["peak_buffered_edge"]),
+        "edge_kills": kill["edge_kills"],
+        "edge_dedups": kill["edge_dedups"],
+        "unaccounted": max(tree["unaccounted"], kill["unaccounted"]),
+        "kill_bitwise_clean": bool(kill_bitwise_clean),
+        "flat": flat,
+        "tree": tree,
+        "kill": kill,
+    }
+
+
 def bench_llm(peak):
     import jax
     import jax.numpy as jnp
@@ -1063,6 +1127,8 @@ def _run_one(mode):
         result = bench_multi_tenant()
     elif mode == "secagg":
         result = bench_secagg()
+    elif mode == "hierarchy":
+        result = bench_hierarchy()
     else:
         result = bench_fedavg(peak)
     result["device"] = str(getattr(dev, "device_kind", dev.platform))
@@ -1183,6 +1249,40 @@ SECAGG_THROUGHPUT_RATIO_FLOOR = 0.5
 #: (int8 grid + cohort carry bits): 4 over 3 bytes/element at a 10k
 #: cohort = 1.33x measured
 SECAGG_BYTES_RATIO_FLOOR = 1.25
+#: Hierarchical aggregation tree (ISSUE 17) — platform-independent byte
+#: accounting, no wall clocks.  Root ingress bytes flat/tree at fanout 8
+#: over the qsgd8 wire on both hops: 16 compressed uploads/round collapse
+#: to 2 re-encoded partials/round, ~8x counted, 4x floor-guarded (header
+#: and control-meta overhead is what eats the slack at tiny models).
+HIER_ROOT_BYTES_RATIO_FLOOR = 4.0
+
+
+def _hierarchy_violations(res) -> list:
+    """Floor checks for the hierarchy section (shared by the full bench and
+    `--mode hierarchy`)."""
+    v = []
+    ratio = res.get("root_bytes_ratio")
+    if ratio is not None and ratio < HIER_ROOT_BYTES_RATIO_FLOOR:
+        v.append(f"hierarchy root ingress bytes flat/tree {ratio} < floor "
+                 f"{HIER_ROOT_BYTES_RATIO_FLOOR} (edge folding not paying "
+                 "for itself at fanout "
+                 f"{res.get('fanout')})")
+    if res.get("peak_buffered_root", 0) > 2 or res.get("peak_buffered_edge", 0) > 2:
+        v.append(f"hierarchy peak buffered root="
+                 f"{res.get('peak_buffered_root')} edge="
+                 f"{res.get('peak_buffered_edge')} > 2 (streaming fold not "
+                 "engaged on some hop)")
+    if res.get("unaccounted", 0) != 0:
+        v.append(f"hierarchy left {res['unaccounted']} uploads unaccounted "
+                 "(folds + relays + dedups must cover every child upload)")
+    if res.get("edge_kills", 0) != 1 or res.get("edge_dedups", 0) < 1:
+        v.append(f"hierarchy kill leg: {res.get('edge_kills')} kills / "
+                 f"{res.get('edge_dedups')} dedups (expected 1 SIGKILL and "
+                 ">= 1 journaled dedup of a re-sent upload)")
+    if not res.get("kill_bitwise_clean", False):
+        v.append("hierarchy killed-edge final global != clean tree run "
+                 "bitwise (journal recovery changed the fold)")
+    return v
 
 
 def _secagg_violations(res) -> list:
@@ -1283,6 +1383,8 @@ def _mode_violations(mode, result) -> list:
         return _secagg_violations(result)
     if mode == "slo":
         return _slo_violations(result)
+    if mode == "hierarchy":
+        return _hierarchy_violations(result)
     return []
 
 
@@ -1386,6 +1488,14 @@ def main():
     if _secagg_violations(secagg):
         # same one-retry policy as the other wall-clock floors
         secagg = _subprocess_bench("secagg")
+    # ISSUE-17 hierarchy: flat vs fanout-8 edge tree on the qsgd8 wire —
+    # root ingress bytes ratio floor, peak buffered <= 2 on every hop,
+    # edge-SIGKILL recovery with the accounting identity closed and the
+    # final global bitwise the clean tree run's
+    hierarchy = _subprocess_bench("hierarchy")
+    if _hierarchy_violations(hierarchy):
+        # same one-retry policy as the other floors
+        hierarchy = _subprocess_bench("hierarchy")
     # ISSUE-16 SLO watchdog: the async soak with declarative SLOs live on
     # the server's timer wheel — evaluations > 0, zero breaches on a clean
     # leg (generous thresholds: any breach is a regression, not noise)
@@ -1517,6 +1627,7 @@ def main():
     violations += _federated_lora_violations(federated_lora)
     violations += _multi_tenant_violations(multi_tenant)
     violations += _secagg_violations(secagg)
+    violations += _hierarchy_violations(hierarchy)
     violations += _slo_violations(slo_bench)
     pop_rss = population.get("rss_multiple")
     if pop_rss is not None and pop_rss > POPULATION_RSS_MULTIPLE_FLOOR:
@@ -1560,6 +1671,7 @@ def main():
             "federated_lora": federated_lora,
             "multi_tenant": multi_tenant,
             "secagg": secagg,
+            "hierarchy": hierarchy,
             "slo": slo_bench,
             "aot": aot,
             "lint": lint_section,

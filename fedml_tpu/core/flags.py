@@ -248,6 +248,25 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "longer in the live set) kept under client_journal_dir; older "
              "retired dirs are reclaimed at run finish — live ranks are "
              "never pruned."),
+    # -- hierarchical aggregation tree (cross_silo/edge.py) -------------------
+    FlagSpec("hier_fanout", "int", 0,
+             "Children per aggregator in the hierarchical aggregation tree: "
+             "set > 0 to route client uploads through ceil(N/fanout) edge "
+             "aggregators that fold their children's arrivals and ship ONE "
+             "pre-folded weighted partial to the root (0 = flat protocol, "
+             "byte-identical to before the flag existed)."),
+    FlagSpec("hier_depth", "int", 2,
+             "Aggregation tree depth when hier_fanout is set: 2 = client -> "
+             "edge -> root; 3 adds a region tier between edges and root."),
+    FlagSpec("hier_topology", "dict", None,
+             "Explicit aggregation tree: {'edges': [[client_rank, ...], ...]"
+             ", 'regions': [[edge_ordinal, ...], ...]} — overrides the "
+             "hier_fanout round-robin construction (regions optional; every "
+             "client rank must appear in exactly one edge)."),
+    FlagSpec("hier_hop_codec", "str", None,
+             "Per-hop re-encode of the edge->parent partial: qsgd8 | topk "
+             "(unset = the raw f32 partial, which keeps the tree fold "
+             "bitwise equal to the flat streaming fold)."),
     FlagSpec("straggler_timeout_s", "float", 0.0,
              "Bounded-wait straggler deadline per round; 0 = wait forever."),
     FlagSpec("straggler_quorum_frac", "float", 0.5,

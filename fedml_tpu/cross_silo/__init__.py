@@ -180,6 +180,18 @@ def run_in_process_group(cfg, dataset, model, backend: str = "INPROC", timeout: 
     ]
     for c in clients:
         c.run_in_thread()
+    # hierarchical aggregation tree (cross_silo/edge.py): one server-shaped
+    # relay manager per aggregator rank, on the same fabric.  Flat topology
+    # (hier flags unset) -> no edge managers, the historical group exactly.
+    from .edge import EdgeAggregatorManager, build_topology
+
+    topo = build_topology(cfg)
+    edges = [] if topo is None else [
+        EdgeAggregatorManager(cfg, topo, rank=r, backend=backend)
+        for r in topo.aggregator_ranks
+    ]
+    for e in edges:
+        e.run_in_thread()
     server = build_server(cfg, dataset, model, backend=backend)
     try:
         history = server.run_until_done(timeout=timeout)
@@ -189,7 +201,11 @@ def run_in_process_group(cfg, dataset, model, backend: str = "INPROC", timeout: 
         # to process FINISH, so interpreter exit never lands mid-XLA-call
         for c in clients:
             c.done.wait(5.0)
+        for e in edges:
+            e.done.wait(5.0)
     finally:
         for c in clients:
             c.finish()
+        for e in edges:
+            e.finish()
     return history

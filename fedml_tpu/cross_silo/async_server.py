@@ -121,6 +121,13 @@ class AsyncFedMLServerManager(FedMLServerManager):
                  logger: Optional[MetricsLogger] = None, runtime=None):
         super().__init__(cfg, aggregator, backend=backend, logger=logger,
                          runtime=runtime)
+        if self.topology is not None:
+            # the async protocol dispatches per client on each fold (no
+            # round barrier for an edge to fold against) — hierarchical
+            # async needs per-edge virtual rounds, a later scale item
+            raise NotImplementedError(
+                "hierarchical aggregation (hier_fanout/hier_topology) is "
+                "synchronous-only for now; unset it or async_aggregation")
         # re-bound (construction-time, before any receive/timer thread
         # exists) so this class's own body declares the guarded state for
         # the GL004 lock-discipline scan

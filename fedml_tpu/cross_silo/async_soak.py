@@ -732,6 +732,304 @@ def run_kill_recover_soak(n_clients: int = 256, concurrency: int = 64,
 
 
 # ---------------------------------------------------------------------------
+# hierarchical edge-node survivability (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def run_edge_kill_soak(n_clients: int = 4, fanout: int = 2, rounds: int = 2,
+                       kill: Optional[tuple] = (0, 0, 1), seed: int = 0,
+                       hop_codec: Optional[str] = None,
+                       codec: Optional[str] = None,
+                       topology: Optional[dict] = None,
+                       timeout_s: float = 120.0) -> dict:
+    """Edge-node SIGKILL soak over the SYNCHRONOUS hierarchical tree
+    (ISSUE 17): real root + real :class:`~fedml_tpu.cross_silo.edge.
+    EdgeAggregatorManager` nodes on the in-proc fabric, clients simulated by
+    THIS harness so every arrival is sequenced deterministically — uploads
+    go in sorted order, one edge's subtree at a time, and the harness waits
+    for each fold before the next send.  Determinism is what upgrades the
+    ISSUE's acceptance from "close" to BITWISE: the clean leg and the kill
+    leg run the identical fold op sequence, so the final globals must match
+    bit for bit (raw hop; ``hop_codec`` trades that pin for the bytes win).
+
+    ``kill = (round, edge_ordinal, after_children)`` hard-kills that edge
+    mid-round once ``after_children`` of its children have folded (each fold
+    is journaled under ``<journal>/edge_<rank>`` before the kill lands — the
+    per-fold cadence, same discipline as the root's mid-round snapshots),
+    rebuilds the manager against the same journal and the SAME router queue
+    (uploads sent while dead stay queued), re-sends the pre-kill uploads
+    under their original idempotence keys, and drives the run out.  The
+    accounting identity must close: every upload the harness ever sent is a
+    fold, a dedup, or a relay at exactly one edge across both manager
+    lifetimes — ``unaccounted == 0``, nothing vanishes with the crash.
+    ``kill=None`` is the clean leg.
+
+    ``fanout=0`` (and no ``topology``) runs the FLAT protocol under the
+    same deterministic sequencing — the reference leg for the root-ingress
+    bytes comparison and for the protocol-level bitwise pin (a prefix-edge
+    ``topology`` like ``{"edges": [[1, 2], [3], [4]]}`` folds the identical
+    op sequence the flat leg does, so their finals must match bit for
+    bit).  ``topology`` is an explicit ``extra.hier_topology`` dict."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import fedml_tpu
+
+    from ..comm.inproc import InProcRouter
+    from ..comm.message import Message
+    from ..data import loader
+    from ..models import model_hub
+    from . import build_server, message_define as md
+    from .edge import EdgeAggregatorManager, build_topology
+
+    workdir = tempfile.mkdtemp(prefix="soak_edgekill_")
+    shape = "flat" if (fanout <= 0 and not topology) else (
+        "topo" if topology else f"f{fanout}")
+    run_id = (f"soak_edgekill_{seed}_{n_clients}_{rounds}_{shape}_"
+              f"{'clean' if kill is None else 'kill'}")
+    from fedml_tpu.arguments import Config
+
+    hier_extra = ({"hier_topology": topology} if topology
+                  else {"hier_fanout": fanout} if fanout > 0 else {})
+    cfg = Config(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=n_clients, client_num_per_round=n_clients,
+        comm_round=rounds, epochs=1, batch_size=16, learning_rate=0.1,
+        partition_method="homo", synthetic_train_size=64 * n_clients,
+        synthetic_test_size=64, frequency_of_the_test=0,
+        compute_dtype="float32", metrics_jsonl_path="", run_id=run_id,
+        random_seed=seed,
+        extra={"streaming_aggregation": True,
+               "server_journal_dir": f"{workdir}/journal", **hier_extra,
+               **({"hier_hop_codec": hop_codec} if hop_codec else {})},
+    )
+    fedml_tpu.init(cfg)
+    ds = loader.load(cfg)
+    model = model_hub.create(cfg, ds.class_num)
+    topo = build_topology(cfg)
+
+    try:
+        InProcRouter.reset(run_id)
+        router = InProcRouter.get(run_id)
+        agg_ranks = [] if topo is None else topo.aggregator_ranks
+        edges = {r: EdgeAggregatorManager(cfg, topo, rank=r, backend="INPROC")
+                 for r in agg_ranks}
+        for e in edges.values():
+            e.run_in_thread()
+        server = build_server(cfg, ds, model, backend="INPROC")
+        template = jax.device_get(server.aggregator.global_vars)
+
+        # client ranks fan into one harness queue; root + edge inboxes stay
+        # real (their queue objects are copied into the FanIn dict)
+        shared: queue.Queue = queue.Queue()
+        fan = _FanInQueues(shared, router.queues[0])
+        for r in agg_ranks:
+            fan[r] = router.queues[r]
+        router.queues = fan
+
+        # worker: answer status probes, record model dispatches per client
+        dispatches: dict[tuple, object] = {}
+        cond = threading.Condition()
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    item = shared.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    return
+                rid, data = item
+                try:
+                    msg = Message.decode(data)  # control only, tensors lazy
+                except Exception:
+                    continue
+                mtype = msg.get_type()
+                if mtype == md.MSG_TYPE_S2C_CHECK_CLIENT_STATUS:
+                    reply = Message(md.MSG_TYPE_C2S_CLIENT_STATUS, rid, 0)
+                    reply.add_params(md.MSG_ARG_KEY_CLIENT_STATUS,
+                                     md.CLIENT_STATUS_ONLINE)
+                    reply.add_params(md.MSG_ARG_KEY_CLIENT_OS,
+                                     md.CLIENT_OS_PYTHON)
+                    router.route(reply)
+                elif mtype in (md.MSG_TYPE_S2C_INIT_CONFIG,
+                               md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT):
+                    r = int(msg.get_control(md.MSG_ARG_KEY_ROUND_INDEX, -1))
+                    epoch = msg.get_control(md.MSG_ARG_KEY_SESSION_EPOCH)
+                    with cond:
+                        dispatches[(rid, r)] = epoch
+                        cond.notify_all()
+                # FINISH needs no ack
+
+        wt = threading.Thread(target=worker, name="edge-soak-clients",
+                              daemon=True)
+        wt.start()
+        deadline = time.monotonic() + timeout_s
+
+        def wait_for(pred, what: str):
+            while not pred():
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"edge kill soak stalled waiting for "
+                                       f"{what} (run_id={run_id})")
+                time.sleep(0.002)
+
+        def upload_for(rid: int, round_idx: int, epoch) -> Message:
+            f = 1.0 + 1e-3 * ((rid * 31 + round_idx * 7) % 97) / 97.0
+            params = jax.tree_util.tree_map(
+                lambda a: ((a * f).astype(a.dtype)
+                           if np.asarray(a).dtype.kind == "f" else a),
+                template)
+            if codec is not None:
+                # ``codec`` puts the CLIENT hop on the compressed wire too,
+                # so flat-vs-tree root-ingress comparisons are codec-fair
+                # (deterministic per-(client, round) quantization key)
+                from ..comm import codecs as codecs_mod
+
+                params, _res, _stats = codecs_mod.compress_pytree(
+                    params, codec,
+                    key=jax.random.fold_in(
+                        jax.random.PRNGKey(seed), rid * 1009 + round_idx),
+                    min_elems=codecs_mod.LOW_RANK_MIN_COMPRESS_ELEMS)
+            up = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, rid,
+                         0 if topo is None else topo.parent(rid))
+            up.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+            up.add_params(md.MSG_ARG_KEY_NUM_SAMPLES,
+                          float(16 + (rid % 7) * 8))
+            up.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+            if epoch is not None:
+                up.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, int(epoch))
+            # a stable per-(client, round) idempotence key, so the post-kill
+            # re-send of already-folded work MUST reconcile as a dedup
+            up.add_params(md.MSG_ARG_KEY_UPLOAD_KEY, f"{rid}:{round_idx}:h:0")
+            return up
+
+        uploads_sent = 0
+        edge_kills = 0
+        t0 = time.monotonic()
+        server.run_in_thread()
+        server.start()
+        if topo is None:
+            if kill is not None:
+                raise ValueError("kill injection needs a tree (fanout >= 1)")
+            groups = [(None, list(range(1, n_clients + 1)))]
+        else:
+            groups = [(r, sorted(topo.children_of[r])) for r in topo.edge_ranks]
+        for round_idx in range(rounds):
+            for ordinal, (erank, children) in enumerate(groups):
+                kill_here = (erank is not None and kill is not None
+                             and kill[0] == round_idx and kill[1] == ordinal)
+                sent_this_edge: list[Message] = []
+                for k, rid in enumerate(children):
+                    if kill_here and k == kill[2]:
+                        # SIGKILL the edge mid-round: receive loop and
+                        # timers stop abruptly, nothing is shipped
+                        edges[erank].hard_kill()
+                        edge_kills += 1
+                        time.sleep(0.15)  # let the dead loop's poll expire
+                        replacement = EdgeAggregatorManager(
+                            cfg, topo, rank=erank, backend="INPROC")
+                        edges[erank] = replacement
+                        replacement.run_in_thread()
+                        replacement.recovery_resume()
+                        # re-send everything already folded, under the
+                        # original keys: journaled dedup must swallow all
+                        for prev in sent_this_edge:
+                            router.route(prev)
+                            uploads_sent += 1
+                        base_d = replacement.deduped_uploads
+                        wait_for(lambda: edges[erank].deduped_uploads
+                                 >= base_d + len(sent_this_edge),
+                                 f"dedup of re-sent uploads at edge {erank}")
+                    with cond:
+                        while (rid, round_idx) not in dispatches:
+                            if not cond.wait(timeout=0.1) and \
+                                    time.monotonic() > deadline:
+                                raise RuntimeError(
+                                    f"no dispatch for client {rid} round "
+                                    f"{round_idx} (run_id={run_id})")
+                        epoch = dispatches[(rid, round_idx)]
+                    up = upload_for(rid, round_idx, epoch)
+                    # baseline BEFORE routing: the fold can land between the
+                    # route and a post-route read, and the wait would hang
+                    base_f = (0 if erank is None
+                              else edges[erank].folds + edges[erank].relays)
+                    router.route(up)
+                    uploads_sent += 1
+                    sent_this_edge.append(up)
+                    if erank is None:
+                        # flat leg: pace on the root's own fold ledger (the
+                        # flags clear at the round boundary, hence the OR)
+                        wait_for(lambda: rid in server.aggregator
+                                 .flag_client_model_uploaded
+                                 or server.round_idx > round_idx
+                                 or server.done.is_set(),
+                                 f"root fold of client {rid}")
+                    else:
+                        wait_for(lambda: edges[erank].folds
+                                 + edges[erank].relays >= base_f + 1,
+                                 f"fold of client {rid} at edge {erank}")
+                # serialize the root's partial folds: edge ordinal order in
+                # BOTH legs, so the clean and kill runs are op-identical.
+                # The last edge of a round completes it and CLEARS the
+                # upload flags, so round_idx advancing also satisfies this.
+                wait_for(lambda: all(
+                    c in server.aggregator.flag_client_model_uploaded
+                    for c in children) or server.round_idx > round_idx
+                    or server.done.is_set(),
+                    f"root accounting of edge {erank} round {round_idx}")
+        completed = server.done.wait(
+            max(0.1, deadline - time.monotonic()))
+        wall = time.monotonic() - t0
+        stop.set()
+        shared.put(None)
+        wt.join(timeout=5.0)
+        peak_root = int(server.aggregator.peak_buffered_updates)
+        peak_edges = max(
+            (e._fold.peak_buffered for e in edges.values()
+             if e._fold is not None), default=0)
+        folds = sum(e.folds for e in edges.values())
+        relays = sum(e.relays for e in edges.values())
+        dedups = sum(e.deduped_uploads for e in edges.values())
+        global_leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+            jax.device_get(server.aggregator.global_vars))]
+        server.finish()
+        for e in edges.values():
+            e.finish()
+        InProcRouter.reset(run_id)
+        if not completed:
+            raise RuntimeError(
+                f"edge kill soak did not finish {rounds} rounds in "
+                f"{timeout_s}s (folds={folds}, dedups={dedups})")
+        return {
+            "clients": n_clients,
+            "fanout": fanout,
+            "rounds": rounds,
+            "edges": 0 if topo is None else len(topo.edge_ranks),
+            "edge_kills": edge_kills,
+            "uploads_sent": uploads_sent,
+            "edge_folds": folds,
+            "edge_relays": relays,
+            "edge_dedups": dedups,
+            # zero-unaccounted-loss: every upload ever sent is a fold, a
+            # dedup, or a relay at exactly one edge, across both lifetimes
+            # (flat leg: uploads bypass the edge tier, identity is vacuous)
+            "unaccounted": (0 if topo is None
+                            else uploads_sent - folds - relays - dedups),
+            "partials_sent": sum(e.partials_sent for e in edges.values()),
+            "root_ingress_bytes": int(server.upload_ingress_bytes),
+            "root_deduped": int(server.deduped_uploads),
+            "peak_buffered_root": peak_root,
+            "peak_buffered_edge": peak_edges,
+            "wall_s": round(wall, 4),
+            "global_leaves": global_leaves,
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # client-side survivability harnesses (ISSUE 13)
 # ---------------------------------------------------------------------------
 

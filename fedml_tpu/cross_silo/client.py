@@ -203,6 +203,14 @@ class ClientMasterManager(FedMLCommManager):
 
         self.comm_codec = codecs.codec_from_config(cfg)
         self._comm_residuals = None
+        # hierarchical aggregation tree (cross_silo/edge.py): model replies
+        # go to this client's edge aggregator instead of the root.  Status
+        # probes, FINISH, and telemetry stay root<->client direct — only the
+        # model-upload hop is re-routed.  Flat topology -> 0, byte-identical.
+        from .edge import build_topology
+
+        _topo = build_topology(cfg)
+        self._upload_dest = 0 if _topo is None else _topo.parent(rank)
         self._comm_ratio = float(cfg_extra(
             cfg, "comm_topk_ratio", getattr(cfg, "compression_ratio", 0.01) or 0.01))
         # compression floor resolution: an EXPLICIT comm_compress_min_size
@@ -319,7 +327,8 @@ class ClientMasterManager(FedMLCommManager):
                              epoch=None if epoch is None else int(epoch))
         new_vars, n_samples = self.trainer.train(params, round_idx, self.seed_key, client_idx)
         self.rounds_trained += 1  # graftlint: disable=GL008(same single-writer invariant as _last_epoch above: receive-loop-only writes; hard_kill/finish read it solely as flight-bundle context)
-        reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
+                        self._upload_dest)
         payload, is_delta = self._maybe_compress(new_vars, params, round_idx)
         reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, payload)
         if is_delta:

@@ -1,0 +1,926 @@
+"""Hierarchical aggregation tree — edge aggregators over the real protocol.
+
+Every upload used to terminate at ONE server, so at fleet scale the root is
+a fan-in and WAN-byte bottleneck (the communication-practicality survey's
+inter-tier bandwidth asymmetry; the reference's hierarchical cross-silo mode
+says the fix is a tree).  This module adds the tree WITHOUT a new protocol:
+
+    client -> edge aggregator -> (optional region) -> root
+
+- :func:`build_topology` turns ``extra.hier_fanout`` / ``extra.hier_depth``
+  (or an explicit ``extra.hier_topology`` dict) into a :class:`HierTopology`
+  — a pure rank map shared by the root (dispatch routing), every client
+  (upload destination), and the edge processes themselves.  Flags unset ->
+  None, and every participant runs the flat protocol byte-identically.
+- :class:`EdgeAggregatorManager` is a server-shaped relay: it registers the
+  EXISTING message types, re-dispatches the global down its subtree, folds
+  its children's model replies streaming (peak buffered <= 2: the running
+  sum + the one in-flight decode), and ships ONE pre-folded weighted partial
+  upward as a control-tagged upload (``MSG_ARG_KEY_HIER_PARTIAL``) carrying
+  the folded sample mass.  Root fan-in drops from O(clients) connections and
+  bytes to O(edges).
+- :class:`EdgePartialFold` is the fold core, factored out of the manager so
+  the bitwise pins (tree fold == flat streaming fold) and the sim parity
+  bridge (``sim/hierarchical.py`` segment-sum group fold == protocol edge
+  fold) can drive it without a transport.
+
+Bitwise discipline (the reason ``supports_associative_fold`` gates this):
+the edge computes ``sum_c f32(w_c) * f32(x_c)`` with EXACTLY the op sequence
+the flat server fold runs (``parallel/stream_fold.py``), and the parent
+folds an arriving partial with a direct add (``fold_partial_leaf``) — no
+unit-weight multiply, no normalization at the edge — so the tree introduces
+no arithmetic the flat fold didn't do.  A parent merging partial ``p`` after
+prefix ``s`` computes ``s + p`` where the flat fold computed
+``(..(s + a1) + a2..)`` over p's terms: identical bits whenever the adds are
+exact or the subtree has a single term, and algebraically equal always.
+The protocol pin test fixes arrival order and a topology whose op sequences
+coincide; the full-tree pin uses exactly-representable payloads.
+
+Per-hop composition with existing machinery: ``extra.hier_hop_codec``
+re-encodes the shipped partial with qsgd8/topk (comm/codecs.py — the
+edge->root hop then costs compressed bytes, measured by
+``fedml_hier_hop_bytes_total``), chunked transport frames apply to both hops
+(``extra.comm_chunk_bytes`` is honored by the same comm managers), uploads
+carry exactly-once keys when journaled, and a per-node
+:class:`~fedml_tpu.obs.health.ClientHealthLedger` attributes RTT/breaches to
+THIS hop's children.  A SIGKILLed edge recovers from its own
+:class:`~fedml_tpu.cross_silo.journal.ServerJournal` (partial sums + wire
+template in the sidecar — no model needed to restore) and drains the child
+uploads that queued while it was dead; the chaos soak closes the
+zero-unaccounted-loss identity over it.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..comm import codecs, wire
+from ..comm.comm_manager import FedMLCommManager
+from ..comm.message import Message
+from ..core.flags import cfg_extra
+from ..obs import registry as obsreg
+from . import message_define as md
+
+log = logging.getLogger("fedml_tpu.cross_silo.edge")
+
+__all__ = [
+    "EdgeAggregatorManager",
+    "EdgePartialFold",
+    "HierTopology",
+    "build_topology",
+    "edge_fold_supported",
+    "round_robin_groups",
+]
+
+# -- fedml_hier_* metric families (docs/METRICS.md "hier" section) -----------
+HOP_BYTES = obsreg.REGISTRY.counter(
+    "fedml_hier_hop_bytes_total",
+    "Model-upload wire bytes by aggregation-tree hop: client_edge (child "
+    "replies into an edge), edge_region (edge partials into a region tier), "
+    "edge_root (partials into the root).  The tentpole quantity: edge_root "
+    "per round is O(edges) where the flat protocol's root ingress was "
+    "O(clients).",
+    labels=("hop",),
+)
+PARTIALS_FOLDED = obsreg.REGISTRY.counter(
+    "fedml_hier_partials_folded_total",
+    "Pre-folded weighted partials merged by a parent (direct add — bitwise "
+    "a continuation of the child's fold), by tier of the folding node.",
+    labels=("tier",),
+)
+PARTIALS_SENT = obsreg.REGISTRY.counter(
+    "fedml_hier_partials_sent_total",
+    "Partials shipped upward by aggregator nodes (one per node per round "
+    "when every child arrived; straggler timeouts ship what landed).",
+)
+EDGE_FOLDS = obsreg.REGISTRY.counter(
+    "fedml_hier_edge_folds_total",
+    "Child model replies folded streaming at aggregator nodes.",
+)
+EDGE_RELAYS = obsreg.REGISTRY.counter(
+    "fedml_hier_edge_relays_total",
+    "Child uploads store-and-forwarded unchanged to the parent (fold "
+    "unsupported for the config, or a frame the template doesn't match).",
+)
+EDGE_DEDUPED = obsreg.REGISTRY.counter(
+    "fedml_hier_edge_deduped_total",
+    "Duplicate child uploads dropped at aggregator nodes (exactly-once "
+    "keys, or a keyless redelivery within the round).",
+)
+TREE_DEPTH = obsreg.REGISTRY.gauge(
+    "fedml_hier_tree_depth",
+    "Aggregation tree depth (2 = client->edge->root, 3 adds regions; 1 "
+    "when the hier flags are unset and the protocol is flat).",
+)
+TREE_FANOUT = obsreg.REGISTRY.gauge(
+    "fedml_hier_tree_fanout",
+    "Largest child count of any aggregation-tree node (root included).",
+)
+TREE_EDGES = obsreg.REGISTRY.gauge(
+    "fedml_hier_tree_edges",
+    "Aggregator nodes in the tree (edges + regions).",
+)
+
+#: idempotence keys remembered per child at an aggregator node — same bound
+#: (and reasoning) as the root's table; defined locally because edge.py must
+#: not import server.py (server.py imports the hop metrics from here)
+DEDUP_KEYS_PER_CHILD = 16
+
+_VALID_HOP_CODECS = ("qsgd8", "topk")
+
+
+def round_robin_groups(n: int, groups: int) -> np.ndarray:
+    """``(n,) int32`` member -> group map, round-robin: ``arange(n) % G``.
+
+    THE shared group-map construction: ``sim/hierarchical.py`` uses it for
+    its ``group_assignment=round_robin`` mode and :func:`build_topology`
+    for the protocol tree, so the sim parity bridge compares the same
+    partition on both sides.
+    """
+    return (np.arange(int(n)) % max(1, int(groups))).astype(np.int32)
+
+
+class HierTopology:
+    """Declarative aggregation tree over the cross-silo rank space.
+
+    Ranks: root = 0, clients = 1..N (unchanged), edge aggregators =
+    N+1..N+E, regions (depth 3) = N+E+1..N+E+R — every node on the same
+    comm fabric, so no transport learns anything new.  ``edges`` is a list
+    of client-rank groups (one per edge, every client in exactly one);
+    ``regions`` optionally groups edge ORDINALS (0-based into ``edges``)
+    under region nodes.
+    """
+
+    def __init__(self, n_clients: int, edges: list, regions: Optional[list] = None):
+        n = int(n_clients)
+        groups = [[int(c) for c in grp] for grp in edges]
+        if any(not grp for grp in groups):
+            raise ValueError("hier topology: empty edge group")
+        flat = sorted(c for grp in groups for c in grp)
+        if flat != list(range(1, n + 1)):
+            raise ValueError(
+                f"hier topology: edge groups must partition client ranks "
+                f"1..{n} exactly once (got {flat})")
+        self.n_clients = n
+        E = len(groups)
+        self.edge_ranks = [n + 1 + i for i in range(E)]
+        self.children_of: dict[int, list[int]] = {
+            self.edge_ranks[i]: groups[i] for i in range(E)}
+        self.parent_of: dict[int, int] = {}
+        for i, grp in enumerate(groups):
+            for c in grp:
+                self.parent_of[c] = self.edge_ranks[i]
+        self.region_ranks: list[int] = []
+        if regions:
+            rgroups = [[int(o) for o in grp] for grp in regions]
+            if any(not grp for grp in rgroups):
+                raise ValueError("hier topology: empty region group")
+            ords = sorted(o for grp in rgroups for o in grp)
+            if ords != list(range(E)):
+                raise ValueError(
+                    f"hier topology: region groups must partition edge "
+                    f"ordinals 0..{E - 1} exactly once (got {ords})")
+            base = n + 1 + E
+            self.region_ranks = [base + j for j in range(len(rgroups))]
+            for j, grp in enumerate(rgroups):
+                members = [self.edge_ranks[o] for o in grp]
+                self.children_of[self.region_ranks[j]] = members
+                for m in members:
+                    self.parent_of[m] = self.region_ranks[j]
+            for r in self.region_ranks:
+                self.parent_of[r] = 0
+        else:
+            for e in self.edge_ranks:
+                self.parent_of[e] = 0
+        self.aggregator_ranks = self.edge_ranks + self.region_ranks
+        #: the root's direct children (regions when present, else edges) —
+        #: the O(edges) fan-in the flat protocol's O(clients) collapses to
+        self.root_children = list(self.region_ranks or self.edge_ranks)
+        self.depth = 3 if self.region_ranks else 2
+        # group map shared with the sim (0-based client index -> edge ordinal)
+        g = np.empty(n, np.int32)
+        for i, grp in enumerate(groups):
+            for c in grp:
+                g[c - 1] = i
+        self.group_of = g
+        self.world_size = 1 + n + len(self.aggregator_ranks)
+
+    def parent(self, rank: int) -> int:
+        """Upload destination for ``rank`` (0 for root children and unknown
+        ranks — the flat default)."""
+        return self.parent_of.get(int(rank), 0)
+
+    def max_fanout(self) -> int:
+        fans = [len(self.root_children)]
+        fans += [len(v) for v in self.children_of.values()]
+        return max(fans)
+
+    def export_gauges(self) -> None:
+        TREE_DEPTH.set(self.depth)
+        TREE_FANOUT.set(self.max_fanout())
+        TREE_EDGES.set(len(self.aggregator_ranks))
+
+    def dispatch_plan(self, selected, skip=()) -> dict:
+        """Route one round's dispatch down the tree: ``{root_child_rank:
+        HIER_CHILDREN spec}`` covering ``selected`` minus ``skip`` (clients
+        whose fold the root already holds — mid-round journal resume).
+        Edge-level spec: ``{"clients": {rank: client_index}}``; region-level:
+        ``{"aggs": {edge_rank: edge_spec}}``.  Keys are strings — the spec
+        rides the JSON control section.  Aggregators left with no wanted
+        client are omitted entirely (no empty dispatch, no empty partial).
+        """
+        drop = set(int(s) for s in skip)
+        per_edge: dict[int, dict] = {}
+        for c in selected:
+            c = int(c)
+            if c in drop:
+                continue
+            e = self.parent_of[c]
+            per_edge.setdefault(e, {"clients": {}})["clients"][str(c)] = c - 1
+        if not self.region_ranks:
+            return per_edge
+        plan: dict[int, dict] = {}
+        for e, spec in per_edge.items():
+            rg = self.parent_of[e]
+            plan.setdefault(rg, {"aggs": {}})["aggs"][str(e)] = spec
+        return plan
+
+
+def build_topology(cfg, n_clients: Optional[int] = None) -> Optional["HierTopology"]:
+    """``extra.hier_*`` flags -> :class:`HierTopology`, or None when unset
+    (every caller then runs the flat protocol, byte-identical to before the
+    flags existed).  All participants call this with the same config, so
+    root, clients, and edges agree on the tree without any wire exchange.
+
+    Default construction from ``hier_fanout``: ``ceil(N / fanout)`` edges
+    with round-robin membership (:func:`round_robin_groups` — the sim's
+    partition), one more round-robin tier of regions at ``hier_depth`` 3.
+    ``hier_topology`` overrides with explicit groups.
+    """
+    explicit = cfg_extra(cfg, "hier_topology")
+    fanout = int(cfg_extra(cfg, "hier_fanout") or 0)
+    if not explicit and fanout <= 0:
+        return None
+    if getattr(cfg, "enable_secagg", False) or getattr(cfg, "enable_fhe", False):
+        raise NotImplementedError(
+            "hierarchical aggregation does not compose with the secure-"
+            "aggregation/FHE protocols yet (per-cohort SecAgg partial folds "
+            "are a later scale item — see ROADMAP); unset hier_fanout/"
+            "hier_topology for these modes")
+    n = int(n_clients if n_clients is not None else cfg.client_num_in_total)
+    if explicit:
+        topo = HierTopology(n, explicit["edges"], explicit.get("regions"))
+    else:
+        depth = int(cfg_extra(cfg, "hier_depth") or 2)
+        if depth not in (2, 3):
+            raise ValueError(f"hier_depth must be 2 or 3, got {depth}")
+        n_edges = max(1, math.ceil(n / fanout))
+        g = round_robin_groups(n, n_edges)
+        edges = [[i + 1 for i in range(n) if g[i] == e] for e in range(n_edges)]
+        regions = None
+        if depth == 3:
+            n_regions = max(1, math.ceil(n_edges / fanout))
+            rg = round_robin_groups(n_edges, n_regions)
+            regions = [[e for e in range(n_edges) if rg[e] == r]
+                       for r in range(n_regions)]
+        topo = HierTopology(n, edges, regions)
+    topo.export_gauges()
+    return topo
+
+
+def hop_codec_from_config(cfg) -> Optional[str]:
+    """``extra.hier_hop_codec`` -> validated codec name or None (raw f32
+    partial — the hop that keeps the tree fold bitwise the flat fold)."""
+    name = str(cfg_extra(cfg, "hier_hop_codec") or "").strip().lower()
+    if name in ("", "no", "off", "none", "raw"):
+        return None
+    if name not in _VALID_HOP_CODECS:
+        raise ValueError(
+            f"unknown hier_hop_codec {name!r}; known: {_VALID_HOP_CODECS}")
+    return name
+
+
+def edge_fold_supported(cfg) -> bool:
+    """Whether aggregator nodes may FOLD child uploads for this config —
+    the config-level mirror of the root's ``FedMLAggregator.stream_mode``
+    gate (same three conditions, evaluated without a model).  The gates
+    must agree: an edge that folded while the root buffered densely would
+    hand the root a partial it can only treat as one client's model.
+    False -> every aggregator store-and-forwards child uploads unchanged
+    (the tree still thins root CONNECTIONS, not bytes)."""
+    if not (codecs.codec_from_config(cfg)
+            or cfg_extra(cfg, "streaming_aggregation")
+            or cfg_extra(cfg, "async_aggregation")):
+        return False
+    from ..trust.pipeline import build_trust_pipeline
+
+    trust = build_trust_pipeline(cfg)
+    if trust is not None and not (hasattr(trust, "supports_streaming")
+                                  and trust.supports_streaming()):
+        return False
+    from ..fl.algorithm import config_supports_associative_fold
+
+    return config_supports_associative_fold(cfg)
+
+
+class EdgePartialFold:
+    """One aggregator node's per-round fold state, transport-free.
+
+    Holds the wire template of the dispatched global (the same
+    ``flatten_with_skeleton({MODEL_PARAMS: global})`` form the root's
+    streaming fold checks against), folds child replies with the root's
+    exact op sequence (``sums[i] += f32(w) * f32(x)`` — see
+    ``parallel/stream_fold.py``'s bitwise-discipline note), merges child
+    PARTIALS with direct adds, and produces the single weighted partial to
+    ship upward.  Peak simultaneously buffered: the running sum + one
+    in-flight decode — <= 2 regardless of children, tracked in
+    ``peak_buffered``.
+    """
+
+    def __init__(self, host_global_tree=None, *,
+                 template: Optional[list] = None, skeleton=None,
+                 sums: Optional[list] = None):
+        if template is None:
+            skeleton, leaves = wire.flatten_with_skeleton(
+                {md.MSG_ARG_KEY_MODEL_PARAMS: host_global_tree})
+            template = [np.asarray(l) for l in leaves]
+        self.tmpl = template
+        self.skel = skeleton
+        self._acc = None
+        if sums is not None:
+            from ..parallel.stream_fold import make_stream_accumulator
+
+            self._acc = make_stream_accumulator(self.tmpl, sums=sums)
+        self.w = 0.0
+        self.w_delta = 0.0
+        self.sources: dict[int, float] = {}
+        self.folded = 0
+        self.peak_buffered = 0
+
+    # -- frame admission ------------------------------------------------------
+    def _admit(self, msg):
+        """(header, leaf_iter) when the message's still-unmaterialized tensor
+        frame matches the template, else None (the caller relays instead)."""
+        frame = msg.tensor_frame() if hasattr(msg, "tensor_frame") else None
+        if frame is None:
+            return None
+        header, leaf_iter = frame
+        specs = header["leaves"]
+        if header["treedef"] != self.skel or len(specs) != len(self.tmpl):
+            return None
+        for spec, t in zip(specs, self.tmpl):
+            if tuple(spec["shape"]) != t.shape:
+                return None
+        return header, leaf_iter
+
+    def _ensure_acc(self):
+        if self._acc is None:
+            from ..parallel.stream_fold import make_stream_accumulator
+
+            self._acc = make_stream_accumulator(self.tmpl)
+        # buffered right now: the running sum (if any folds landed) + this
+        # in-flight decode — the <= 2 per-hop acceptance bound
+        n = (1 if self.folded else 0) + 1
+        if n > self.peak_buffered:
+            self.peak_buffered = n
+
+    # -- folds ----------------------------------------------------------------
+    def fold_child(self, child_rank: int, msg, sample_num: float,
+                   is_delta: bool) -> bool:
+        """Fold one child model reply with weight ``sample_num`` — leaf by
+        leaf off the undecoded frame, dequantizing compressed leaves, the
+        root fold's exact arithmetic.  False -> structure mismatch, relay."""
+        admitted = self._admit(msg)
+        if admitted is None:
+            return False
+        _, leaf_iter = admitted
+        self._ensure_acc()
+        w = float(sample_num)
+        for i, _spec, arr in leaf_iter:
+            self._acc.fold_leaf(i, w, arr)
+        self.w += w
+        if is_delta:
+            self.w_delta += w
+        self.sources[int(child_rank)] = w
+        self.folded += 1
+        return True
+
+    def fold_partial(self, msg, sources: dict, w_delta: float) -> bool:
+        """Merge a child aggregator's pre-folded partial: DIRECT adds
+        (``fold_partial_leaf``), never a unit-weight multiply — the add is
+        the bitwise continuation of the child's own fold.  ``sources`` maps
+        client rank -> folded weight (string keys off the JSON control
+        section are fine); the masses join this node's totals."""
+        admitted = self._admit(msg)
+        if admitted is None:
+            return False
+        _, leaf_iter = admitted
+        self._ensure_acc()
+        for i, _spec, arr in leaf_iter:
+            self._acc.fold_partial_leaf(i, arr)
+        for k, v in sources.items():
+            self.sources[int(k)] = float(v)
+            self.w += float(v)
+        self.w_delta += float(w_delta)
+        self.folded += 1
+        return True
+
+    # -- ship -----------------------------------------------------------------
+    def partial_tree(self):
+        """The accumulated weighted partial sums, restored into the model's
+        tree shape (f32 leaves) — the MODEL_PARAMS payload of the upward
+        ship.  Raw bits of the running sums: the parent's direct add
+        continues this fold exactly."""
+        if self._acc is None:
+            sums = [np.zeros(np.shape(t), np.float32) for t in self.tmpl]
+        else:
+            sums = self._acc.host_sums()
+        return wire.restore_skeleton(self.skel, sums)[md.MSG_ARG_KEY_MODEL_PARAMS]
+
+    def control_tag(self) -> dict:
+        """The ``MSG_ARG_KEY_HIER_PARTIAL`` control payload (string keys:
+        it rides the JSON section)."""
+        return {"sources": {str(k): float(v)
+                            for k, v in sorted(self.sources.items())},
+                "w_delta": float(self.w_delta)}
+
+    # -- journal form ---------------------------------------------------------
+    def export_state(self) -> tuple[dict, dict]:
+        """(protocol-json, named-arrays) for the edge journal sidecar.  The
+        TEMPLATE leaves ride along with the partial sums, so a restarted
+        edge restores without any model object."""
+        proto = {
+            "skel": self.skel,
+            "w": float(self.w),
+            "w_delta": float(self.w_delta),
+            "folded": int(self.folded),
+            "peak": int(self.peak_buffered),
+            "sources": {str(k): float(v) for k, v in sorted(self.sources.items())},
+        }
+        arrays = {f"tmpl_{i}": t for i, t in enumerate(self.tmpl)}
+        if self._acc is not None:
+            for i, s in enumerate(self._acc.host_sums()):
+                arrays[f"sum_{i}"] = s
+        return proto, arrays
+
+    @classmethod
+    def from_state(cls, proto: dict, arrays: dict) -> "EdgePartialFold":
+        tmpl = []
+        i = 0
+        while f"tmpl_{i}" in arrays:
+            tmpl.append(np.asarray(arrays[f"tmpl_{i}"]))
+            i += 1
+        sums = None
+        if "sum_0" in arrays:
+            sums = [np.asarray(arrays[f"sum_{j}"], np.float32)
+                    for j in range(len(tmpl))]
+        fold = cls(template=tmpl, skeleton=proto["skel"], sums=sums)
+        fold.w = float(proto.get("w", 0.0))
+        fold.w_delta = float(proto.get("w_delta", 0.0))
+        fold.folded = int(proto.get("folded", 0))
+        fold.peak_buffered = int(proto.get("peak", 0))
+        fold.sources = {int(k): float(v)
+                        for k, v in (proto.get("sources") or {}).items()}
+        return fold
+
+
+class EdgeAggregatorManager(FedMLCommManager):
+    """A server-shaped relay at one aggregation-tree node (edge OR region —
+    the tier only changes whether children are clients or other aggregators).
+
+    Protocol: the parent's INIT/SYNC dispatch arrives with the global model
+    and this node's subtree plan (``MSG_ARG_KEY_HIER_CHILDREN``); the node
+    re-dispatches per child, folds the children's ``C2S_SEND_MODEL``
+    replies streaming (or store-and-forwards them when the config doesn't
+    support the fold), and ships one control-tagged partial upward once
+    every expected child is accounted — or when its straggler timer fires
+    (half the root's ``straggler_timeout_s``, so the partial beats the
+    root's own quorum decision).
+
+    Crash recovery mirrors the root's: with ``extra.server_journal_dir``
+    set, the node journals its partial fold after every accepted child under
+    ``<dir>/edge_<rank>`` and a restarted manager (same rank, same fabric)
+    resumes the round — re-folding nothing (journaled dedup keys), losing
+    nothing (the in-proc queue holds uploads that arrived while dead), and
+    re-shipping idempotently (the partial's upload key dedups at the
+    parent).
+    """
+
+    def __init__(self, cfg, topology: HierTopology, rank: int,
+                 backend: Optional[str] = None, runtime=None):
+        super().__init__(cfg, rank=rank, size=topology.world_size,
+                         backend=backend)
+        self.topology = topology
+        self.parent_rank = topology.parent(rank)
+        self.tier = "region" if rank in topology.region_ranks else "edge"
+        self.done = threading.Event()
+        self._lock = threading.Lock()
+        from .runtime import ServerRuntime
+
+        self._runtime = runtime if runtime is not None else ServerRuntime()
+        self._owns_runtime = runtime is None
+        # per-hop health attribution: THIS node's ledger covers only its
+        # direct children (no comm tap: in-process trees run many nodes per
+        # process and the process-wide sink would cross-pollinate hops)
+        from ..obs.health import ClientHealthLedger
+
+        self.health = ClientHealthLedger()
+        self.relay_only = not edge_fold_supported(cfg)
+        self.hop_codec = hop_codec_from_config(cfg)
+        self._hop_ratio = float(cfg_extra(cfg, "comm_topk_ratio") or 0.01)
+        self._hop_residuals = None
+        self.straggler_timeout = float(
+            cfg_extra(cfg, "straggler_timeout_s") or 0) * 0.5
+        # round state (all guarded by _lock)
+        self._fold: Optional[EdgePartialFold] = None
+        self._round_idx: Optional[int] = None
+        self._epoch = None
+        self._expect: dict[int, object] = {}
+        self._arrived: set[int] = set()
+        self._shipped = False
+        self._ship_attempted = False
+        self._sent_at: dict[int, float] = {}
+        self._folded_keys: dict[int, object] = {}
+        self._attempts: dict[str, int] = {}
+        # counters the soaks and dryrun assert over
+        self.folds = 0
+        self.relays = 0
+        self.deduped_uploads = 0
+        self.partials_sent = 0
+        self.upload_ingress_bytes = 0
+        #: True when construction restored a journal snapshot (soak_worker
+        #: boot files report it, same field as ClientMasterManager)
+        self.resumed_from_journal = False
+        # own journal under <server_journal_dir>/edge_<rank>
+        self.journal = None
+        root_dir = cfg_extra(cfg, "server_journal_dir")
+        if root_dir:
+            from .journal import ServerJournal
+
+            self.journal = ServerJournal(
+                os.path.join(str(root_dir), f"edge_{rank}"),
+                keep=int(cfg_extra(cfg, "server_journal_keep")))
+            self._journal_recover()
+
+    # -- protocol -------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            md.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_dispatch)
+        self.register_message_receive_handler(
+            md.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_message_dispatch)
+        self.register_message_receive_handler(
+            md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_message_child_upload)
+        self.register_message_receive_handler(
+            md.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+
+    def handle_message_dispatch(self, msg: Message) -> None:
+        """Parent dispatch: install the round, relay the global to this
+        node's subtree, arm the straggler timer."""
+        with self._lock:
+            round_idx = int(msg.get_control(md.MSG_ARG_KEY_ROUND_INDEX))
+            epoch = msg.get_control(md.MSG_ARG_KEY_SESSION_EPOCH)
+            plan = msg.get_control(md.MSG_ARG_KEY_HIER_CHILDREN) or {}
+            same_round = (round_idx == self._round_idx
+                          and epoch == self._epoch)
+            if same_round and self._shipped:
+                # duplicate dispatch of a round whose partial already went
+                # up: re-ship idempotently (the upload key dedups) instead
+                # of redoing the subtree
+                self._ship_locked(resend=True)
+                return
+            if same_round and self._fold is not None and self._fold.folded:
+                # journal-recovered mid-round re-dispatch: the partial sums
+                # carry the already-folded children — re-relay only to the
+                # rest, so no work is redone
+                pending = {c: s for c, s in self._expect.items()
+                           if c not in self._arrived}
+                self._relay_dispatch(msg, pending, round_idx, epoch)
+                self._arm_straggler()
+                return
+            params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
+            self._round_idx = round_idx
+            self._epoch = epoch
+            self._expect = self._parse_plan(plan)
+            self._arrived = set()
+            self._shipped = False
+            self._ship_attempted = False
+            self._fold = (None if self.relay_only
+                          else EdgePartialFold(params))
+            self._sent_at.clear()
+            self._relay_dispatch(msg, self._expect, round_idx, epoch,
+                                 params=params)
+            self._arm_straggler()
+
+    def _parse_plan(self, plan: dict) -> dict:
+        """HIER_CHILDREN control dict -> {child_rank: per-child spec}.
+        Edge tier: spec is the client index; region tier: spec is the
+        child edge's own ``{"clients": ...}`` dict, forwarded verbatim."""
+        if "aggs" in plan:
+            return {int(r): spec for r, spec in plan["aggs"].items()}
+        return {int(r): int(idx) for r, idx in (plan.get("clients") or {}).items()}
+
+    def _relay_dispatch(self, msg: Message, children: dict, round_idx: int,
+                        epoch, params=None) -> None:  # graftlint: disable=GL004(caller holds _lock: handle_message_dispatch only)
+        if not children:
+            return
+        if params is None:
+            params = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
+        for child, spec in sorted(children.items()):
+            relay = Message(msg.get_type(), self.rank, child)
+            relay.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+            relay.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+            if epoch is not None:
+                relay.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, int(epoch))
+            if self.tier == "region":
+                relay.add_params(md.MSG_ARG_KEY_HIER_CHILDREN, spec)
+            else:
+                relay.add_params(md.MSG_ARG_KEY_CLIENT_INDEX, int(spec))
+            try:
+                self._sent_at[child] = time.perf_counter()
+                self.send_message(relay)
+            except Exception:
+                # best-effort per child, like the root's broadcast: the
+                # straggler timer owns progress for unreachable children
+                self.health.record_comm_failure(child)
+                log.warning("edge %d: dispatch to child %d failed; "
+                            "continuing", self.rank, child, exc_info=True)
+
+    def handle_message_child_upload(self, msg: Message) -> None:
+        """One child's model reply (or, at a region, a child edge's
+        partial): dedup, fold-or-relay, account, ship when complete.
+        Mirrors the root's upload gate order so every transport behavior
+        (redelivery, stale round, missing key) lands the same way."""
+        with self._lock:
+            sender = int(msg.get_sender_id())
+            upload_key = msg.get_control(md.MSG_ARG_KEY_UPLOAD_KEY)
+            if upload_key is not None and self._is_duplicate_upload(sender, upload_key):
+                self.deduped_uploads += 1
+                EDGE_DEDUPED.inc()
+                return
+            if self._round_idx is None or int(
+                    msg.get_control(md.MSG_ARG_KEY_ROUND_INDEX, -1)) != self._round_idx:
+                return  # stale round (post-timeout arrival) — root-identical
+            epoch = msg.get_control(md.MSG_ARG_KEY_SESSION_EPOCH, self._epoch)
+            if self._epoch is not None and epoch != self._epoch:
+                return  # pre-restart dispatch's work; the root would reject it
+            if sender in self._arrived:
+                self.deduped_uploads += 1
+                EDGE_DEDUPED.inc()
+                return  # keyless redelivery within the round
+            sent_at = self._sent_at.pop(sender, None)
+            if sent_at is not None:
+                self.health.observe_rtt(sender, time.perf_counter() - sent_at)
+            nbytes = int(getattr(msg, "wire_nbytes", 0) or 0)
+            self.upload_ingress_bytes += nbytes
+            HOP_BYTES.inc(nbytes, hop=("edge_region" if self.tier == "region"
+                                       else "client_edge"))
+            child_tag = msg.get_control(md.MSG_ARG_KEY_HIER_PARTIAL)
+            folded = False
+            if self._fold is not None:
+                if child_tag is not None:
+                    folded = self._fold.fold_partial(
+                        msg, child_tag.get("sources") or {},
+                        float(child_tag.get("w_delta", 0.0)))
+                    if folded:
+                        PARTIALS_FOLDED.inc(tier=self.tier)
+                else:
+                    n_samples = float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES))
+                    is_delta = bool(msg.get_control(
+                        md.MSG_ARG_KEY_MODEL_IS_DELTA, False))
+                    folded = self._fold.fold_child(
+                        sender, msg, n_samples, is_delta)
+                if folded:
+                    self.folds += 1
+                    EDGE_FOLDS.inc()
+            if not folded:
+                self._relay_upload(msg, sender)
+            self._note_upload_key(sender, upload_key)
+            self._arrived.add(sender)
+            self._journal_snapshot_locked()
+            if set(self._expect) <= self._arrived:
+                self._ship_locked()
+
+    def _relay_upload(self, msg: Message, sender: int) -> None:  # graftlint: disable=GL004(caller holds _lock: handle_message_child_upload only)
+        """Store-and-forward one child upload unchanged to the parent,
+        preserving the child as wire sender so the root folds/buffers it
+        under the right identity.  The fallback that keeps the tree correct
+        for every config the fold doesn't cover."""
+        fwd = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, sender,
+                      self.parent_rank)
+        fwd.add_params(md.MSG_ARG_KEY_MODEL_PARAMS,
+                       msg.get(md.MSG_ARG_KEY_MODEL_PARAMS))
+        for key in (md.MSG_ARG_KEY_NUM_SAMPLES, md.MSG_ARG_KEY_ROUND_INDEX,
+                    md.MSG_ARG_KEY_SESSION_EPOCH, md.MSG_ARG_KEY_UPLOAD_KEY,
+                    md.MSG_ARG_KEY_MODEL_IS_DELTA, md.MSG_ARG_KEY_HIER_PARTIAL):
+            val = msg.get_control(key)
+            if val is not None:
+                fwd.add_params(key, val)
+        self.relays += 1
+        EDGE_RELAYS.inc()
+        try:
+            self.send_message(fwd)
+        except Exception:
+            log.warning("edge %d: relay of child %d upload failed",
+                        self.rank, sender, exc_info=True)
+
+    def _ship_locked(self, resend: bool = False) -> None:  # graftlint: disable=GL004(callers hold _lock: handle_message_child_upload, handle_message_dispatch, and the straggler timeout)
+        """Ship THE one pre-folded weighted partial upward (or nothing, in
+        relay mode — every upload already went up individually)."""
+        self._runtime.cancel(self, "straggler")
+        if self._fold is None:
+            self._shipped = True
+            return
+        if self._shipped and not resend:
+            return
+        if not self._fold.folded:
+            # nothing landed (every child relayed or timed out): no partial
+            self._shipped = True
+            return
+        tree = self._fold.partial_tree()
+        if self.hop_codec is not None:
+            # per-hop re-encode (comm/codecs.py): the upward hop costs
+            # compressed bytes; EF residuals carry per node across rounds.
+            # Convergence-approximate by construction — the raw hop is the
+            # bitwise one (hier_hop_codec doc).
+            # low-rank floor, not the model-scale default: the partial is
+            # this subtree's ENTIRE upward traffic for the round, so even
+            # sub-1024-element leaves are worth encoding (qsgd8 shrinks any
+            # f32 leaf above ~260 elements — comm/codecs.py floor note)
+            tree, self._hop_residuals, _stats = codecs.compress_pytree(
+                tree, self.hop_codec, residuals=self._hop_residuals,
+                ratio=self._hop_ratio,
+                min_elems=codecs.LOW_RANK_MIN_COMPRESS_ELEMS)
+        up = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
+                     self.parent_rank)
+        up.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, tree)
+        up.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, float(self._fold.w))
+        up.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self._round_idx)
+        if self._epoch is not None:
+            up.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, int(self._epoch))
+        up.add_params(md.MSG_ARG_KEY_HIER_PARTIAL, self._fold.control_tag())
+        if self.journal is not None:
+            # exactly-once upward: attempt is journaled (inside the same
+            # snapshot cadence) before the send, so a crash-resend of this
+            # partial dedups at the parent instead of double-folding
+            attempt = self._next_attempt_locked(resend=resend)
+            up.add_params(
+                md.MSG_ARG_KEY_UPLOAD_KEY,
+                f"edge{self.rank}:{self._round_idx}:"
+                f"{-1 if self._epoch is None else int(self._epoch)}:{attempt}")
+        self._shipped = True
+        self._ship_attempted = True
+        self._journal_snapshot_locked()
+        self.partials_sent += 1
+        PARTIALS_SENT.inc()
+        try:
+            self.send_message(up)
+        except Exception:
+            log.warning("edge %d: partial ship to %d failed", self.rank,
+                        self.parent_rank, exc_info=True)
+
+    def _next_attempt_locked(self, resend: bool) -> int:  # graftlint: disable=GL004(callers hold _lock: _ship_locked only)
+        k = f"{self._round_idx}:{-1 if self._epoch is None else int(self._epoch)}"
+        if resend:
+            # same bytes, same key: the duplicate dispatch path re-ships the
+            # journaled attempt so the parent's dedup recognizes it
+            return max(0, self._attempts.get(k, 1) - 1)
+        n = self._attempts.get(k, 0)
+        self._attempts[k] = n + 1
+        while len(self._attempts) > 64:
+            self._attempts.pop(next(iter(self._attempts)))
+        return n
+
+    # -- straggler ------------------------------------------------------------
+    def _arm_straggler(self) -> None:  # graftlint: disable=GL004(caller holds _lock: handle_message_dispatch only)
+        if self.straggler_timeout <= 0:
+            return
+        self._runtime.arm(self, "straggler", self.straggler_timeout,
+                          self._on_straggler_timeout)
+
+    def _on_straggler_timeout(self) -> None:
+        with self._lock:
+            if self._shipped or self._fold is None:
+                return
+            if self._fold.folded:
+                for child in sorted(set(self._expect) - self._arrived):
+                    self.health.record_deadline_breach(child)
+                log.warning(
+                    "edge %d round %s: straggler timeout, shipping partial "
+                    "with %d/%d children", self.rank, self._round_idx,
+                    len(self._arrived), len(self._expect))
+                self._ship_locked()
+            else:
+                self._arm_straggler()  # nothing folded yet: keep waiting
+
+    # -- dedup ----------------------------------------------------------------
+    def _is_duplicate_upload(self, sender: int, key: str) -> bool:  # graftlint: disable=GL004(caller holds _lock: receive-handler gate)
+        dq = self._folded_keys.get(sender)
+        return dq is not None and key in dq
+
+    def _note_upload_key(self, sender: int, key: Optional[str]) -> None:  # graftlint: disable=GL004(caller holds _lock: receive-handler accept path)
+        if key is None:
+            return
+        dq = self._folded_keys.get(sender)
+        if dq is None:
+            dq = self._folded_keys[sender] = deque(maxlen=DEDUP_KEYS_PER_CHILD)
+        dq.append(key)
+
+    # -- recovery journal ------------------------------------------------------
+    def _journal_snapshot_locked(self) -> None:  # graftlint: disable=GL004(callers hold _lock: upload accept path and _ship_locked)
+        """Commit the round's partial fold after every accepted child (and
+        at ship).  Model-less sidecar: the template leaves ride with the
+        partial sums, so restore needs no model object — an edge process is
+        stateless between rounds by design."""
+        if self.journal is None or self._round_idx is None:
+            return
+        proto = {
+            "kind": "edge",
+            "rank": int(self.rank),
+            "round_idx": int(self._round_idx),
+            "epoch": None if self._epoch is None else int(self._epoch),
+            "expect": {str(c): s for c, s in sorted(self._expect.items())},
+            "arrived": sorted(self._arrived),
+            "shipped": bool(self._shipped),
+            "folds": int(self.folds),
+            "relays": int(self.relays),
+            "deduped": int(self.deduped_uploads),
+            "attempts": dict(self._attempts),
+            "folded_keys": {str(c): list(dq)
+                            for c, dq in sorted(self._folded_keys.items())},
+        }
+        arrays = {}
+        if self._fold is not None:
+            fold_proto, arrays = self._fold.export_state()
+            proto["fold"] = fold_proto
+        self.journal.snapshot(self._round_idx, proto, arrays)
+
+    def _journal_recover(self) -> None:  # graftlint: disable=GL004(construction-time: runs from __init__ before the receive loop or any timer thread exists)
+        """Resume the interrupted round from the newest intact sidecar: the
+        partial sums, folded-child set, dedup keys, and ship state come
+        back; child uploads that landed while dead are still queued on the
+        fabric and drain through the normal handler (their keys dedup any
+        the partial already contains)."""
+        snap = self.journal.restore(model_template=None)
+        if snap is None:
+            return
+        self.resumed_from_journal = True
+        proto = snap["protocol"]
+        self._round_idx = int(proto["round_idx"])
+        self._epoch = proto.get("epoch")
+        self._expect = {int(c): s for c, s in (proto.get("expect") or {}).items()}
+        self._arrived = set(int(c) for c in proto.get("arrived") or [])
+        self._shipped = bool(proto.get("shipped"))
+        self.folds = int(proto.get("folds", 0))
+        self.relays = int(proto.get("relays", 0))
+        self.deduped_uploads = int(proto.get("deduped", 0))
+        self._attempts = {str(k): int(v)
+                          for k, v in (proto.get("attempts") or {}).items()}
+        for c, keys in (proto.get("folded_keys") or {}).items():
+            self._folded_keys[int(c)] = deque(
+                [str(k) for k in keys], maxlen=DEDUP_KEYS_PER_CHILD)
+        if proto.get("fold") is not None:
+            self._fold = EdgePartialFold.from_state(proto["fold"], snap["arrays"])
+        log.info("edge %d: recovered journal step %d (round %d, %d/%d "
+                 "children folded, shipped=%s)", self.rank, snap["step"],
+                 self._round_idx, len(self._arrived), len(self._expect),
+                 self._shipped)
+
+    def recovery_resume(self) -> None:
+        """Post-restart nudge (called after the receive loop is up): if the
+        recovered round was complete-but-unshipped — the crash hit between
+        the last fold and the ship — ship now; queued uploads need no nudge,
+        they drain through the handler."""
+        with self._lock:
+            if (self._round_idx is not None and not self._shipped
+                    and self._fold is not None
+                    and set(self._expect) <= self._arrived):
+                self._ship_locked()
+            elif self._round_idx is not None and not self._shipped:
+                self._arm_straggler()
+
+    # -- lifecycle -------------------------------------------------------------
+    def handle_message_finish(self, msg: Message) -> None:
+        self.done.set()
+        self.finish()
+
+    def hard_kill(self) -> None:  # graftlint: disable=GL008(crash simulation: deliberately lock-free — a SIGKILL takes no locks either; the restarted manager rebuilds every invariant from the journal under its own lock)
+        """SIGKILL simulation for the chaos soak: stop the receive loop and
+        timers abruptly — no ship, no journal write, no teardown.  Whatever
+        the per-fold journal cadence already committed survives; everything
+        since is lost, exactly like a real kill."""
+        self._runtime.cancel(self)
+        self.com_manager.stop_receive_message()
+
+    def finish(self) -> None:
+        self._runtime.cancel(self)
+        super().finish()
+        if self._owns_runtime:
+            self._runtime.close()

@@ -48,6 +48,7 @@ from ..comm.message import Message
 from ..core.flags import cfg_extra
 from ..trust.secagg.field import DEFAULT_PRIME, dequantize_from_field, quantize_to_field
 from ..trust.secagg.lightsecagg import LightSecAggProtocol
+from ..trust.secagg.stream import DENSE_RING_BITS, pack_ring, unpack_ring
 from . import message_define as md
 from .client import ClientMasterManager, FedMLTrainer
 from .server import FedMLAggregator, FedMLServerManager
@@ -65,6 +66,12 @@ MSG_ARG_KEY_ENCODED_MASK = "encoded_mask"
 MSG_ARG_KEY_AGG_ENCODED_MASK = "aggregate_encoded_mask"
 MSG_ARG_KEY_MASK_SOURCE = "client_id"
 MSG_ARG_KEY_ACTIVE_CLIENTS = "active_clients"
+#: control-plane descriptor of a ring-packed masked upload (ISSUE 17
+#: satellite): ``{"ring_bits", "length"}``.  M31 field elements fit 31 bits,
+#: so the wire carries little-endian u32 (4 B/elem) instead of the int64
+#: tensor codec's 8 B/elem — absent meta means a legacy raw int64 upload,
+#: which the server still accepts bit-identically.
+MSG_ARG_KEY_MASKED_RING = "masked_ring"
 
 
 def secagg_params(cfg):
@@ -234,9 +241,15 @@ class LSAServerManager(FedMLServerManager):
         with self._agg_lock:
             if msg.get(md.MSG_ARG_KEY_ROUND_INDEX) != self.round_idx or self._phase != "model":
                 return
+            vec = msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)
+            meta = msg.get_control(MSG_ARG_KEY_MASKED_RING)
+            if meta is not None:
+                # ring-packed wire (u32): exact inverse of the client's
+                # pack_ring; no meta -> legacy raw int64, accepted as before
+                vec = unpack_ring(np.asarray(vec), int(meta["ring_bits"]),
+                                  int(meta["length"]))
             self.aggregator.add_local_trained_result(
-                msg.get_sender_id(),
-                msg.get(md.MSG_ARG_KEY_MODEL_PARAMS),
+                msg.get_sender_id(), vec,
                 float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES)),
             )
             if self.aggregator.check_whether_all_receive(len(self.selected)):
@@ -389,7 +402,15 @@ class LSAClientManager(ClientMasterManager):
         padded[: flat.size] = field_vec
         masked = (padded + mask) % self.protocol.p
         reply = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, masked)
+        # halve the masked-upload wire: field elements < 2^31 ride as u32
+        # (trust/secagg/stream.pack_ring), declared in control meta so the
+        # server can tell packed from legacy int64; unpack is exact, so the
+        # protocol math downstream is BITWISE the unpacked wire's
+        reply.add_params(md.MSG_ARG_KEY_MODEL_PARAMS,
+                         pack_ring(masked, DENSE_RING_BITS))
+        reply.add_params(MSG_ARG_KEY_MASKED_RING,
+                         {"ring_bits": DENSE_RING_BITS,
+                          "length": int(masked.size)})
         reply.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
         reply.add_params(md.MSG_ARG_KEY_ROUND_INDEX, round_idx)
         self.send_message(reply)

@@ -40,6 +40,18 @@ MSG_ARG_KEY_SESSION_EPOCH = "session_epoch"
 # the servers fold each key at most once and count the rest as deduped.
 # Absent when client journaling is off: wire byte-identical to before.
 MSG_ARG_KEY_UPLOAD_KEY = "upload_key"
+# TPU-native extension: hierarchical aggregation tree (cross_silo/edge.py).
+# HIER_PARTIAL rides the control section of an edge aggregator's upload to
+# its parent and marks MODEL_PARAMS as a PRE-FOLDED weighted partial sum
+# (sum_c w_c * x_c over the edge's children) rather than one client's model:
+# {"sources": {client_rank: weight}, "w_delta": delta_mass}.  The parent
+# folds it with unit weight — IEEE-exact, so the tree fold stays bitwise the
+# flat fold.  HIER_CHILDREN rides the root's dispatch to an aggregator and
+# names the subtree to relay to: {"clients": {rank: client_index}} at an
+# edge, {"aggs": {edge_rank: <edge-level dict>}} at a region.  Both keys are
+# absent in the flat protocol — wire byte-identical to before they existed.
+MSG_ARG_KEY_HIER_PARTIAL = "hier_partial"
+MSG_ARG_KEY_HIER_CHILDREN = "hier_children"
 
 CLIENT_STATUS_ONLINE = "ONLINE"
 CLIENT_OS_PYTHON = "python"

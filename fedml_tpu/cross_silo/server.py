@@ -40,6 +40,7 @@ from ..fl.local_sgd import make_eval_fn
 from ..obs import registry as obsreg, trace as obstrace
 from ..obs.metrics import MetricsLogger
 from . import message_define as md
+from .edge import HOP_BYTES as HIER_HOP_BYTES, build_topology
 
 log = logging.getLogger("fedml_tpu.cross_silo.server")
 
@@ -296,6 +297,59 @@ class FedMLAggregator:
         self.flag_client_model_uploaded[client_idx] = True
         return True
 
+    def fold_partial(self, msg, sources: dict, w_delta: float) -> bool:
+        """Fold an edge aggregator's pre-folded weighted partial (the
+        hierarchical tree's control-tagged upload — ``cross_silo/edge.py``).
+        MODEL_PARAMS carries ``sum_c w_c * x_c`` over the edge's children,
+        so each leaf merges with a DIRECT add (``fold_partial_leaf``) — no
+        unit-weight multiply, keeping the tree fold bitwise a continuation
+        of the flat fold — and the per-source sample masses land in the
+        same ledgers the flat path maintains (``sample_num_dict`` /
+        ``flag_client_model_uploaded``), so quorum accounting, reweighting,
+        and ``check_whether_all_receive`` are unchanged.  Returns False
+        when stream mode is off or the frame doesn't match the model (a
+        partial has no dense fallback — the caller drops and counts it)."""
+        if not self.stream_mode:
+            return False
+        frame = msg.tensor_frame() if hasattr(msg, "tensor_frame") else None
+        if frame is None:
+            return False
+        header, leaf_iter = frame
+        tmpl, skel = self._stream_template()
+        specs = header["leaves"]
+        if header["treedef"] != skel or len(specs) != len(tmpl):
+            log.warning("edge partial frame structure mismatch; dropping")
+            return False
+        for spec, t in zip(specs, tmpl):
+            if tuple(spec["shape"]) != t.shape:
+                log.warning("edge partial leaf shape mismatch; dropping")
+                return False
+        fresh = {int(k): float(v) for k, v in sources.items()
+                 if int(k) not in self.flag_client_model_uploaded}
+        if not fresh:
+            return True  # every source already accounted (redelivery)
+        if len(fresh) != len(sources):
+            # partial overlap (an edge re-ship racing its own relayed
+            # children) cannot be split apart — the sums are already merged
+            log.warning("edge partial overlaps %d already-folded sources; "
+                        "dropping", len(sources) - len(fresh))
+            return False
+        if self._stream_acc is None:
+            from ..parallel.stream_fold import make_stream_accumulator
+
+            self._stream_acc = make_stream_accumulator(
+                tmpl, sharded=self._shard_fold)
+        self._note_buffered(inflight=1)
+        for i, _spec, arr in leaf_iter:
+            self._stream_acc.fold_partial_leaf(i, arr)
+        self._stream_w += sum(fresh.values())
+        self._stream_w_delta += float(w_delta)
+        self._stream_folded += 1
+        for cid, w in fresh.items():
+            self.sample_num_dict[cid] = w
+            self.flag_client_model_uploaded[cid] = True
+        return True
+
     def received_count(self) -> int:
         # flag_client_model_uploaded is the one ledger every upload path
         # maintains (dense buffer, streaming fold, and the secure-agg
@@ -538,6 +592,15 @@ class FedMLServerManager(FedMLCommManager):
         self.per_round = min(cfg.client_num_per_round, len(self.client_ids))
         self.active_clients: set[int] = set()
         self.selected: list[int] = []
+        # hierarchical aggregation tree (cross_silo/edge.py): non-None flips
+        # dispatch to per-aggregator subtree plans and accepts control-tagged
+        # pre-folded partials on the upload path; None (hier flags unset) is
+        # the flat protocol, byte-identical to before the tree existed
+        self.topology = build_topology(cfg)
+        #: wire bytes of model uploads arriving AT THIS NODE, cumulative —
+        #: the tentpole quantity (O(edges) with the tree vs O(clients) flat);
+        #: _round_payload_bytes is its per-round obs-trail sibling
+        self.upload_ingress_bytes = 0
         self.done = threading.Event()
         self.history: list[dict] = []
         self.logger = logger or MetricsLogger(cfg.metrics_jsonl_path or None)
@@ -827,13 +890,39 @@ class FedMLServerManager(FedMLCommManager):
                 CLIENT_ROUND_TRIP.observe(rtt, client=str(sender))
                 self.health.observe_rtt(sender, rtt)
                 self._round_rtts[sender] = rtt
+            nbytes = int(getattr(msg, "wire_nbytes", 0) or 0)
+            self._round_payload_bytes += nbytes
+            self.upload_ingress_bytes += nbytes
+            hier_tag = msg.get_control(md.MSG_ARG_KEY_HIER_PARTIAL)
+            if hier_tag is not None:
+                # hierarchical tree: ONE pre-folded weighted partial stands
+                # in for an edge's whole subtree.  Direct-add fold; the
+                # per-source masses land in the same ledgers, so the
+                # all-receive check below counts clients exactly as flat.
+                HIER_HOP_BYTES.inc(nbytes, hop="edge_root")
+                if not self.aggregator.fold_partial(
+                        msg, hier_tag.get("sources") or {},
+                        float(hier_tag.get("w_delta", 0.0))):
+                    # a partial has no dense fallback: unfoldable means a
+                    # protocol bug or a config split-brain — drop loudly
+                    log.warning("dropping unfoldable edge partial from %d "
+                                "(round %d)", sender, self.round_idx)
+                    return
+                self._note_upload_key(sender, upload_key)
+                if (self._journal_every_folds
+                        and self.aggregator._stream_folded
+                        and self.aggregator._stream_folded
+                        % self._journal_every_folds == 0):
+                    self._journal_midround_snapshot()
+                if self.aggregator.check_whether_all_receive(len(self.selected)):
+                    self._finish_round()
+                return
             n_samples = float(msg.get(md.MSG_ARG_KEY_NUM_SAMPLES))
             # control-only read: raw (non-delta) uploads carry no delta flag,
             # and a plain get() of the missing key would materialize the
             # tensor section — silently demoting the streaming fold to the
             # dense buffer-all path
             is_delta = bool(msg.get_control(md.MSG_ARG_KEY_MODEL_IS_DELTA, False))
-            self._round_payload_bytes += int(getattr(msg, "wire_nbytes", 0) or 0)
             # streaming path first: fold the still-undecoded frame into the
             # running weighted sum so aggregation overlaps the network tail;
             # falls back to the buffer-all (reference-bit-exact) path
@@ -976,6 +1065,9 @@ class FedMLServerManager(FedMLCommManager):
         self._round_rtts.clear()
         self._round_payload_bytes = 0
         params = jax.device_get(self.aggregator.global_vars)
+        if self.topology is not None:
+            self._broadcast_model_hier(msg_type, params)
+            return
         for cid in self.selected:
             if self.aggregator.has_received(cid):
                 # mid-round journal resume (ISSUE 13): this client's fold is
@@ -1002,6 +1094,36 @@ class FedMLServerManager(FedMLCommManager):
                 # quorum + straggler handling own progress for missing clients
                 self.health.record_comm_failure(cid)
                 log.warning("broadcast to client %d failed; continuing", cid, exc_info=True)
+        self._arm_straggler_timer()
+
+    def _broadcast_model_hier(self, msg_type: int, params) -> None:  # graftlint: disable=GL004(caller holds _agg_lock: _broadcast_model only)
+        """Tree dispatch: ONE message per direct-child aggregator, carrying
+        the global plus that subtree's routing plan (HIER_CHILDREN) — root
+        egress connections drop from O(clients) to O(root children), the
+        downlink mirror of the uplink fan-in win.  Clients whose fold the
+        journal already holds are excluded from the plan (the edge never
+        re-asks them); the straggler timer + quorum math are unchanged
+        because the partial's sources land in the same per-client ledgers."""
+        skip = [cid for cid in self.selected
+                if self.aggregator.has_received(cid)]
+        plan = self.topology.dispatch_plan(self.selected, skip=skip)
+        for agg_rank, spec in sorted(plan.items()):
+            msg = Message(msg_type, 0, agg_rank)
+            msg.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, params)
+            msg.add_params(md.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            msg.add_params(md.MSG_ARG_KEY_HIER_CHILDREN, spec)
+            if self.journal is not None:
+                msg.add_params(md.MSG_ARG_KEY_SESSION_EPOCH, self.session_epoch)
+            obstrace.inject(msg, self._round_span)
+            try:
+                # per-hop RTT attribution: the pop on the partial's arrival
+                # observes THIS hop (root<->aggregator), not a client's
+                self._sent_at[agg_rank] = time.perf_counter()
+                self.send_message(msg)
+            except Exception:
+                self.health.record_comm_failure(agg_rank)
+                log.warning("hier dispatch to aggregator %d failed; "
+                            "continuing", agg_rank, exc_info=True)
         self._arm_straggler_timer()
 
     # -- model publication (ISSUE 11) -----------------------------------------
@@ -1131,7 +1253,11 @@ class FedMLServerManager(FedMLCommManager):
         self.com_manager.stop_receive_message()
 
     def send_finish(self) -> None:
-        for cid in self.client_ids:
+        ranks = list(self.client_ids)
+        if self.topology is not None:
+            # aggregator nodes shut down on the same terminal broadcast
+            ranks += self.topology.aggregator_ranks
+        for cid in ranks:
             try:
                 self.send_message(Message(md.MSG_TYPE_S2C_FINISH, 0, cid))
             except Exception:

@@ -87,6 +87,29 @@ def main() -> int:
         server.finish()
         return 0 if ok else 3
 
+    if role == "edge":
+        # hierarchical aggregation tree (ISSUE 17): a relay/fold tier node.
+        # Same survivability contract as the other roles — the edge journal
+        # (<server_journal_dir>/edge_<rank>) is the whole recovery story, a
+        # respawned process resumes mid-round from it and recovery_resume()
+        # ships a complete-but-unshipped partial immediately.
+        from fedml_tpu.cross_silo.edge import EdgeAggregatorManager, build_topology
+
+        topo = build_topology(cfg)
+        if topo is None:
+            raise SystemExit("edge role requires hier_fanout/hier_topology")
+        edge = EdgeAggregatorManager(cfg, topo, rank=rank, backend="TCP")
+        prior_boots = glob.glob(os.path.join(workdir, f"boot_r{rank}_*.json"))
+        _atomic_write_json(
+            os.path.join(workdir, f"boot_r{rank}_{os.getpid()}.json"),
+            {"rank": rank, "pid": os.getpid(), "restart": bool(prior_boots),
+             "resumed": bool(edge.resumed_from_journal)})
+        edge.run_in_thread()
+        edge.recovery_resume()
+        ok = edge.done.wait(timeout_s)
+        edge.finish()
+        return 0 if ok else 3
+
     from fedml_tpu.cross_silo import build_client
 
     client = build_client(cfg, ds, model, rank=rank, backend="TCP")

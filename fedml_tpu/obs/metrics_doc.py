@@ -31,6 +31,7 @@ _REGISTERING_MODULES = (
     "fedml_tpu.core.aot",
     "fedml_tpu.cross_silo.async_server",
     "fedml_tpu.cross_silo.client_journal",
+    "fedml_tpu.cross_silo.edge",
     "fedml_tpu.cross_silo.journal",
     "fedml_tpu.cross_silo.runtime",
     "fedml_tpu.cross_silo.server",
@@ -58,6 +59,7 @@ _SECTIONS = {
     "comm": "Communication layer",
     "crosssilo": "Cross-silo rounds",
     "flight": "Flight recorder",
+    "hier": "Hierarchical aggregation tree",
     "journal": "Server recovery journal",
     "mt": "Multi-tenant control plane",
     "obs": "Observability trail shipping",

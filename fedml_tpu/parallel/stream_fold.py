@@ -79,6 +79,15 @@ class HostStreamAccumulator:
     def fold_leaf(self, i: int, w: float, arr) -> None:
         self._sums[i] += np.float32(w) * np.asarray(arr, dtype=np.float32)
 
+    def fold_partial_leaf(self, i: int, arr) -> None:
+        """Merge a PRE-FOLDED weighted partial (hierarchical aggregation,
+        ``cross_silo/edge.py``): a direct add, no weight multiply — the
+        partial already carries ``sum_c w_c * x_c``, and adding it verbatim
+        is the bitwise continuation of the child node's fold (a ``* f32(1.0)``
+        would be value-identical but is omitted on principle: the tree must
+        introduce no op the flat fold didn't run)."""
+        self._sums[i] += np.asarray(arr, dtype=np.float32)
+
     def host_sums(self) -> list:
         """The per-leaf f32 sums as host arrays (journal snapshot form)."""
         return [np.asarray(s) for s in self._sums]
@@ -149,6 +158,16 @@ class ShardedStreamAccumulator:
         x = jax.device_put(jnp.asarray(np.asarray(arr), jnp.float32),
                            self._shardings[i])
         self._sums[i] = self._add(self._sums[i], self._mul(x, jnp.float32(w)))
+
+    def fold_partial_leaf(self, i: int, arr) -> None:
+        """Direct add of a pre-folded weighted partial — see the host form;
+        the single-op ``add`` jit keeps it bitwise the numpy add."""
+        import jax
+        import jax.numpy as jnp
+
+        x = jax.device_put(jnp.asarray(np.asarray(arr), jnp.float32),
+                           self._shardings[i])
+        self._sums[i] = self._add(self._sums[i], x)
 
     def host_sums(self) -> list:
         import jax

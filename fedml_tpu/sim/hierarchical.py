@@ -37,6 +37,22 @@ from ..fl.local_sgd import make_eval_fn, make_local_train_fn
 from ..obs.metrics import MetricsLogger
 from ..parallel import mesh as meshlib
 
+# THE shared pieces between this simulator and the protocol tree
+# (cross_silo/edge.py): the round-robin group map and the weighted group
+# sums.  Sharing them at SOURCE level (not just by convention) is what lets
+# the parity-bridge test pin the two hierarchies to each other bitwise.
+from ..cross_silo.edge import round_robin_groups
+
+
+def segment_group_sums(leaf, w_sel, g_sel, num_groups: int):
+    """Per-group weighted sums ``sum_c w_c * x_c`` of one stacked leaf —
+    the sim-side twin of the protocol edge fold (an EdgePartialFold's
+    partial is exactly one group's row of this, computed arrival-by-arrival
+    instead of by segment reduction).  f32 multiply then segment add, the
+    same IEEE ops as ``stream_fold.fold_leaf``."""
+    wleaf = leaf.astype(jnp.float32) * w_sel.reshape((-1,) + (1,) * (leaf.ndim - 1))
+    return jax.ops.segment_sum(wleaf, g_sel, num_segments=num_groups)
+
 
 class HierarchicalSimulator:
     def __init__(self, cfg: Config, dataset, model, mesh=None):
@@ -63,7 +79,9 @@ class HierarchicalSimulator:
                 group_of[np.asarray(members, np.int64)] = g
             self.group_of = jnp.asarray(group_of)
         else:
-            self.group_of = jnp.asarray(np.arange(n) % self.group_num, jnp.int32)
+            # the same partition build_topology's fanout default produces,
+            # by construction (shared helper)
+            self.group_of = jnp.asarray(round_robin_groups(n, self.group_num))
         spe = max(1, math.ceil(stacked.capacity / cfg.batch_size))
         self.hp = hparams_from_config(cfg, steps_per_epoch=spe)
         self._local_train = make_local_train_fn(model, self.hp)
@@ -154,8 +172,7 @@ class HierarchicalSimulator:
                 wsum = jax.ops.segment_sum(w_sel, g_sel, num_segments=G)
 
                 def red(leaf, old):
-                    wleaf = leaf.astype(jnp.float32) * w_sel.reshape((-1,) + (1,) * (leaf.ndim - 1))
-                    sgm = jax.ops.segment_sum(wleaf, g_sel, num_segments=G)
+                    sgm = segment_group_sums(leaf, w_sel, g_sel, G)
                     mean = sgm / jnp.maximum(wsum, 1e-12).reshape((-1,) + (1,) * (sgm.ndim - 1))
                     keep = (wsum > 0).reshape((-1,) + (1,) * (sgm.ndim - 1))
                     return jnp.where(keep, mean, old.astype(jnp.float32)).astype(old.dtype)

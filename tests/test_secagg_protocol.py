@@ -152,6 +152,108 @@ def test_lsa_dropout_reconstruction(eight_devices):
     np.testing.assert_allclose(np.asarray(flat_f), np.asarray(flat_e), atol=2e-3)
 
 
+def test_ring_pack_roundtrip_and_wire_bytes():
+    """ISSUE 17 satellite: the masked upload rides the wire ring-packed
+    (u32, 4 B/elem) instead of raw int64 (8 B/elem).  Packing must be an
+    exact roundtrip — unpack restores the int64 bits, so the field math
+    downstream is unchanged."""
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.cross_silo import message_define as md
+    from fedml_tpu.cross_silo.lightsecagg import MSG_ARG_KEY_MASKED_RING
+    from fedml_tpu.trust.secagg.stream import (
+        DENSE_RING_BITS, pack_ring, unpack_ring)
+
+    rs = np.random.RandomState(11)
+    vec = rs.randint(0, 2**DENSE_RING_BITS - 1, size=1337, dtype=np.int64)
+    packed = pack_ring(vec, DENSE_RING_BITS)
+    assert packed.dtype == np.uint32 and packed.nbytes == 4 * vec.size
+    np.testing.assert_array_equal(
+        unpack_ring(packed, DENSE_RING_BITS, vec.size), vec)
+
+    def frame_bytes(payload, with_meta):
+        m = Message(md.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 1, 0)
+        m.add_params(md.MSG_ARG_KEY_MODEL_PARAMS, payload)
+        if with_meta:
+            m.add_params(MSG_ARG_KEY_MASKED_RING,
+                         {"ring_bits": DENSE_RING_BITS, "length": vec.size})
+        m.add_params(md.MSG_ARG_KEY_NUM_SAMPLES, 16.0)
+        m.add_params(md.MSG_ARG_KEY_ROUND_INDEX, 0)
+        return len(m.encode())
+
+    legacy, ring = frame_bytes(vec, False), frame_bytes(packed, True)
+    # ~2x on the dominant tensor section (header/meta overhead is O(1))
+    assert ring < 0.6 * legacy, (ring, legacy)
+
+
+def test_lsa_packed_wire_bitwise_matches_legacy_int64(eight_devices):
+    """End to end on the real protocol: (a) every model upload arrives
+    ring-packed (u32 + control meta); (b) a run whose clients speak the
+    LEGACY raw-int64 wire (no meta) is still accepted by the server and
+    produces the BITWISE-identical final global — masks differ between runs
+    (os.urandom) but cancel exactly in the field aggregate, and unpack is
+    exact, so the dequantized finals must match bit for bit."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo import message_define as md
+    from fedml_tpu.cross_silo import lightsecagg as lsa
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    wire_seen = []
+    orig_handle = lsa.LSAServerManager.handle_message_receive_model
+
+    def spy_handle(self, msg):
+        wire_seen.append((
+            np.asarray(msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)).dtype,
+            msg.get_control(lsa.MSG_ARG_KEY_MASKED_RING) is not None,
+        ))
+        orig_handle(self, msg)
+
+    orig_send = lsa.LSAClientManager.send_message
+
+    def legacy_send(self, msg):
+        # simulate an old client: unpack back to raw int64 and strip the
+        # ring meta before the frame hits the wire
+        meta = msg.get_control(lsa.MSG_ARG_KEY_MASKED_RING)
+        if meta is not None:
+            msg.msg_params[md.MSG_ARG_KEY_MODEL_PARAMS] = lsa.unpack_ring(
+                np.asarray(msg.get(md.MSG_ARG_KEY_MODEL_PARAMS)),
+                int(meta["ring_bits"]), int(meta["length"]))
+            msg.msg_params.pop(lsa.MSG_ARG_KEY_MASKED_RING)
+        orig_send(self, msg)
+
+    def run(run_id, legacy):
+        cfg = _lsa_config(run_id=run_id, comm_round=1,
+                          frequency_of_the_test=0)
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        model = model_hub.create(cfg, ds.class_num)
+        wire_seen.clear()
+        lsa.LSAServerManager.handle_message_receive_model = spy_handle
+        if legacy:
+            lsa.LSAClientManager.send_message = legacy_send
+        try:
+            _, server = lsa.run_lightsecagg_process_group(
+                cfg, ds, model, timeout=120.0)
+        finally:
+            lsa.LSAServerManager.handle_message_receive_model = orig_handle
+            lsa.LSAClientManager.send_message = orig_send
+        assert len(wire_seen) == cfg.client_num_in_total
+        for dtype, has_meta in wire_seen:
+            if legacy:
+                assert dtype == np.int64 and not has_meta
+            else:
+                assert dtype == np.uint32 and has_meta
+        import jax
+
+        return [np.asarray(l) for l in
+                jax.tree_util.tree_leaves(_final_global(server))]
+
+    packed = run("lsa_ring", legacy=False)
+    legacy = run("lsa_ring_legacy", legacy=True)
+    for a, b in zip(packed, legacy):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_secagg_flag_dispatch(eight_devices):
     """enable_secagg routes the cross-silo runner through LSA and refuses
     the single-process simulator."""
