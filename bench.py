@@ -1390,6 +1390,46 @@ def _mode_violations(mode, result) -> list:
 
 def main():
     argv = sys.argv[1:]
+    if "--mode" in argv and argv[argv.index("--mode") + 1] == "compare":
+        # regression sentinel (ISSUE 18, obs/regress.py): judge one result
+        # file against the BENCH_*.json trajectory.  Pure stdlib + no
+        # subprocess, no retry — comparison is deterministic, and a flaky
+        # rerun would only launder a real regression.
+        from fedml_tpu.obs import regress
+
+        def _opt(flag, default=None):
+            return argv[argv.index(flag) + 1] if flag in argv else default
+
+        candidate = _opt("--candidate")
+        if not candidate:
+            print("bench.py --mode compare requires --candidate <result.json>",
+                  file=sys.stderr)
+            sys.exit(2)
+        baseline_dir = _opt("--baseline-dir",
+                            os.path.dirname(os.path.abspath(__file__)))
+        try:
+            comparison = regress.compare_candidate(
+                candidate, baseline_dir,
+                rel_tol=float(_opt("--rel-tol", 0.10)),
+                nsigma=float(_opt("--nsigma", 3.0)))
+        except ValueError as e:
+            print(f"bench.py --mode compare: {e}", file=sys.stderr)
+            sys.exit(2)
+        print(json.dumps({"metric": "bench_compare",
+                          "value": len(comparison["regressions"]),
+                          "unit": "regressions",
+                          "floor_violations": [
+                              f"{r['metric']}: {r['candidate']} vs mean "
+                              f"{r['mean']} (slack {r['slack']})"
+                              for r in comparison["regressions"]],
+                          "detail": {"regression": comparison}}))
+        if not comparison["ok"]:
+            sys.stdout.flush()
+            print("BENCH REGRESSION: " + "; ".join(
+                r["metric"] for r in comparison["regressions"]),
+                file=sys.stderr)
+            sys.exit(3)
+        return
     if "--mode" in argv:
         # single-section run (`bench.py --mode federated_lora`): same
         # exit-3 / one-retry floor policy as the full bench

@@ -364,6 +364,33 @@ def cmd_obs(args) -> int:
         bad = (stitched.get("unaccounted") or 0) + \
             stitched["uploads"]["unattributed_lost"]
         return 0 if bad == 0 else 1
+    if args.obs_cmd == "dash":
+        from fedml_tpu.obs import dash as obs_dash
+        from fedml_tpu.obs import timeline as obs_timeline
+
+        if not Path(args.path).exists():
+            print(f"error: no such path {args.path}", file=sys.stderr)
+            return 2
+        loaded = obs_timeline.load_timeline(args.path)
+        if not loaded["samples"] and not loaded["rounds"]:
+            print(f"error: no readable timeline segments under {args.path}",
+                  file=sys.stderr)
+            return 1
+        profile = None
+        if args.profile:
+            if not Path(args.profile).exists():
+                print(f"error: no attribution json {args.profile}",
+                      file=sys.stderr)
+                return 2
+            with open(args.profile) as f:
+                profile = json.load(f)
+        if args.html:
+            html_doc = obs_dash.render_dash_html(loaded, profile)
+            Path(args.html).write_text(html_doc)
+            print(f"wrote {args.html} ({len(html_doc)} bytes)",
+                  file=sys.stderr)
+        print(obs_dash.render_dash_text(loaded, profile))
+        return 0
     if args.obs_cmd == "serve":
         from fedml_tpu.obs.registry import REGISTRY, MetricsHTTPServer
 
@@ -589,6 +616,16 @@ def main(argv=None) -> int:
                      help="emit the stitched structure as JSON instead of text")
     opm.add_argument("--limit", type=int, default=40,
                      help="timeline events to render (<=0 = all; default 40)")
+    odash = osub.add_parser(
+        "dash",
+        help="performance dashboard from recorded timeline segments")
+    odash.add_argument("path",
+                       help="timeline segment directory (extra.timeline_dir)")
+    odash.add_argument("--html", default="",
+                       help="also write a self-contained HTML dashboard here")
+    odash.add_argument("--profile", default="",
+                       help="profiler attribution JSON (obs/profiler.py) to "
+                            "render as an attribution table")
     p.set_defaults(fn=cmd_obs)
 
     p = sub.add_parser("lint", help="AST invariant checker (GL001-GL009) over fedml_tpu/")

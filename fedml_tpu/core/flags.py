@@ -371,6 +371,33 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "derived per-round achieved-FLOPS/MFU gauges in sim/engine.py "
              "(forces an eager compile at program resolve time; unset = no "
              "cost analysis, bit-identical default path)."),
+    FlagSpec("perf_timeline", "bool", False,
+             "Continuous performance timeline: periodic registry-snapshot "
+             "deltas sampled on the server runtime's timer wheel into a "
+             "bounded in-memory ring plus atomic on-disk segment files, "
+             "with range-scan / windowed-rate / histogram-pNN queries and a "
+             "convergence series tee'd from the servers' round history "
+             "(fedml_convergence_rounds_to_target); unset = no recorder, "
+             "no timer, bit-identical default path."),
+    FlagSpec("timeline_dir", "str", None,
+             "Directory timeline segment files are flushed into; derived: "
+             "<cwd>/perf_timeline."),
+    FlagSpec("timeline_interval_s", "float", 1.0,
+             "Timeline sampling cadence on the timer wheel."),
+    FlagSpec("timeline_capacity", "int", 512,
+             "Timeline ring capacity in samples (oldest evicted first — "
+             "the bound that keeps recorder memory constant under "
+             "sustained sampling); segments flush every capacity/2 "
+             "samples."),
+    FlagSpec("profile_rounds", "str", None,
+             "Profile window for per-program device-time attribution: 'n' "
+             "traces rounds 0..n-1, 'k:n' traces n rounds starting at k "
+             "(programmatic jax.profiler start/stop around the sim "
+             "engine's round chunks; unset = no tracing, bit-identical "
+             "default path)."),
+    FlagSpec("profile_dir", "str", None,
+             "Directory the profiler trace + attribution JSON land in; "
+             "derived: <cwd>/profile_traces."),
     # -- multi-host ----------------------------------------------------------
     FlagSpec("coordinator_address", "str", None,
              "jax.distributed coordinator host:port "

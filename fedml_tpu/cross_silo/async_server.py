@@ -356,6 +356,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
         self._close_round_trace(agg_span, eval_span)
         self.logger.log(metrics)
         self.history.append(metrics)
+        if self.timeline is not None:
+            # convergence tee: the async series is keyed by server version
+            self.timeline.note_round(server_version=self.server_version,
+                                     test_acc=metrics.get("test_acc"))
         self.server_version += 1
         self.round_idx = self.server_version  # keep base-class reporting honest
         self._arrivals_in_round = 0

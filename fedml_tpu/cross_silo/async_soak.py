@@ -740,7 +740,8 @@ def run_edge_kill_soak(n_clients: int = 4, fanout: int = 2, rounds: int = 2,
                        hop_codec: Optional[str] = None,
                        codec: Optional[str] = None,
                        topology: Optional[dict] = None,
-                       timeout_s: float = 120.0) -> dict:
+                       timeout_s: float = 120.0,
+                       extra_flags: Optional[dict] = None) -> dict:
     """Edge-node SIGKILL soak over the SYNCHRONOUS hierarchical tree
     (ISSUE 17): real root + real :class:`~fedml_tpu.cross_silo.edge.
     EdgeAggregatorManager` nodes on the in-proc fabric, clients simulated by
@@ -802,7 +803,11 @@ def run_edge_kill_soak(n_clients: int = 4, fanout: int = 2, rounds: int = 2,
         random_seed=seed,
         extra={"streaming_aggregation": True,
                "server_journal_dir": f"{workdir}/journal", **hier_extra,
-               **({"hier_hop_codec": hop_codec} if hop_codec else {})},
+               **({"hier_hop_codec": hop_codec} if hop_codec else {}),
+               # caller overrides last (flight_recorder, perf_timeline, ...);
+               # point any output dirs OUTSIDE the soak's workdir — it is
+               # rmtree'd on the way out
+               **(extra_flags or {})},
     )
     fedml_tpu.init(cfg)
     ds = loader.load(cfg)

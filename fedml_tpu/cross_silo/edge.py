@@ -559,6 +559,19 @@ class EdgeAggregatorManager(FedMLCommManager):
         #: True when construction restored a journal snapshot (soak_worker
         #: boot files report it, same field as ClientMasterManager)
         self.resumed_from_journal = False
+        # flight recorder (ISSUE 18 satellite), gated on
+        # extra.flight_recorder: edge nodes were the one fleet role without
+        # a black box — a SIGKILLed edge left nothing for the postmortem to
+        # stitch.  No comm tap here (same reasoning as the health ledger
+        # above: in-process trees run many nodes per process and the
+        # process-wide sink would cross-pollinate hops); signal handlers
+        # are installed by soak_worker's edge role, where one process IS
+        # one edge.
+        from ..obs import flight as obsflight
+
+        self.flight = obsflight.recorder_from_config(
+            cfg, name=f"edge_{rank}",
+            meta={"role": "edge", "rank": int(rank), "tier": self.tier})
         # own journal under <server_journal_dir>/edge_<rank>
         self.journal = None
         root_dir = cfg_extra(cfg, "server_journal_dir")
@@ -664,6 +677,9 @@ class EdgeAggregatorManager(FedMLCommManager):
             if upload_key is not None and self._is_duplicate_upload(sender, upload_key):
                 self.deduped_uploads += 1
                 EDGE_DEDUPED.inc()
+                if self.flight is not None:
+                    self.flight.note("edge_dedup", sender=sender,
+                                     round_idx=self._round_idx, keyed=True)
                 return
             if self._round_idx is None or int(
                     msg.get_control(md.MSG_ARG_KEY_ROUND_INDEX, -1)) != self._round_idx:
@@ -674,6 +690,9 @@ class EdgeAggregatorManager(FedMLCommManager):
             if sender in self._arrived:
                 self.deduped_uploads += 1
                 EDGE_DEDUPED.inc()
+                if self.flight is not None:
+                    self.flight.note("edge_dedup", sender=sender,
+                                     round_idx=self._round_idx, keyed=False)
                 return  # keyless redelivery within the round
             sent_at = self._sent_at.pop(sender, None)
             if sent_at is not None:
@@ -700,6 +719,10 @@ class EdgeAggregatorManager(FedMLCommManager):
                 if folded:
                     self.folds += 1
                     EDGE_FOLDS.inc()
+                    if self.flight is not None:
+                        self.flight.note("edge_fold", sender=sender,
+                                         round_idx=self._round_idx,
+                                         partial=child_tag is not None)
             if not folded:
                 self._relay_upload(msg, sender)
             self._note_upload_key(sender, upload_key)
@@ -725,6 +748,9 @@ class EdgeAggregatorManager(FedMLCommManager):
                 fwd.add_params(key, val)
         self.relays += 1
         EDGE_RELAYS.inc()
+        if self.flight is not None:
+            self.flight.note("edge_relay", sender=sender,
+                             round_idx=self._round_idx)
         try:
             self.send_message(fwd)
         except Exception:
@@ -780,6 +806,10 @@ class EdgeAggregatorManager(FedMLCommManager):
         self._journal_snapshot_locked()
         self.partials_sent += 1
         PARTIALS_SENT.inc()
+        if self.flight is not None:
+            self.flight.note("edge_partial_ship", round_idx=self._round_idx,
+                             children=len(self._arrived),
+                             expected=len(self._expect), resend=resend)
         try:
             self.send_message(up)
         except Exception:
@@ -899,6 +929,12 @@ class EdgeAggregatorManager(FedMLCommManager):
         the last fold and the ship — ship now; queued uploads need no nudge,
         they drain through the handler."""
         with self._lock:
+            if self.flight is not None:
+                self.flight.note("recovery_resume", round_idx=self._round_idx,
+                                 shipped=self._shipped,
+                                 arrived=len(self._arrived),
+                                 expected=len(self._expect),
+                                 resumed=self.resumed_from_journal)
             if (self._round_idx is not None and not self._shipped
                     and self._fold is not None
                     and set(self._expect) <= self._arrived):
@@ -911,15 +947,27 @@ class EdgeAggregatorManager(FedMLCommManager):
         self.done.set()
         self.finish()
 
-    def hard_kill(self) -> None:  # graftlint: disable=GL008(crash simulation: deliberately lock-free — a SIGKILL takes no locks either; the restarted manager rebuilds every invariant from the journal under its own lock)
+    def hard_kill(self) -> None:  # graftlint: disable=GL008(crash simulation: deliberately lock-free — a SIGKILL takes no locks either; the restarted manager rebuilds every invariant from the journal under its own lock),GL004(same: the flight trigger reads _round_idx/_shipped racily on purpose — a best-effort snapshot at the kill instant, never a consistency source)
         """SIGKILL simulation for the chaos soak: stop the receive loop and
         timers abruptly — no ship, no journal write, no teardown.  Whatever
         the per-fold journal cadence already committed survives; everything
         since is lost, exactly like a real kill."""
+        if self.flight is not None:
+            # the black box outlives the kill: one atomic bundle with the
+            # ring's folds/relays/dedups, stitchable by `obs postmortem`
+            self.flight.trigger("hard_kill", rank=int(self.rank),
+                                round_idx=self._round_idx,
+                                shipped=self._shipped)
+            self.flight.close()
         self._runtime.cancel(self)
         self.com_manager.stop_receive_message()
 
-    def finish(self) -> None:
+    def finish(self) -> None:  # graftlint: disable=GL004(teardown: done has latched and the receive loop is quiescing — the flight trigger's counter reads are a final best-effort snapshot),GL008(same single-quiescent-reader argument for folds/relays)
+        if self.flight is not None and not self.flight._closed:
+            self.flight.trigger("finish", rank=int(self.rank),
+                                round_idx=self._round_idx,
+                                folds=self.folds, relays=self.relays)
+            self.flight.close()
         self._runtime.cancel(self)
         super().finish()
         if self._owns_runtime:

@@ -40,7 +40,17 @@ from ..fl.local_sgd import make_eval_fn
 from ..obs import registry as obsreg, trace as obstrace
 from ..obs.metrics import MetricsLogger
 from . import message_define as md
-from .edge import HOP_BYTES as HIER_HOP_BYTES, build_topology
+from .edge import (
+    EDGE_DEDUPED as HIER_EDGE_DEDUPED,
+    EDGE_FOLDS as HIER_EDGE_FOLDS,
+    EDGE_RELAYS as HIER_EDGE_RELAYS,
+    HOP_BYTES as HIER_HOP_BYTES,
+    PARTIALS_SENT as HIER_PARTIALS_SENT,
+    TREE_DEPTH as HIER_TREE_DEPTH,
+    TREE_EDGES as HIER_TREE_EDGES,
+    TREE_FANOUT as HIER_TREE_FANOUT,
+    build_topology,
+)
 
 log = logging.getLogger("fedml_tpu.cross_silo.server")
 
@@ -688,6 +698,17 @@ class FedMLServerManager(FedMLCommManager):
             otlp=self.otlp, flight=self.flight)
         if self.slo is not None:
             self.slo.start()
+        # performance timeline (ISSUE 18), gated on extra.perf_timeline:
+        # periodic registry-snapshot samples on THIS manager's timer wheel
+        # into a bounded ring + atomic segment files, plus the convergence
+        # series tee'd from _finish_round — the input to `fedml-tpu obs dash`
+        from ..obs import timeline as obstimeline
+
+        self.timeline = obstimeline.timeline_from_config(
+            cfg, name="server", runtime=self._runtime,
+            meta={"role": "server"})
+        if self.timeline is not None:
+            self.timeline.start()
         # durable recovery journal (cross_silo/journal.py), gated on
         # extra.server_journal_dir: snapshot full protocol state at round
         # boundaries, recover on restart under a bumped session epoch.
@@ -999,6 +1020,11 @@ class FedMLServerManager(FedMLCommManager):
         self._close_round_trace(agg_span, eval_span)
         self.logger.log(metrics)
         self.history.append(metrics)
+        if self.timeline is not None:
+            # convergence tee: (round_idx, test_acc, wall) becomes timeline
+            # data + the rounds-to-target gauge
+            self.timeline.note_round(round_idx=self.round_idx,
+                                     test_acc=metrics.get("test_acc"))
         self.round_idx += 1
         self._journal_snapshot()
         self._publish_model()
@@ -1040,6 +1066,26 @@ class FedMLServerManager(FedMLCommManager):
             # health trajectory rides the same trail: one client_health
             # record per known client, per round (obs report renders it)
             records += self.health.records(trace_id=round_span.trace_id)
+            if self.topology is not None:
+                # hierarchy trajectory: cumulative tree counters per round
+                # (INPROC edges share this process's registry) — obs report's
+                # hierarchy section differences consecutive records
+                records.append(
+                    {"kind": "metric", "metric": "hier_tree",
+                     "round_idx": self.round_idx,
+                     "trace_id": round_span.trace_id, "ts": time.time(),
+                     "hop_bytes": {
+                         hop: int(HIER_HOP_BYTES.value(hop=hop))
+                         for hop in ("client_edge", "edge_region", "edge_root")
+                     },
+                     "folds": int(HIER_EDGE_FOLDS.value()),
+                     "relays": int(HIER_EDGE_RELAYS.value()),
+                     "deduped": int(HIER_EDGE_DEDUPED.value()),
+                     "partials_sent": int(HIER_PARTIALS_SENT.value()),
+                     "depth": int(HIER_TREE_DEPTH.value()),
+                     "fanout": int(HIER_TREE_FANOUT.value()),
+                     "edges": int(HIER_TREE_EDGES.value())}
+                )
             self.obs_collector.ingest(0, records)
         if self.flight is not None:
             for s in child_spans:
@@ -1296,6 +1342,10 @@ class FedMLServerManager(FedMLCommManager):
             self.round_gate.release(self)
         if self.slo is not None:
             self.slo.stop()
+        if self.timeline is not None:
+            # final sample + segment flush, then the timer is released
+            # (close latches, so the timeout-path double finish is safe)
+            self.timeline.close()
         if self.flight is not None and not self.flight._closed:
             # one terminal bundle per run (close() latches, so the racing
             # straggler-timer finish can't dump twice)

@@ -99,6 +99,11 @@ def main() -> int:
         if topo is None:
             raise SystemExit("edge role requires hier_fanout/hier_topology")
         edge = EdgeAggregatorManager(cfg, topo, rank=rank, backend="TCP")
+        if edge.flight is not None:
+            # real-process edge: one rank per process, so the process-wide
+            # SIGTERM/excepthook taps are safe here (same reasoning as the
+            # client role below; in-process trees leave them uninstalled)
+            edge.flight.install_signal_handlers()
         prior_boots = glob.glob(os.path.join(workdir, f"boot_r{rank}_*.json"))
         _atomic_write_json(
             os.path.join(workdir, f"boot_r{rank}_{os.getpid()}.json"),

@@ -38,8 +38,10 @@ _REGISTERING_MODULES = (
     "fedml_tpu.obs.flight",
     "fedml_tpu.obs.health",
     "fedml_tpu.obs.otlp",
+    "fedml_tpu.obs.profiler",
     "fedml_tpu.obs.remote",
     "fedml_tpu.obs.slo",
+    "fedml_tpu.obs.timeline",
     "fedml_tpu.ops.pallas.timing",
     "fedml_tpu.population.cohorts",
     "fedml_tpu.population.store",
@@ -57,6 +59,7 @@ _SECTIONS = {
     "chaos": "Chaos injection",
     "client": "Client health + journals",
     "comm": "Communication layer",
+    "convergence": "Convergence tracking",
     "crosssilo": "Cross-silo rounds",
     "flight": "Flight recorder",
     "hier": "Hierarchical aggregation tree",
@@ -66,11 +69,13 @@ _SECTIONS = {
     "otlp": "OTLP egress",
     "pallas": "Pallas kernels",
     "pop": "Population-scale store",
+    "profile": "Program-time attribution",
     "program": "Compiled-program cost model",
     "runtime": "Event-driven runtime",
     "serving": "Serving fleet",
     "sim": "Simulation engine",
     "slo": "SLO watchdog",
+    "timeline": "Performance timeline",
 }
 
 

@@ -1,0 +1,196 @@
+"""Bench regression sentinel (ISSUE 18).
+
+The repo accumulated a trajectory of ``BENCH_*.json`` results — one per
+growth round — that until now was compared by eyeball.  This module makes
+the trajectory machine-checked: :func:`load_trajectory` flattens every
+numeric leaf of each result's ``parsed`` payload into dotted metric paths
+(``detail.llm.mfu``, ``detail.fedavg_cifar10_resnet20.rounds_per_sec``,
+...), and :func:`compare` judges a fresh run metric-by-metric with
+noise-aware thresholds:
+
+    slack = max(rel_tol * |mean|, nsigma * std, abs_tol)
+
+so a metric that historically wobbles (std captures it) gets proportional
+headroom while a rock-stable one is held tight — but never tighter than
+``rel_tol`` of its mean, because a 5-point trajectory's std is itself
+noisy.  Direction is inferred from the leaf name (``*_seconds``, ``lag``,
+``bytes`` ... regress UP; throughputs and MFU regress DOWN) — a metric
+the heuristic can't classify is checked in its inferred direction only,
+never both (a genuinely ambiguous name would otherwise always flag).
+
+Config-shaped leaves (batch sizes, client counts, chip peaks) are
+excluded: they describe the experiment, not its performance, and a
+deliberate config change must not read as a regression.
+
+``bench.py --mode compare`` wraps this into the exit-code contract the
+driver consumes: ``detail.regression`` in the result JSON, exit 3 on any
+regression.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import math
+import os
+from typing import Any, Optional, Sequence
+
+log = logging.getLogger("fedml_tpu.obs.regress")
+
+__all__ = ["load_trajectory", "flatten_numeric", "compare",
+           "compare_candidate", "lower_is_better"]
+
+#: leaf names that describe the experiment's configuration, not its
+#: performance — excluded from comparison entirely
+_CONFIG_LEAVES = frozenset({
+    "batch", "seq_len", "clients_total", "clients_per_round", "n_params_m",
+    "flops_per_token_g", "chip_peak_tflops", "n", "rc", "vs_baseline",
+    "comm_round", "epochs",
+})
+
+#: leaf-name fragments whose metrics regress UPWARD (cost-like); anything
+#: else is treated as throughput-like and regresses DOWNWARD
+_LOWER_BETTER_FRAGMENTS = (
+    "seconds", "_s", "lag", "staleness", "bytes", "loss", "dropped",
+    "violations", "latency", "host_gap", "compile", "wait", "retries",
+    "deduped", "breaches", "unaccounted", "skipped",
+)
+
+
+def lower_is_better(metric_path: str) -> bool:
+    leaf = metric_path.rsplit(".", 1)[-1].lower()
+    return any(f in leaf for f in _LOWER_BETTER_FRAGMENTS)
+
+
+def flatten_numeric(parsed: Any, prefix: str = "") -> dict[str, float]:
+    """Dotted-path -> value for every numeric leaf, config leaves and
+    non-numerics skipped (bools are config, not measurements)."""
+    out: dict[str, float] = {}
+    if isinstance(parsed, dict):
+        for k, v in parsed.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, (dict, list)):
+                out.update(flatten_numeric(v, key))
+            elif isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            elif str(k) in _CONFIG_LEAVES:
+                continue
+            elif math.isfinite(float(v)):
+                out[key] = float(v)
+    elif isinstance(parsed, list):
+        # lists in bench results are violation/event collections — their
+        # LENGTH is the comparable quantity
+        if prefix:
+            out[prefix + ".len"] = float(len(parsed))
+    return out
+
+
+def load_trajectory(root: str, pattern: str = "BENCH_*.json") -> list[dict]:
+    """Every readable bench result under ``root``, flattened and sorted by
+    its round number: ``[{"path", "round", "metrics": {...}}]``."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(str(root), pattern))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            log.warning("regress: skipping unreadable %s (%s)", path, e)
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        out.append({
+            "path": path,
+            "round": int(doc.get("n", 0) or 0),
+            "metrics": flatten_numeric(parsed),
+        })
+    out.sort(key=lambda r: (r["round"], r["path"]))
+    return out
+
+
+def _mean_std(values: Sequence[float]) -> tuple[float, float]:
+    n = len(values)
+    mean = sum(values) / n
+    var = sum((v - mean) ** 2 for v in values) / n
+    return mean, math.sqrt(var)
+
+
+def compare(trajectory: Sequence[dict], candidate: dict, *,
+            rel_tol: float = 0.10, nsigma: float = 3.0,
+            abs_tol: float = 1e-9) -> dict:
+    """Judge ``candidate`` (flattened metrics) against the trajectory.
+
+    Returns ``{"ok", "checked", "regressions": [...], "improvements":
+    [...], "new_metrics": [...], "thresholds": {...}}`` — regressions
+    carry the full evidence (candidate, mean, std, slack, direction) so
+    the driver's log is the postmortem."""
+    by_metric: dict[str, list[float]] = {}
+    for entry in trajectory:
+        for k, v in entry.get("metrics", {}).items():
+            by_metric.setdefault(k, []).append(float(v))
+    regressions, improvements, checked = [], [], 0
+    new_metrics = sorted(set(candidate) - set(by_metric))
+    for metric, cand in sorted(candidate.items()):
+        history = by_metric.get(metric)
+        if not history:
+            continue
+        checked += 1
+        mean, std = _mean_std(history)
+        slack = max(rel_tol * abs(mean), nsigma * std, abs_tol)
+        lower = lower_is_better(metric)
+        delta = (cand - mean) if lower else (mean - cand)
+        row = {"metric": metric, "candidate": round(cand, 9),
+               "mean": round(mean, 9), "std": round(std, 9),
+               "slack": round(slack, 9), "n_history": len(history),
+               "direction": "lower_better" if lower else "higher_better"}
+        if delta > slack:
+            regressions.append(row)
+        elif delta < -slack:
+            improvements.append(row)
+    return {
+        "ok": not regressions,
+        "checked": checked,
+        "regressions": regressions,
+        "improvements": improvements,
+        "new_metrics": new_metrics,
+        "thresholds": {"rel_tol": rel_tol, "nsigma": nsigma,
+                       "abs_tol": abs_tol},
+    }
+
+
+def compare_candidate(candidate_path: str, baseline_dir: str, *,
+                      rel_tol: float = 0.10, nsigma: float = 3.0,
+                      abs_tol: float = 1e-9,
+                      exclude_self: bool = True) -> dict:
+    """Load + flatten one candidate result file and judge it against the
+    ``BENCH_*.json`` trajectory under ``baseline_dir`` (the candidate's
+    own file is excluded from the trajectory when it lives there).
+    Raises ``ValueError`` on an unreadable/shape-less candidate — an
+    absent input is an invocation error, not a clean pass."""
+    try:
+        with open(candidate_path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"candidate {candidate_path}: {e}") from e
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    if not isinstance(parsed, dict):
+        # allow a bare parsed payload (a BENCH_RESULT line's JSON)
+        parsed = doc if isinstance(doc, dict) else None
+    if not isinstance(parsed, dict):
+        raise ValueError(f"candidate {candidate_path}: no parsed payload")
+    candidate = flatten_numeric(parsed)
+    if not candidate:
+        raise ValueError(f"candidate {candidate_path}: no numeric metrics")
+    trajectory = load_trajectory(baseline_dir)
+    if exclude_self:
+        cand_abs = os.path.abspath(candidate_path)
+        trajectory = [t for t in trajectory
+                      if os.path.abspath(t["path"]) != cand_abs]
+    result = compare(trajectory, candidate, rel_tol=rel_tol, nsigma=nsigma,
+                     abs_tol=abs_tol)
+    result["candidate_path"] = candidate_path
+    result["baseline_dir"] = str(baseline_dir)
+    result["trajectory"] = [{"path": t["path"], "round": t["round"]}
+                            for t in trajectory]
+    return result

@@ -22,7 +22,7 @@ from typing import Iterable, Optional, Sequence
 __all__ = [
     "load_jsonl", "SpanNode", "build_span_trees", "round_rows",
     "phase_percentiles", "slowest_clients", "pallas_kernel_stats",
-    "client_health_rows", "render_report",
+    "client_health_rows", "hier_rows", "render_report",
 ]
 
 
@@ -303,6 +303,73 @@ def client_health_rows(records: Iterable[dict]) -> list[dict]:
     return out
 
 
+_HOPS = ("client_edge", "edge_region", "edge_root")
+
+
+def hier_rows(records: Iterable[dict]) -> list[dict]:
+    """Per-round hierarchy rows from the ``hier_tree`` trail records the
+    cross-silo server persists at round close when an aggregation tree is
+    configured.  The recorded counters are CUMULATIVE (straight reads of the
+    ``fedml_hier_*`` families), so each row differences consecutive records —
+    the first row's deltas are its absolute values, which is correct for a
+    trail that starts at round 0.  Tree-shape gauges (depth/fanout/edges) are
+    level values and pass through undifferenced."""
+    recs = []
+    for rec in records:
+        if rec.get("kind") == "metric" and rec.get("metric") == "hier_tree":
+            recs.append(rec)
+
+    def _num(rec, key, default=0.0):
+        try:
+            return float(rec.get(key, default) or 0.0)
+        except (TypeError, ValueError):
+            return float(default)
+
+    def rec_key(item):
+        i, rec = item
+        try:
+            return (0, float(rec.get("round_idx")), i)
+        except (TypeError, ValueError):
+            return (1, float(i), 0)
+
+    ordered = [rec for _, rec in sorted(enumerate(recs), key=rec_key)]
+    out = []
+    prev = None
+    for rec in ordered:
+        hop_bytes = rec.get("hop_bytes") or {}
+        if not isinstance(hop_bytes, dict):
+            hop_bytes = {}
+        cum = {
+            "hop_bytes": {hop: _num(hop_bytes, hop) for hop in _HOPS},
+            "folds": _num(rec, "folds"),
+            "relays": _num(rec, "relays"),
+            "deduped": _num(rec, "deduped"),
+            "partials_sent": _num(rec, "partials_sent"),
+        }
+        row = {
+            "round_idx": rec.get("round_idx"),
+            "hop_bytes": dict(cum["hop_bytes"]),
+            "folds": cum["folds"],
+            "relays": cum["relays"],
+            "deduped": cum["deduped"],
+            "partials_sent": cum["partials_sent"],
+            "depth": _num(rec, "depth"),
+            "fanout": _num(rec, "fanout"),
+            "edges": _num(rec, "edges"),
+        }
+        if prev is not None:
+            # counters only move forward; a negative delta means the trail
+            # spans a process restart — clamp rather than report nonsense
+            for hop in _HOPS:
+                row["hop_bytes"][hop] = max(
+                    0.0, cum["hop_bytes"][hop] - prev["hop_bytes"][hop])
+            for key in ("folds", "relays", "deduped", "partials_sent"):
+                row[key] = max(0.0, cum[key] - prev[key])
+        prev = cum
+        out.append(row)
+    return out
+
+
 def _table(headers: list[str], rows: list[list[str]]) -> str:
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(headers)]
@@ -368,6 +435,23 @@ def render_report(records: Iterable[dict]) -> str:
               _s(r["ewma_rtt_s"] if isinstance(r["ewma_rtt_s"], (int, float)) else None),
               _s(float(r["breaches"] or 0.0)), _s(float(r["comm_failures"] or 0.0))]
              for r in health],
+        ))
+
+    hier = hier_rows(records)
+    if hier:
+        last = hier[-1]
+        shape = (f"tree depth={int(last['depth'])} "
+                 f"fanout={int(last['fanout'])} edges={int(last['edges'])}")
+        sections.append("== hierarchy ==\n" + shape + "\n" + _table(
+            ["round", "client_edge_B", "edge_region_B", "edge_root_B",
+             "folds", "relays", "deduped", "partials"],
+            [[str(r["round_idx"]),
+              str(int(r["hop_bytes"]["client_edge"])),
+              str(int(r["hop_bytes"]["edge_region"])),
+              str(int(r["hop_bytes"]["edge_root"])),
+              str(int(r["folds"])), str(int(r["relays"])),
+              str(int(r["deduped"])), str(int(r["partials_sent"]))]
+             for r in hier],
         ))
 
     kernels = pallas_kernel_stats(records)

@@ -1,0 +1,515 @@
+"""Performance timeline — continuous telemetry recording (ISSUE 18).
+
+PR 16 made *failures* self-explaining; performance was still observed at
+two instants only (a live ``/metrics`` scrape, a pile of ``BENCH_*.json``).
+This module is the substrate between those instants: a
+:class:`TimelineRecorder` samples ``MetricsRegistry.snapshot()`` on the
+existing ``cross_silo/runtime.py`` timer wheel — NO new threads — into a
+bounded in-memory ring, flushes atomic on-disk segment files with the
+flight-bundle envelope (MAGIC + one sorted-keys JSON meta line + JSON
+body, ``tempfile.mkstemp`` + fsync + ``os.replace``), and answers the
+queries a performance investigation actually asks:
+
+- **range scans** over samples (in-ring or loaded from segments),
+- **windowed rates** of any counter series (``rounds/s``, ``versions/s``,
+  ``bytes/s`` between any two sampled instants, not just "now"),
+- **histogram-delta pNN** — percentile of the *window's* observations
+  (last counts minus first counts, bucket-interpolated), which a
+  cumulative ``/metrics`` scrape fundamentally cannot answer.
+
+Samples store the *cumulative* scalarized snapshot; every query is a
+delta between two samples, so the ring IS a time series of deltas without
+the reconstruction fragility of storing increments.
+
+The recorder also owns the **convergence series** (ROADMAP
+"rounds-to-accuracy as a tracked metric"): the servers tee each finished
+round's ``(round_idx, server_version, test_acc, wall)`` through
+:meth:`TimelineRecorder.note_round`, and the first crossing of each
+accuracy target becomes ``fedml_convergence_rounds_to_target{target}`` —
+throughput × rounds-to-target (the survey's judging criterion) is then
+two queries against one artifact.
+
+Gating is absolute: :func:`timeline_from_config` returns ``None`` unless
+``extra.perf_timeline`` is set — no ring, no timer, no segment files,
+default path bit-identical.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Optional, Sequence
+
+from ..core.flags import cfg_extra
+from . import registry as obsreg
+
+log = logging.getLogger("fedml_tpu.obs.timeline")
+
+__all__ = [
+    "TimelineRecorder", "timeline_from_config", "read_segment",
+    "list_segments", "load_timeline", "range_scan", "windowed_rate",
+    "hist_pnn", "value_series", "rounds_to_target",
+]
+
+#: on-disk segment envelope: MAGIC + one sorted-keys JSON meta line + the
+#: JSON body.  Bump the magic when the envelope changes — old segments are
+#: then rejected as foreign, never misread.
+_MAGIC = b"FMLTLN1\n"
+
+#: accuracy targets tracked by default (first-crossing round per target)
+_DEFAULT_TARGETS = (0.5, 0.6, 0.7, 0.8, 0.9)
+
+TIMELINE_SAMPLES = obsreg.REGISTRY.counter(
+    "fedml_timeline_samples_total",
+    "Registry snapshots sampled into the performance-timeline ring.",
+)
+TIMELINE_SEGMENTS = obsreg.REGISTRY.counter(
+    "fedml_timeline_segments_total",
+    "Atomic timeline segment files flushed to disk.",
+)
+CONV_ROUND = obsreg.REGISTRY.gauge(
+    "fedml_convergence_round",
+    "Latest round index (sync) or server version (async) tee'd into the "
+    "convergence series.",
+)
+CONV_TEST_ACC = obsreg.REGISTRY.gauge(
+    "fedml_convergence_test_acc",
+    "Latest test accuracy tee'd into the convergence series.",
+)
+ROUNDS_TO_TARGET = obsreg.REGISTRY.gauge(
+    "fedml_convergence_rounds_to_target",
+    "First round index whose test accuracy reached the target (the ROADMAP "
+    "rounds-to-accuracy metric; unset until the target is crossed).",
+    labels=("target",),
+)
+
+
+def _split_snapshot(snapshot: list[dict]) -> tuple[dict, dict, dict]:
+    """Flatten a registry snapshot into ``(scalars, hists, buckets)`` —
+    counters/gauges as ``{"family{k=v,...}": value}``, histograms as
+    ``{key: {"counts": [...], "sum": s, "count": n}}`` with the bucket
+    bounds keyed per family (stored once, not per sample)."""
+    scalars: dict[str, float] = {}
+    hists: dict[str, dict] = {}
+    buckets: dict[str, list[float]] = {}
+    for fam in snapshot:
+        name = fam["name"]
+        hist = fam.get("kind") == "histogram"
+        if hist and fam.get("buckets"):
+            buckets[name] = [float(b) for b in fam["buckets"]]
+        for s in fam.get("samples", ()):
+            labels = ",".join(f"{k}={v}" for k, v in sorted(s["labels"].items()))
+            key = f"{name}{{{labels}}}" if labels else name
+            if hist:
+                hists[key] = {"counts": [int(c) for c in s["counts"]],
+                              "sum": float(s["sum"]), "count": int(s["count"])}
+            else:
+                scalars[key] = float(s["value"])
+    return scalars, hists, buckets
+
+
+def _family_of(key: str) -> str:
+    return key.split("{", 1)[0]
+
+
+# ---------------------------------------------------------------------------
+# pure query functions — they work on ANY sorted sample list (the live ring
+# or segments loaded back from disk), which is what lets the dash and tests
+# share one implementation with the recorder
+
+
+def range_scan(samples: Sequence[dict], start_ts: Optional[float] = None,
+               end_ts: Optional[float] = None) -> list[dict]:
+    """Samples whose timestamp falls in ``[start_ts, end_ts]`` (either
+    bound ``None`` = unbounded)."""
+    out = []
+    for s in samples:
+        ts = float(s.get("ts", 0.0))
+        if start_ts is not None and ts < start_ts:
+            continue
+        if end_ts is not None and ts > end_ts:
+            continue
+        out.append(s)
+    return out
+
+
+def _window(samples: Sequence[dict], window_s: Optional[float],
+            now: Optional[float]) -> list[dict]:
+    if not samples:
+        return []
+    if window_s is None or window_s <= 0:
+        return list(samples)
+    t = now if now is not None else float(samples[-1].get("ts", 0.0))
+    return range_scan(samples, start_ts=t - float(window_s), end_ts=t)
+
+
+def windowed_rate(samples: Sequence[dict], key: str,
+                  window_s: Optional[float] = None,
+                  now: Optional[float] = None) -> Optional[float]:
+    """Per-second rate of a cumulative scalar series over the window:
+    ``(last - first) / (t_last - t_first)`` between the window's first and
+    last samples carrying the series.  ``None`` without two such samples
+    (no data = no rate, never a fabricated zero)."""
+    win = [s for s in _window(samples, window_s, now)
+           if key in s.get("scalars", {})]
+    if len(win) < 2:
+        return None
+    t0, t1 = float(win[0]["ts"]), float(win[-1]["ts"])
+    if t1 <= t0:
+        return None
+    return (float(win[-1]["scalars"][key]) - float(win[0]["scalars"][key])) / (t1 - t0)
+
+
+def value_series(samples: Sequence[dict], key: str) -> list[tuple[float, float]]:
+    """``[(ts, value)]`` for one scalar series — the dash's curve input."""
+    return [(float(s["ts"]), float(s["scalars"][key]))
+            for s in samples if key in s.get("scalars", {})]
+
+
+def hist_pnn(samples: Sequence[dict], key: str, q: float,
+             buckets: Sequence[float],
+             window_s: Optional[float] = None,
+             now: Optional[float] = None) -> Optional[float]:
+    """Bucket-interpolated percentile of the observations that landed
+    WITHIN the window: per-bucket counts are differenced between the
+    window's last and first samples, then walked to the ``q`` quantile
+    with linear interpolation inside the bucket (the +Inf bucket reports
+    the last finite bound).  ``q`` in (0, 1]."""
+    win = [s for s in _window(samples, window_s, now)
+           if key in s.get("hists", {})]
+    if len(win) < 2 or not buckets:
+        return None
+    first = win[0]["hists"][key]["counts"]
+    last = win[-1]["hists"][key]["counts"]
+    delta = [max(0, int(b) - int(a)) for a, b in zip(first, last)]
+    total = sum(delta)
+    if total == 0:
+        return None
+    target = float(q) * total
+    cumulative = 0
+    lo = 0.0
+    for bound, c in zip(buckets, delta):
+        hi = float(bound)
+        if c and cumulative + c >= target:
+            if hi == float("inf"):
+                return lo
+            frac = (target - cumulative) / c
+            return lo + frac * (hi - lo)
+        cumulative += c
+        if hi != float("inf"):
+            lo = hi
+    return lo
+
+
+def rounds_to_target(rounds: Sequence[dict],
+                     targets: Sequence[float] = _DEFAULT_TARGETS
+                     ) -> dict[str, Optional[float]]:
+    """First-crossing round per accuracy target over a convergence series
+    (``None`` = never crossed) — the offline twin of the live gauge, so a
+    loaded timeline answers rounds-to-accuracy without a running server."""
+    out: dict[str, Optional[float]] = {f"{t:g}": None for t in targets}
+    for r in rounds:
+        acc = r.get("test_acc")
+        if acc is None:
+            continue
+        idx = r.get("round_idx")
+        idx = r.get("server_version") if idx is None else idx
+        if idx is None:
+            continue
+        for t in targets:
+            k = f"{t:g}"
+            if out[k] is None and float(acc) >= float(t):
+                out[k] = float(idx)
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+
+class TimelineRecorder:
+    """Bounded in-ring performance timeline + atomic on-disk segments."""
+
+    def __init__(self, out_dir: str, *, name: str = "server",
+                 capacity: int = 512, interval_s: float = 1.0,
+                 registry: Optional[obsreg.MetricsRegistry] = None,
+                 runtime=None, targets: Sequence[float] = _DEFAULT_TARGETS,
+                 meta: Optional[dict] = None):
+        self.out_dir = os.path.abspath(str(out_dir))
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.name = str(name)
+        self.capacity = max(8, int(capacity))
+        self.interval_s = max(0.01, float(interval_s))
+        self.registry = registry or obsreg.REGISTRY
+        self.runtime = runtime
+        self.targets = tuple(float(t) for t in targets)
+        self.meta = dict(meta or {})
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._rounds: deque = deque(maxlen=4096)
+        self._buckets: dict[str, list[float]] = {}
+        # flush a segment every capacity/2 samples: pending stays bounded
+        # and a full ring is always covered by at most two segments
+        self._flush_every = max(4, self.capacity // 2)
+        self._pending_samples: list[dict] = []
+        self._pending_rounds: list[dict] = []
+        self._crossed: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._started = False
+        self._closed = False
+
+    # -- timer-wheel lifecycle ------------------------------------------------
+    def start(self) -> "TimelineRecorder":
+        if self.runtime is None:
+            raise ValueError("TimelineRecorder.start needs a ServerRuntime")
+        self._started = True
+        self.runtime.arm(self, "timeline_tick", self.interval_s, self._tick)
+        return self
+
+    def _tick(self) -> None:
+        if self._closed:
+            return
+        try:
+            self.sample_now()
+        except Exception:
+            log.exception("timeline: sample tick failed")
+        if not self._closed:
+            self.runtime.arm(self, "timeline_tick", self.interval_s, self._tick)
+
+    # -- intake ---------------------------------------------------------------
+    def sample_now(self, now: Optional[float] = None) -> dict:
+        """Take one registry snapshot into the ring (public so tests and
+        harnesses can drive the recorder without a timer; ``now`` pins the
+        sample timestamp for deterministic fixtures); returns the sample.
+        Flushes a segment when enough samples are pending."""
+        scalars, hists, buckets = _split_snapshot(self.registry.snapshot())
+        sample = {"ts": round(float(now) if now is not None else time.time(), 6),
+                  "scalars": scalars, "hists": hists}
+        flush = False
+        with self._lock:
+            self._buckets.update(buckets)
+            self._ring.append(sample)
+            self._pending_samples.append(sample)
+            flush = len(self._pending_samples) >= self._flush_every
+        TIMELINE_SAMPLES.inc()
+        if flush:
+            self.flush()
+        return sample
+
+    def note_round(self, *, round_idx: Optional[int] = None,
+                   server_version: Optional[int] = None,
+                   test_acc: Optional[float] = None,
+                   wall: Optional[float] = None) -> None:
+        """Tee one finished round into the convergence series.  Never
+        raises into the server's round path."""
+        try:
+            row = {"wall": round(float(wall if wall is not None else time.time()), 6)}
+            if round_idx is not None:
+                row["round_idx"] = int(round_idx)
+            if server_version is not None:
+                row["server_version"] = int(server_version)
+            if test_acc is not None:
+                row["test_acc"] = float(test_acc)
+            with self._lock:
+                self._rounds.append(row)
+                self._pending_rounds.append(row)
+            idx = row.get("round_idx", row.get("server_version"))
+            if idx is not None:
+                CONV_ROUND.set(float(idx))
+            if test_acc is not None:
+                CONV_TEST_ACC.set(float(test_acc))
+                if idx is not None:
+                    for t in self.targets:
+                        k = f"{t:g}"
+                        if k not in self._crossed and float(test_acc) >= t:
+                            self._crossed[k] = float(idx)
+                            ROUNDS_TO_TARGET.set(float(idx), target=k)
+        except Exception:
+            log.exception("timeline: note_round failed")
+
+    # -- queries (delegate to the pure functions over the live ring) ----------
+    def samples(self, start_ts: Optional[float] = None,
+                end_ts: Optional[float] = None) -> list[dict]:
+        with self._lock:
+            ring = list(self._ring)
+        return range_scan(ring, start_ts, end_ts)
+
+    def rounds(self) -> list[dict]:
+        with self._lock:
+            return list(self._rounds)
+
+    def latest(self, key: str) -> Optional[float]:
+        with self._lock:
+            ring = list(self._ring)
+        for s in reversed(ring):
+            if key in s.get("scalars", {}):
+                return float(s["scalars"][key])
+        return None
+
+    def rate(self, key: str, window_s: Optional[float] = None,
+             now: Optional[float] = None) -> Optional[float]:
+        return windowed_rate(self.samples(), key, window_s, now)
+
+    def pnn(self, key: str, q: float, window_s: Optional[float] = None,
+            now: Optional[float] = None) -> Optional[float]:
+        with self._lock:
+            buckets = list(self._buckets.get(_family_of(key), ()))
+        return hist_pnn(self.samples(), key, q, buckets, window_s, now)
+
+    def crossed_targets(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._crossed)
+
+    # -- segments -------------------------------------------------------------
+    def flush(self) -> Optional[str]:
+        """Write every pending sample/round as one atomic segment file;
+        returns its path (``None`` when nothing is pending)."""
+        with self._lock:
+            samples, self._pending_samples = self._pending_samples, []
+            rounds, self._pending_rounds = self._pending_rounds, []
+            buckets = {k: list(v) for k, v in self._buckets.items()}
+            self._seq += 1
+            seq = self._seq
+        if not samples and not rounds:
+            with self._lock:
+                self._seq -= 1
+            return None
+        body = {"samples": samples, "rounds": rounds, "buckets": buckets,
+                "recorder": dict(self.meta)}
+        meta = {
+            "format": "fedml-timeline-v1",
+            "name": self.name,
+            "pid": os.getpid(),
+            "seq": seq,
+            "ts": round(time.time(), 6),
+            "n_samples": len(samples),
+            "n_rounds": len(rounds),
+        }
+        payload = json.dumps(body, sort_keys=True, default=str).encode()
+        blob = _MAGIC + json.dumps(meta, sort_keys=True).encode() + b"\n" + payload
+        fname = f"{self.name}.{os.getpid()}.{seq:06d}.tseg"
+        fname = "".join(c if c.isalnum() or c in "._-" else "_" for c in fname)
+        path = os.path.join(self.out_dir, fname)
+        fd, tmp = tempfile.mkstemp(dir=self.out_dir, prefix=".tmp_", suffix=".tseg")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic: readers see a complete segment or none
+        except OSError:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            raise
+        TIMELINE_SEGMENTS.inc()
+        return path
+
+    def close(self) -> None:
+        """Final sample + flush, then release the timer.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            # one end-of-run sample: even a run shorter than one tick
+            # interval leaves a queryable timeline behind
+            scalars, hists, buckets = _split_snapshot(self.registry.snapshot())
+            sample = {"ts": round(time.time(), 6), "scalars": scalars,
+                      "hists": hists}
+            with self._lock:
+                self._buckets.update(buckets)
+                self._ring.append(sample)
+                self._pending_samples.append(sample)
+            TIMELINE_SAMPLES.inc()
+        except Exception:
+            log.exception("timeline: final sample failed")
+        try:
+            self.flush()
+        except Exception:
+            log.exception("timeline: final flush failed")
+        if self._started and self.runtime is not None:
+            self.runtime.cancel(self)
+
+
+# ---------------------------------------------------------------------------
+# segment IO
+
+
+def read_segment(path: str) -> dict:
+    """Parse one ``.tseg`` segment -> ``{"meta": {...}, "samples": [...],
+    "rounds": [...], "buckets": {...}}``.  Raises ``ValueError`` on a
+    foreign or torn file (callers skip those)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if not blob.startswith(_MAGIC):
+        raise ValueError(f"{path}: not a timeline segment (bad magic)")
+    rest = blob[len(_MAGIC):]
+    nl = rest.find(b"\n")
+    if nl < 0:
+        raise ValueError(f"{path}: truncated header")
+    meta = json.loads(rest[:nl].decode())
+    body = json.loads(rest[nl + 1:].decode())
+    body["meta"] = meta
+    body["path"] = path
+    return body
+
+
+def list_segments(root: str) -> list[str]:
+    """Every ``.tseg`` file under ``root`` (recursive), sorted."""
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        out.extend(os.path.join(dirpath, f) for f in files
+                   if f.endswith(".tseg") and not f.startswith(".tmp_"))
+    return sorted(out)
+
+
+def load_timeline(root: str) -> dict:
+    """Merge every readable segment under ``root`` into one timeline:
+    samples sorted by ts, rounds sorted by wall, bucket maps unioned,
+    torn/foreign files skipped (and counted in ``skipped``)."""
+    samples: list[dict] = []
+    rounds: list[dict] = []
+    buckets: dict[str, list[float]] = {}
+    metas: list[dict] = []
+    skipped = 0
+    for path in list_segments(root):
+        try:
+            seg = read_segment(path)
+        except (ValueError, OSError, json.JSONDecodeError):
+            skipped += 1
+            continue
+        samples.extend(seg.get("samples", ()))
+        rounds.extend(seg.get("rounds", ()))
+        buckets.update(seg.get("buckets", {}))
+        metas.append(seg.get("meta", {}))
+    samples.sort(key=lambda s: float(s.get("ts", 0.0)))
+    rounds.sort(key=lambda r: float(r.get("wall", 0.0)))
+    return {"samples": samples, "rounds": rounds, "buckets": buckets,
+            "metas": metas, "skipped": skipped}
+
+
+def timeline_from_config(cfg, *, name: str, runtime=None,
+                         registry: Optional[obsreg.MetricsRegistry] = None,
+                         meta: Optional[dict] = None
+                         ) -> Optional[TimelineRecorder]:
+    """The one gate: ``extra.perf_timeline`` unset/falsy -> ``None`` (no
+    ring, no timer, no segments, bit-identical default path)."""
+    if cfg is None or not cfg_extra(cfg, "perf_timeline"):
+        return None
+    out_dir = cfg_extra(cfg, "timeline_dir") or os.path.join(
+        os.getcwd(), "perf_timeline")
+    try:
+        return TimelineRecorder(
+            str(out_dir), name=name,
+            capacity=int(cfg_extra(cfg, "timeline_capacity")),
+            interval_s=float(cfg_extra(cfg, "timeline_interval_s")),
+            registry=registry, runtime=runtime,
+            meta={"run_id": str(getattr(cfg, "run_id", "")), **(meta or {})})
+    except OSError as e:
+        log.warning("timeline: recorder dir %s unusable (%s) — running "
+                    "without the timeline", out_dir, e)
+        return None

@@ -109,6 +109,13 @@ def tenant_config(cfg, job_id: str, *, journal_root: Optional[str] = None,
     fd = cfg_extra(cfg, "flight_dir")
     if fd:
         overrides["flight_dir"] = os.path.join(str(fd), f"job_{jid}")
+    # performance timeline (ISSUE 18): same isolation stance — each
+    # tenant's segment files land under its own job dir (the samples
+    # themselves stay distinguishable anyway via the job label the
+    # ScopedRegistry stamps on every series)
+    td = cfg_extra(cfg, "timeline_dir")
+    if td:
+        overrides["timeline_dir"] = os.path.join(str(td), f"job_{jid}")
     shared_aot = aot_dir or cfg_extra(cfg, "mt_shared_aot_dir")
     if shared_aot:
         overrides["aot_programs"] = True

@@ -160,6 +160,13 @@ class MeshSimulator(RoundCheckpointMixin):
         # zero extra work on any hot path.
         self._cost_gauges = bool(cfg_extra(cfg, "cost_model_gauges"))
         self._chunk_flops: dict = {}
+        # per-program device-time attribution (ISSUE 18, obs/profiler.py):
+        # a programmatic trace window around rounds k..k+n behind
+        # extra.profile_rounds.  Flag unset -> None, no trace, no window.
+        from ..obs import profiler as obsprofiler
+
+        self.profiler = obsprofiler.profiler_from_config(
+            cfg, name="sim", peak_flops=_device_peak_flops() or None)
 
         # ---- data: pad + stack, shard over the clients axis ----
         stacked = stack_clients(dataset, multiple_of=cfg.batch_size)
@@ -701,6 +708,8 @@ class MeshSimulator(RoundCheckpointMixin):
             jnp.int32(self.round_idx), self.root_key, self.defense_history,
         )
         fn = self._get_multi_round_fn(n, example_args=args)
+        if self.profiler is not None:
+            self.profiler.maybe_start(self.round_idx)
         t0 = time.perf_counter()
         try:
             with traced("sim.chunk", rounds=n, start_round=self.round_idx,
@@ -708,6 +717,8 @@ class MeshSimulator(RoundCheckpointMixin):
                 gv, ss, cs, nd, stacked = fn(*args)
                 host = jax.device_get(stacked)  # the single host sync for the chunk
         except Exception as e:
+            if self.profiler is not None:
+                self.profiler.finalize()  # keep the trace of the failing chunk
             raise RuntimeError(
                 f"scanned chunk of {n} rounds failed at round {self.round_idx}; "
                 "carried state was donated and is no longer valid — resume from "
@@ -715,6 +726,10 @@ class MeshSimulator(RoundCheckpointMixin):
             ) from e
         execute_s = time.perf_counter() - t0
         CHUNK_EXECUTE_TIME.observe(execute_s)
+        if self.profiler is not None:
+            self.profiler.note_program(f"sim.multi_round.{n}",
+                                       flops=self._chunk_flops.get(n), rounds=n)
+            self.profiler.maybe_stop(self.round_idx + n)
         if self._cost_gauges and self._chunk_flops.get(n):
             achieved = self._chunk_flops[n] / max(execute_s, 1e-9)
             ACHIEVED_FLOPS.set(achieved)
@@ -889,6 +904,10 @@ class MeshSimulator(RoundCheckpointMixin):
             scores = self.assess_contribution()
             if scores is not None:
                 self.logger.log({f"contribution_c{i}": float(s) for i, s in enumerate(scores)})
+        if self.profiler is not None:
+            # a window still open at fit end (profile_rounds past comm_round)
+            # closes and attributes here rather than losing the trace
+            self.profiler.finalize()
         if self._otlp is not None:
             # end-of-fit egress: drain queued spans and ship the registry
             # snapshot; flush (not close) so a caller running fit again on

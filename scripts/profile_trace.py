@@ -6,13 +6,14 @@ device events by hlo_category and by source line, reporting achieved TFLOP/s
 and GB/s per bucket — the evidence base for PERF.md's roofline ("what is the
 round actually spending its time and bandwidth on").
 
+The parsing/aggregation lives in ``fedml_tpu.obs.profiler`` since ISSUE 18
+(the engine opens its own trace windows behind ``extra.profile_rounds``);
+this script remains the manual one-round harness over that library.
+
 Usage: python scripts/profile_trace.py   (on the TPU; writes /tmp/prof)
        PROFILE_FUSED=1 python scripts/profile_trace.py   (trace the
        extra.fused_blocks program — the PERF.md round-6 attribution path)
 """
-import collections
-import glob
-import gzip
 import json
 import os
 import sys
@@ -21,6 +22,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+
+from fedml_tpu.obs.profiler import (
+    aggregate_device_events,
+    bucket_rows,
+    find_trace_file,
+    load_trace,
+)
 
 
 def build_sim():
@@ -58,47 +66,16 @@ def main():
     with jax.profiler.trace("/tmp/prof"):
         run()
 
-    latest = max(glob.glob("/tmp/prof/plugins/profile/*/"), key=os.path.getmtime)
-    trace_file = glob.glob(os.path.join(latest, "*.trace.json.gz"))[0]
-    with gzip.open(trace_file) as f:
-        tr = json.load(f)
-
-    pids = {e["pid"]: e["args"].get("name", "")
-            for e in tr.get("traceEvents", [])
-            if e.get("ph") == "M" and e.get("name") == "process_name"}
-    dev_pids = {p for p, n in pids.items() if "TPU" in n or "device" in n.lower()}
-
-    cat = collections.defaultdict(lambda: [0, 0, 0, 0])   # ps, flops, bytes, n
-    src = collections.defaultdict(lambda: [0, 0, 0, 0])
-    for e in tr.get("traceEvents", []):
-        a = e.get("args") or {}
-        if e.get("ph") == "X" and e.get("pid") in dev_pids and "hlo_category" in a:
-            c = a["hlo_category"]
-            if c == "while":
-                continue
-            d = int(a.get("device_duration_ps", 0))
-            fl = int(a.get("model_flops", 0) or 0)
-            by = int(a.get("raw_bytes_accessed", 0) or 0)
-            for bucket, key in ((cat, c), (src, a.get("source", "?"))):
-                bucket[key][0] += d
-                bucket[key][1] += fl
-                bucket[key][2] += by
-                bucket[key][3] += 1
-
-    def rows(bucket, top):
-        out = []
-        for k, (d, fl, by, n) in sorted(bucket.items(), key=lambda kv: -kv[1][0])[:top]:
-            out.append({
-                "key": k, "ms": round(d / 1e9, 2), "n": n,
-                "tflops": round(fl / (d / 1e12) / 1e12, 2) if d else 0,
-                "gbps": round(by / (d / 1e12) / 1e9, 1) if d else 0,
-            })
-        return out
+    trace_file = find_trace_file("/tmp/prof")
+    if trace_file is None:
+        raise SystemExit("no trace captured under /tmp/prof")
+    aggregated = aggregate_device_events(load_trace(trace_file))
+    cat = aggregated["by_category"]
 
     print("TRACE " + json.dumps({
         "total_ms": round(sum(v[0] for v in cat.values()) / 1e9, 1),
-        "by_category": rows(cat, 8),
-        "by_source": rows(src, 12),
+        "by_category": bucket_rows(cat, 8),
+        "by_source": bucket_rows(aggregated["by_source"], 12),
     }))
 
 

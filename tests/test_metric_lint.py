@@ -35,8 +35,10 @@ INSTRUMENTED_MODULES = [
     "fedml_tpu.obs.flight",
     "fedml_tpu.obs.health",
     "fedml_tpu.obs.otlp",
+    "fedml_tpu.obs.profiler",
     "fedml_tpu.obs.remote",
     "fedml_tpu.obs.slo",
+    "fedml_tpu.obs.timeline",
     "fedml_tpu.ops.pallas.timing",
     "fedml_tpu.population.cohorts",
     "fedml_tpu.population.store",
