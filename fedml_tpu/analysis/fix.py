@@ -19,9 +19,17 @@ read just becomes registry-checked.
 item): the read half is exactly ``cfg_extra`` with the same default, and
 the dict-seeding side effect is what the registry replaces — every other
 registry-backed read supplies its own declared default, so the seed is
-dead weight.  A *statement*-position ``extra.setdefault(...)`` exists ONLY
-for that side effect (someone downstream reads the dict raw), so it is
-still reported for manual migration rather than silently deleted.
+dead weight.  A *statement*-position ``extra.setdefault(k, v)`` exists ONLY
+for that side effect (someone downstream reads the dict raw); it is
+rewritten to an EXPLICIT seed assignment through the registry-checked
+read::
+
+    cfg.extra.setdefault("k", 3)   ->   cfg.extra['k'] = cfg_extra(cfg, 'k', 3)
+
+which preserves the seeded dict for every raw downstream reader (present
+key keeps its value via the ``cfg_extra`` resolution order, missing key
+lands the same default) while the flag name becomes declared and
+GL001-checked.
 
 Value-position ``extra["k"]`` subscript READS are rewritten to
 ``cfg_extra(cfg, 'k', None)`` (ISSUE 12 satellite).  This is the one rewrite
@@ -33,10 +41,10 @@ GL001-checked read.  Set keys behave identically (proven by test).
 Statement-position subscripts, Store/Del/augmented targets, and write sites
 are left alone.
 
-Sites the fixer cannot prove out — statement-position ``setdefault`` and
-subscripts, ``in`` membership tests, non-literal flag names, and receivers
-whose owning config expression cannot be recovered — are reported for
-manual migration, never guessed at.
+Sites the fixer cannot prove out — statement-position subscripts, ``in``
+membership tests, non-literal flag names, and receivers whose owning
+config expression cannot be recovered — are reported for manual
+migration, never guessed at.
 
 ``fix_source`` loops to a fixpoint (a ``.get`` nested inside another's
 default argument is rewritten on the next pass), which is also what makes
@@ -160,9 +168,27 @@ def _one_pass(source: str, relpath: str,
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
                 and node.args and _is_extra_expr(node.func.value, extra_vars):
             if node.func.attr == "setdefault" and id(node) in stmt_position:
-                skip(node, "statement-position extra.setdefault(...) exists only "
-                           "to seed the dict for a raw downstream read — "
-                           "migrate that read to cfg_extra by hand")
+                # statement-position seed: rewrite to an explicit assignment
+                # through the registry-checked read — the seeded dict stays
+                # seeded for raw downstream readers, the name becomes a
+                # declared GL001-checked flag
+                name = str_const(node.args[0])
+                cfg_src = _cfg_expr_of(node.func.value, assigned)
+                if (name is None or cfg_src is None
+                        or len(node.args) > 2 or node.keywords):
+                    skip(node, "statement-position extra.setdefault(...) with a "
+                               "non-literal name / unrecoverable config / odd "
+                               "call shape — migrate by hand")
+                    continue
+                recv = node.func.value
+                recv_src = ast.unparse(recv)
+                if not isinstance(recv, (ast.Name, ast.Attribute)):
+                    recv_src = f"({recv_src})"  # keep the target parseable
+                default_src = (ast.unparse(node.args[1])
+                               if len(node.args) == 2 else "None")
+                candidates.append((_span(node, offsets),
+                                   f"{recv_src}[{name!r}] = "
+                                   f"cfg_extra({cfg_src}, {name!r}, {default_src})"))
                 continue
             if node.func.attr not in ("get", "setdefault"):
                 continue

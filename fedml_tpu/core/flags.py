@@ -431,6 +431,31 @@ FLAGS: dict[str, FlagSpec] = _specs(
              "plane: jobs with the same tracing fingerprint deserialize "
              "each other's exported round/eval programs instead of "
              "recompiling (unset = per-config aot_programs_dir semantics)."),
+    FlagSpec("mt_submesh_shape", "str", None,
+             "Per-job submesh shape ('clients:2' / 'silo:1,data:2') the "
+             "control plane carves out of the fleet's device array: each "
+             "admitted job leases a DISJOINT contiguous submesh and its "
+             "rounds run genuinely concurrently with its siblings' instead "
+             "of time-slicing the full mesh; unset (or shapes that do not "
+             "tile the fleet — see mt_submesh_jobs) = PR-14 time-sliced "
+             "gate semantics, bit-identical."),
+    FlagSpec("mt_submesh_jobs", "int", None,
+             "Number of disjoint submeshes to carve (the fleet partition "
+             "degree): mt_submesh_shape x mt_submesh_jobs device totals "
+             "must fit in the fleet or the plan is rejected and the "
+             "scheduler falls back to the time-sliced gate; derived: "
+             "fleet size // submesh size."),
+    FlagSpec("mt_quota_burst", "float", 0.0,
+             "Token-bucket admission quota per tenant, in grants: a job "
+             "spends one token per granted round and the bucket refills at "
+             "1/mt_quota_refill_s tokens per second up to this burst cap, "
+             "so one tenant cannot starve the fleet between round "
+             "boundaries no matter its weight; 0 = quota disabled "
+             "(fair-share only, bit-identical to before the flag existed)."),
+    FlagSpec("mt_quota_refill_s", "float", 1.0,
+             "Seconds to refill ONE admission token of the mt_quota_burst "
+             "bucket (the steady-state grant period a quota-capped tenant "
+             "converges to)."),
     # -- serving -------------------------------------------------------------
     FlagSpec("model_publish_dir", "str", None,
              "Continuous model publication directory: the cross-silo servers "
@@ -447,6 +472,19 @@ FLAGS: dict[str, FlagSpec] = _specs(
     FlagSpec("serving_model_name", "str", None,
              "Model card name for deploy; derived: cfg.model."),
     FlagSpec("model_version", "str", "v1", "Model card version for deploy."),
+    FlagSpec("gateway_port", "int", 0,
+             "Tenant-routed serving gateway listen port (0 = ephemeral): "
+             "one HTTP front door for a shared worker fleet, routing each "
+             "request's tenant id to the worker bound to that tenant's "
+             "model_publish_dir."),
+    FlagSpec("gateway_max_batch", "int", 8,
+             "Gateway-side coalescing batch cap per tenant: requests for "
+             "the same tenant are batched at the gateway before the "
+             "worker's own micro-batcher sees them."),
+    FlagSpec("gateway_flush_ms", "float", 2.0,
+             "Gateway batching window per tenant in milliseconds — how "
+             "long an under-filled tenant batch waits for co-tenants' "
+             "rows before flushing to the worker."),
 )
 
 

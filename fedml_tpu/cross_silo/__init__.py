@@ -29,7 +29,8 @@ def _client_shard(dataset, client_idx: int):
     return dataset.train_x[ix], dataset.train_y[ix]
 
 
-def build_aggregator(cfg, dataset, model, trust=None) -> FedMLAggregator:
+def build_aggregator(cfg, dataset, model, trust=None,
+                     mesh=None) -> FedMLAggregator:
     eval_bs = min(256, max(32, cfg.test_batch_size))
     test_arrays = pad_eval_set(dataset.test_x, dataset.test_y, eval_bs)
     sample_x = dataset.train_x[: cfg.batch_size]
@@ -37,16 +38,20 @@ def build_aggregator(cfg, dataset, model, trust=None) -> FedMLAggregator:
         from ..trust.pipeline import build_trust_pipeline
 
         trust = build_trust_pipeline(cfg)
-    return FedMLAggregator(cfg, model, sample_x, test_arrays, trust=trust)
+    return FedMLAggregator(cfg, model, sample_x, test_arrays, trust=trust,
+                           mesh=mesh)
 
 
 def build_server(cfg, dataset, model, backend: Optional[str] = None, trust=None,
-                 runtime=None) -> FedMLServerManager:
+                 runtime=None, mesh=None) -> FedMLServerManager:
     """``runtime`` (cross_silo/runtime.py ServerRuntime): the multi-tenant
     control plane passes its shared timer-wheel/dispatch loop so N tenant
     servers ride one thread; None = the manager owns its own (the
-    single-job default, semantics unchanged)."""
-    aggregator = build_aggregator(cfg, dataset, model, trust=trust)
+    single-job default, semantics unchanged).  ``mesh``: an externally
+    supplied mesh — under the device-slot scheduler, the job's submesh
+    LEASE — the aggregator's sharded fold resolves against; None = the
+    full default mesh, unchanged."""
+    aggregator = build_aggregator(cfg, dataset, model, trust=trust, mesh=mesh)
     if cfg_extra(cfg, "async_aggregation"):
         # buffered-async (FedBuff-style) server: clients upload whenever
         # ready, arrivals fold with staleness-decayed weights, a virtual

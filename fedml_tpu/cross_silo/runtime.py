@@ -88,6 +88,24 @@ PREEMPTIONS = obsreg.REGISTRY.counter(
     "job that was passed over.",
     labels=("job",),
 )
+FLEET_SUBMESHES = obsreg.REGISTRY.gauge(
+    "fedml_fleet_submeshes",
+    "Disjoint per-job submeshes the device-slot scheduler arbitrates (0 = "
+    "no SubmeshPlan; time-sliced full-mesh gate).",
+)
+LEASE_GRANTS = obsreg.REGISTRY.counter(
+    "fedml_fleet_lease_grants_total",
+    "Submesh-lease grants issued by the device-slot scheduler, by job — a "
+    "grant binds the job's round to its leased devices, not the full mesh.",
+    labels=("job",),
+)
+QUOTA_THROTTLED = obsreg.REGISTRY.counter(
+    "fedml_fleet_quota_throttled_total",
+    "Admissions deferred because the tenant's token-bucket quota "
+    "(extra.mt_quota_burst) was empty, by job; the job resumes when the "
+    "bucket refills — throttled, never starved.",
+    labels=("job",),
+)
 
 
 class ServerRuntime:
@@ -221,7 +239,7 @@ class ServerRuntime:
 
 
 class GangScheduler:
-    """Round-boundary mesh-slot arbiter for N concurrent FL jobs.
+    """Round-boundary device-slot arbiter for N concurrent FL jobs.
 
     Jobs (server managers) call :meth:`request` when ready to start a
     (virtual) round and :meth:`release` when the round's aggregate commits.
@@ -232,13 +250,39 @@ class GangScheduler:
     ones at equal weights).  Grant callbacks are posted to the runtime's
     dispatch loop, never run under this scheduler's lock or the caller's.
 
+    Two admission layers sit on top of fair share (ISSUE 19), both off by
+    default and bit-identical when off:
+
+    - **submesh leases**: constructed with a ``SubmeshPlan``, a grant is a
+      lease of the job's HOME submesh (static — its compiled programs bind
+      to those devices), ``slots`` equals the partition degree, and jobs on
+      distinct leases run genuinely concurrently; :meth:`lease_of` exposes
+      the Mesh so callers build their shardings against the lease.
+    - **token-bucket quota** (``quota_burst`` grants, one token refilled
+      every ``quota_refill_s`` seconds): caps one tenant's admission rate
+      between round boundaries regardless of weight.  A quota-blocked job
+      stays pending and a refill timer re-pumps at the earliest token
+      arrival — throttled, never starved.
+
     Thread model (GL008-audited): all state below is guarded by ``_lock``;
     grant callbacks are collected under the lock and posted outside it.
     """
 
-    def __init__(self, runtime: ServerRuntime, slots: int = 1):
+    def __init__(self, runtime: ServerRuntime, slots: int = 1,
+                 plan=None, quota_burst: float = 0.0,
+                 quota_refill_s: float = 1.0):
         self.runtime = runtime
-        self.slots = max(1, int(slots))
+        #: optional parallel.mesh.SubmeshPlan — present, a grant is a
+        #: SUBMESH LEASE (the job's round runs on its leased devices while
+        #: siblings run on theirs) and ``slots`` is the partition degree;
+        #: absent, grants are time-sliced full-mesh round tokens (PR-14
+        #: semantics, bit-identical)
+        self.plan = plan
+        self.slots = len(plan) if plan is not None else max(1, int(slots))
+        #: token-bucket admission quota (extra.mt_quota_burst /
+        #: mt_quota_refill_s); burst <= 0 disables the bucket entirely
+        self.quota_burst = float(quota_burst or 0.0)
+        self.quota_refill_s = max(1e-6, float(quota_refill_s or 1.0))
         self._lock = threading.Lock()
         self._names: dict[int, str] = {}
         self._weights: dict[int, float] = {}
@@ -248,26 +292,52 @@ class GangScheduler:
         self._pending: dict[int, tuple[Callable, float, int]] = {}
         #: job-id -> grant monotonic of the held slot
         self._holders: dict[int, float] = {}
+        #: job-id -> home lease index (static: a job's compiled programs
+        #: bind to its lease's devices, so the lease never migrates)
+        self._home_lease: dict[int, int] = {}
+        self._lease_busy: set[int] = set()
+        self._next_lease = 0
+        #: job-id -> tokens / last-refill monotonic (lazy refill)
+        self._tokens: dict[int, float] = {}
+        self._tokens_at: dict[int, float] = {}
+        self._throttled: set[int] = set()
         self._arrival = itertools.count()
         #: per-job accounting the bench/tests read: grants, waits, holds,
         #: times this job was passed over by a higher-priority grant
         self.stats: dict[str, dict] = {}
+        FLEET_SUBMESHES.set(len(plan) if plan is not None else 0)
 
     def register(self, job: object, name: str, weight: float = 1.0,
-                 priority: int = 0) -> None:
+                 priority: int = 0, lease_index: Optional[int] = None) -> None:
         with self._lock:
             jid = id(job)
             self._names[jid] = str(name)
             self._weights[jid] = max(1e-6, float(weight))
             self._priority[jid] = int(priority)
+            if self.plan is not None:
+                if lease_index is None:
+                    lease_index = self._next_lease
+                self._home_lease[jid] = int(lease_index) % len(self.plan)
+                self._next_lease += 1
             # WFQ catch-up: a late-admitted job starts at the busiest
             # sibling's virtual time instead of replaying the past
             floor = max(self._vtime.values(), default=0.0)
             self._vtime[jid] = max(self._vtime.get(jid, 0.0), floor)
             self.stats.setdefault(self._names[jid], {
-                "grants": 0, "preempted": 0, "wait_s": [], "hold_s": [],
+                "grants": 0, "preempted": 0, "throttled": 0,
+                "wait_s": [], "hold_s": [],
                 "weight": self._weights[jid], "priority": self._priority[jid],
             })
+
+    def lease_of(self, job: object):
+        """The submesh leased to ``job`` (None without a SubmeshPlan).
+        Stable across grants: servers resolve their NamedShardings and AOT
+        fingerprints against this once, at build time."""
+        if self.plan is None:
+            return None
+        with self._lock:
+            idx = self._home_lease.get(id(job))
+        return None if idx is None else self.plan.lease(idx)
 
     def request(self, job: object, grant_cb: Callable) -> None:
         """Queue ``job`` for the next slot; idempotent per job (a re-request
@@ -288,16 +358,19 @@ class GangScheduler:
         self._pump()
 
     def release(self, job: object) -> None:
-        """Release ``job``'s held slot (no-op when it holds none) and charge
-        the measured hold time to its virtual clock."""
+        """Release ``job``'s held slot/lease (no-op when it holds none) and
+        charge the measured hold time to its virtual clock."""
         with self._lock:
             jid = id(job)
             t0 = self._holders.pop(jid, None)
             if t0 is not None:
                 hold = time.monotonic() - t0
                 self._vtime[jid] = self._vtime.get(jid, 0.0) + hold / self._weights.get(jid, 1.0)
+                if self.plan is not None:
+                    self._lease_busy.discard(self._home_lease.get(jid, -1))
                 name = self._names.get(jid, "?")
                 rec = self.stats.setdefault(name, {"grants": 0, "preempted": 0,
+                                                   "throttled": 0,
                                                    "wait_s": [], "hold_s": []})
                 rec["hold_s"].append(hold)
                 SLOT_HOLD.observe(hold, job=name)
@@ -307,24 +380,76 @@ class GangScheduler:
         self._names[jid] = name
         self._weights[jid] = 1.0
         self._priority[jid] = 0
+        if self.plan is not None and jid not in self._home_lease:
+            self._home_lease[jid] = self._next_lease % len(self.plan)
+            self._next_lease += 1
         self._vtime[jid] = max(self._vtime.values(), default=0.0)
         self.stats.setdefault(name, {"grants": 0, "preempted": 0,
+                                     "throttled": 0,
                                      "wait_s": [], "hold_s": []})
 
+    def _refill_locked(self, jid: int, now: float) -> None:  # graftlint: disable=GL004(caller holds _lock: _eligible_locked's lazy refill)
+        last = self._tokens_at.get(jid)
+        if last is None:
+            self._tokens[jid] = self.quota_burst  # a new tenant starts full
+        else:
+            self._tokens[jid] = min(
+                self.quota_burst,
+                self._tokens.get(jid, self.quota_burst)
+                + (now - last) / self.quota_refill_s)
+        self._tokens_at[jid] = now
+
+    def _eligible_locked(self, jid: int, now: float) -> bool:  # graftlint: disable=GL004(caller holds _lock: _pump's admission filter)
+        """Quota + lease admission filter; a quota-blocked job is metered
+        as throttled ONCE per blocked wait (not once per pump pass)."""
+        if self.quota_burst > 0:
+            self._refill_locked(jid, now)
+            if self._tokens.get(jid, 0.0) < 1.0:
+                if jid not in self._throttled:
+                    self._throttled.add(jid)
+                    name = self._names.get(jid, "?")
+                    rec = self.stats.setdefault(
+                        name, {"grants": 0, "preempted": 0, "throttled": 0,
+                               "wait_s": [], "hold_s": []})
+                    rec["throttled"] = rec.get("throttled", 0) + 1
+                    QUOTA_THROTTLED.inc(job=name)
+                return False
+        if self.plan is not None:
+            if self._home_lease.get(jid, 0) in self._lease_busy:
+                return False
+        return True
+
     def _pump(self) -> None:
-        """Grant free slots; callbacks post to the runtime OUTSIDE the lock
-        (a grant callback takes its server's _agg_lock — posting under
-        _lock would build the scheduler-lock -> agg-lock edge this design
-        exists to avoid)."""
+        """Grant free slots/leases; callbacks post to the runtime OUTSIDE
+        the lock (a grant callback takes its server's _agg_lock — posting
+        under _lock would build the scheduler-lock -> agg-lock edge this
+        design exists to avoid).  When every pending job is quota-blocked,
+        a refill timer re-pumps at the earliest token arrival — throttled
+        tenants resume, they never starve."""
         grants: list[Callable] = []
+        refill_delay = None
         with self._lock:
             while self._pending and len(self._holders) < self.slots:
-                chosen = self._pick_locked()
+                now = time.monotonic()
+                eligible = [j for j in self._pending
+                            if self._eligible_locked(j, now)]
+                if not eligible:
+                    if self.quota_burst > 0 and self._pending:
+                        refill_delay = self._earliest_refill_locked()
+                    break
+                chosen = self._pick_locked(eligible)
                 cb, enq, _seq = self._pending.pop(chosen)
                 now = time.monotonic()
                 self._holders[chosen] = now
+                if self.quota_burst > 0:
+                    self._tokens[chosen] = self._tokens.get(chosen, self.quota_burst) - 1.0
+                    self._throttled.discard(chosen)
                 name = self._names.get(chosen, "?")
+                if self.plan is not None:
+                    self._lease_busy.add(self._home_lease.get(chosen, 0))
+                    LEASE_GRANTS.inc(job=name)
                 rec = self.stats.setdefault(name, {"grants": 0, "preempted": 0,
+                                                   "throttled": 0,
                                                    "wait_s": [], "hold_s": []})
                 rec["grants"] += 1
                 rec["wait_s"].append(now - enq)
@@ -333,20 +458,29 @@ class GangScheduler:
                 grants.append(cb)
         for cb in grants:
             self.runtime.post(cb)
+        if refill_delay is not None:
+            self.runtime.arm(self, "quota_refill", refill_delay, self._pump)
 
-    def _pick_locked(self) -> int:  # graftlint: disable=GL004(caller holds _lock: _pump's selection step)
+    def _earliest_refill_locked(self) -> float:  # graftlint: disable=GL004(caller holds _lock: _pump's backoff computation)
+        deficits = [max(0.0, 1.0 - self._tokens.get(j, 0.0))
+                    for j in self._pending]
+        return max(0.001, min(deficits, default=1.0) * self.quota_refill_s)
+
+    def _pick_locked(self, candidates) -> int:  # graftlint: disable=GL004(caller holds _lock: _pump's selection step)
         """Highest priority class, then lowest virtual time, then arrival
-        order.  When priority overrides fair share, the passed-over job's
-        preemption counter ticks — the boundary-preemption meter."""
+        order, over the quota/lease-eligible candidates.  When priority
+        overrides fair share, the passed-over job's preemption counter
+        ticks — the boundary-preemption meter."""
         def fair_key(jid: int):
             return (self._vtime.get(jid, 0.0), self._pending[jid][2])
 
-        fair = min(self._pending, key=fair_key)
-        chosen = min(self._pending,
+        fair = min(candidates, key=fair_key)
+        chosen = min(candidates,
                      key=lambda j: (-self._priority.get(j, 0),) + fair_key(j))
         if chosen != fair and self._priority.get(chosen, 0) > self._priority.get(fair, 0):
             name = self._names.get(fair, "?")
             self.stats.setdefault(name, {"grants": 0, "preempted": 0,
+                                         "throttled": 0,
                                          "wait_s": [], "hold_s": []})
             self.stats[name]["preempted"] += 1
             PREEMPTIONS.inc(job=name)
@@ -365,6 +499,7 @@ class GangScheduler:
                 out[name] = {
                     "grants": rec["grants"],
                     "preempted": rec["preempted"],
+                    "throttled": rec.get("throttled", 0),
                     "weight": rec.get("weight", 1.0),
                     "priority": rec.get("priority", 0),
                     "hold_p50_s": round(float(np.percentile(holds, 50)), 6) if holds else None,

@@ -122,6 +122,7 @@ class FedMLAggregator:
     # inherit the safe exact-mode behavior
     stream_mode = False
     _shard_fold = False
+    _mesh = None
     _np_global = None
     _stream_tmpl = None
     _stream_acc = None
@@ -130,9 +131,14 @@ class FedMLAggregator:
     _stream_folded = 0
     peak_buffered_updates = 0
 
-    def __init__(self, cfg, model, sample_x, test_arrays, trust=None):
+    def __init__(self, cfg, model, sample_x, test_arrays, trust=None,
+                 mesh=None):
         self.cfg = cfg
         self._model = model
+        # externally supplied mesh (a submesh LEASE under the device-slot
+        # scheduler): the sharded stream fold resolves its NamedShardings
+        # against it instead of the full default mesh; None = unchanged
+        self._mesh = mesh
         # _calibrate_schedule replaces the guess with protocol truth at
         # first aggregation
         self.hp = hparams_from_config(cfg, steps_per_epoch=provisional_steps_per_epoch(cfg))
@@ -276,7 +282,7 @@ class FedMLAggregator:
             from ..parallel.stream_fold import make_stream_accumulator
 
             self._stream_acc = make_stream_accumulator(
-                tmpl, sharded=self._shard_fold)
+                tmpl, sharded=self._shard_fold, mesh=self._mesh)
         # buffered right now: the accumulator + this in-flight decode (+ any
         # dense fallbacks) — the quantity the <=2 acceptance bound tracks
         self._note_buffered(inflight=1)
@@ -348,7 +354,7 @@ class FedMLAggregator:
             from ..parallel.stream_fold import make_stream_accumulator
 
             self._stream_acc = make_stream_accumulator(
-                tmpl, sharded=self._shard_fold)
+                tmpl, sharded=self._shard_fold, mesh=self._mesh)
         self._note_buffered(inflight=1)
         for i, _spec, arr in leaf_iter:
             self._stream_acc.fold_partial_leaf(i, arr)
@@ -523,7 +529,7 @@ class FedMLAggregator:
         from ..parallel.stream_fold import make_stream_accumulator
 
         self._stream_acc = make_stream_accumulator(
-            tmpl, sharded=self._shard_fold, sums=sums)
+            tmpl, sharded=self._shard_fold, mesh=self._mesh, sums=sums)
         self._stream_w = float(proto.get("stream_w", 0.0))
         self._stream_w_delta = float(proto.get("stream_w_delta", 0.0))
         self._stream_folded = int(proto.get("stream_folded", 0))

@@ -47,6 +47,7 @@ _REGISTERING_MODULES = (
     "fedml_tpu.population.store",
     "fedml_tpu.sched.multi_tenant",
     "fedml_tpu.serving.batcher",
+    "fedml_tpu.serving.gateway",
     "fedml_tpu.serving.publisher",
     "fedml_tpu.sim.engine",
 )
@@ -61,7 +62,9 @@ _SECTIONS = {
     "comm": "Communication layer",
     "convergence": "Convergence tracking",
     "crosssilo": "Cross-silo rounds",
+    "fleet": "Fleet partition (per-job submeshes)",
     "flight": "Flight recorder",
+    "gateway": "Tenant-routed serving gateway",
     "hier": "Hierarchical aggregation tree",
     "journal": "Server recovery journal",
     "mt": "Multi-tenant control plane",

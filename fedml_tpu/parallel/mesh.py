@@ -84,6 +84,105 @@ def mesh_from_config(cfg, devices=None) -> Mesh:
     return make_mesh((AXIS_CLIENTS,), None, devices)
 
 
+class SubmeshPlan:
+    """A partition of the fleet's device array into disjoint per-job Meshes.
+
+    Each lease is a contiguous slice of the device list reshaped to the SAME
+    axis names/sizes, so a job's NamedShardings, pjit server fold, and AOT
+    fingerprints (mesh shape is a fingerprint component) all resolve against
+    its lease exactly as they would against a dedicated fleet of that shape —
+    which is what makes submesh-vs-dedicated bitwise parity possible.
+    """
+
+    def __init__(self, submeshes: Sequence[Mesh], axis_names: Sequence[str],
+                 axis_sizes: Sequence[int]):
+        if not submeshes:
+            raise ValueError("SubmeshPlan needs at least one submesh")
+        self.submeshes = list(submeshes)
+        self.axis_names = tuple(axis_names)
+        self.axis_sizes = tuple(int(s) for s in axis_sizes)
+
+    def __len__(self) -> int:
+        return len(self.submeshes)
+
+    def lease(self, index: int) -> Mesh:
+        """The submesh of lease slot ``index`` (jobs hold a slot index, not
+        a Mesh — the scheduler maps grant -> lease through this)."""
+        return self.submeshes[index % len(self.submeshes)]
+
+    def describe(self) -> dict:
+        return {
+            "jobs": len(self.submeshes),
+            "shape": dict(zip(self.axis_names, self.axis_sizes)),
+            "devices_per_job": int(np.prod(self.axis_sizes)),
+        }
+
+
+def carve_submeshes(
+    axis_names: Sequence[str],
+    axis_sizes: Sequence[int],
+    n_jobs: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> SubmeshPlan:
+    """Carve ``n_jobs`` disjoint contiguous submeshes of shape
+    ``axis_names x axis_sizes`` out of the device list.
+
+    Raises ``ValueError`` when the shapes do not tile the fleet (per-job
+    size not concrete, or n_jobs x per-job devices exceeds the fleet) —
+    callers fall back to the time-sliced gate on that error.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    sizes = [int(s) for s in axis_sizes]
+    if any(s <= 0 for s in sizes):
+        raise ValueError(
+            f"submesh shape {dict(zip(axis_names, sizes))} must be concrete "
+            "(no -1 / zero axes) to tile the fleet")
+    per = int(np.prod(sizes))
+    n_jobs = int(n_jobs)
+    if n_jobs < 1:
+        raise ValueError(f"n_jobs must be >= 1, got {n_jobs}")
+    if per * n_jobs > len(devs):
+        raise ValueError(
+            f"{n_jobs} submeshes of {per} devices need {per * n_jobs}, "
+            f"fleet has {len(devs)}")
+    subs = []
+    for i in range(n_jobs):
+        chunk = devs[i * per:(i + 1) * per]
+        subs.append(Mesh(np.array(chunk).reshape(sizes), tuple(axis_names)))
+    return SubmeshPlan(subs, axis_names, sizes)
+
+
+def submesh_plan_from_config(cfg, devices=None) -> Optional[SubmeshPlan]:
+    """Build the fleet partition from ``extra.mt_submesh_shape`` /
+    ``mt_submesh_jobs``, or None (LOUDLY) when unset or the shapes do not
+    tile the fleet — None means the control plane keeps the PR-14
+    time-sliced gate, bit-identical."""
+    import logging
+
+    from ..core.flags import cfg_extra
+
+    spec = cfg_extra(cfg, "mt_submesh_shape")
+    if not spec:
+        return None
+    names, sizes = parse_mesh_shape(spec)
+    devs = list(devices if devices is not None else jax.devices())
+    n_jobs = cfg_extra(cfg, "mt_submesh_jobs")
+    try:
+        if n_jobs is None:
+            per = int(np.prod([s for s in sizes if s > 0]))
+            if any(s <= 0 for s in sizes) or per <= 0:
+                raise ValueError(
+                    f"submesh shape {spec!r} must be concrete to derive "
+                    "mt_submesh_jobs")
+            n_jobs = len(devs) // per
+        return carve_submeshes(names, sizes, n_jobs, devs)
+    except ValueError as e:
+        logging.getLogger("fedml_tpu.parallel.mesh").warning(
+            "mt_submesh_shape=%r rejected (%s); falling back to the "
+            "time-sliced round gate", spec, e)
+        return None
+
+
 def round_up(n: int, multiple: int) -> int:
     """Smallest multiple of ``multiple`` >= ``n`` (client-axis padding math)."""
     return -(-n // multiple) * multiple

@@ -142,6 +142,8 @@ class TenantJob:
         #: job-scoped registry view — every family registered through it
         #: carries job=<id>, so colliding names across tenants cannot bleed
         self.metrics = obsreg.REGISTRY.scoped(job=job_id)
+        #: the submesh leased to this job (None = full-mesh time slicing)
+        self.mesh = None
         self.fleet = None
         self._fleet_queue = None
         #: per-job AOT accounting delta captured at admit (shared-store
@@ -194,15 +196,32 @@ class MultiTenantControlPlane:
                  journal_root: Optional[str] = None,
                  aot_dir: Optional[str] = None,
                  runtime: Optional[ServerRuntime] = None,
-                 base_cfg=None):
-        self.slots = int(slots if slots is not None
-                         else cfg_extra(base_cfg, "mt_slots"))
+                 base_cfg=None, plan=None,
+                 quota_burst: Optional[float] = None,
+                 quota_refill_s: Optional[float] = None):
+        #: optional SubmeshPlan (parallel/mesh.py): present — explicitly or
+        #: via base_cfg's mt_submesh_shape/mt_submesh_jobs — each admitted
+        #: job leases ONE disjoint submesh and rounds run genuinely
+        #: concurrently; absent/rejected = the PR-14 time-sliced gate
+        if plan is None and base_cfg is not None:
+            from ..parallel.mesh import submesh_plan_from_config
+
+            plan = submesh_plan_from_config(base_cfg)
+        self.plan = plan
+        self.slots = (len(plan) if plan is not None
+                      else int(slots if slots is not None
+                               else cfg_extra(base_cfg, "mt_slots")))
         self.journal_root = journal_root
         self.aot_dir = aot_dir or cfg_extra(base_cfg, "mt_shared_aot_dir")
         self.runtime = runtime if runtime is not None else ServerRuntime(
             name="fedml-mt-runtime")
         self._owns_runtime = runtime is None
-        self.scheduler = GangScheduler(self.runtime, slots=self.slots)
+        self.scheduler = GangScheduler(
+            self.runtime, slots=self.slots, plan=plan,
+            quota_burst=(quota_burst if quota_burst is not None
+                         else cfg_extra(base_cfg, "mt_quota_burst")),
+            quota_refill_s=(quota_refill_s if quota_refill_s is not None
+                            else cfg_extra(base_cfg, "mt_quota_refill_s")))
         self.jobs: dict[str, TenantJob] = {}
         self._started = False
 
@@ -244,16 +263,25 @@ class MultiTenantControlPlane:
         if build_clients:
             clients = [build_client(tcfg, dataset, model, rank=r, backend=backend)
                        for r in range(1, tcfg.client_num_in_total + 1)]
+        lease_idx = None
+        lease_mesh = None
+        if self.plan is not None:
+            # static home lease: the job's compiled programs (shardings,
+            # AOT fingerprints) bind to these devices for its lifetime
+            lease_idx = len(self.jobs) % len(self.plan)
+            lease_mesh = self.plan.lease(lease_idx)
         hits0 = AOT_HITS.value()
         server = build_server(tcfg, dataset, model, backend=backend,
-                              runtime=self.runtime)
+                              runtime=self.runtime, mesh=lease_mesh)
         job = TenantJob(jid, tcfg, dataset, model, server, clients,
                         weight=w, priority=prio)
+        job.mesh = lease_mesh
         job.aot_hits_at_admit = int(AOT_HITS.value() - hits0)
         if job.aot_hits_at_admit > 0:
             AOT_WARM_JOBS.inc()
         server.round_gate = self.scheduler
-        self.scheduler.register(server, jid, weight=w, priority=prio)
+        self.scheduler.register(server, jid, weight=w, priority=prio,
+                                lease_index=lease_idx)
         self.jobs[jid] = job
         JOBS_ADMITTED.inc()
         log.info("admitted job %s (weight %.2f, priority %d, %d clients, "
@@ -347,11 +375,19 @@ def run_multi_tenant_soak(n_jobs: int = 8, versions: int = 6, *,
                           priorities: Optional[list] = None,
                           journal_root: Optional[str] = None,
                           aot_dir: Optional[str] = None,
+                          submesh_shape: Optional[str] = None,
+                          extra_flags: Optional[dict] = None,
                           timeout_s: float = 600.0) -> dict:
     """N buffered-async jobs, each with its own simulated client fleet,
     gang-scheduled onto one host pool — or the SAME jobs run one at a time
     through the same gated machinery (``concurrent=False``, the Nx-sequential
     baseline the bench ratio divides by).
+
+    ``submesh_shape`` (e.g. ``"clients:2"``): carve ``n_jobs`` disjoint
+    submeshes and run the CONCURRENT leg as a fleet partition — every job
+    leases its own devices and rounds genuinely overlap (the ``--mode
+    fleet`` bench shape); the sequential baseline always runs on the full
+    mesh.  Raises ``ValueError`` when the shapes don't tile the fleet.
 
     Returns aggregate versions/s, pooled p50/p95 round-hold latency (the
     per-round mesh occupancy under gang scheduling), and the per-job
@@ -360,15 +396,23 @@ def run_multi_tenant_soak(n_jobs: int = 8, versions: int = 6, *,
 
     from ..cross_silo.async_soak import _soak_config
 
+    plan = None
+    if concurrent and submesh_shape:
+        from ..parallel import mesh as meshlib
+
+        names, sizes = meshlib.parse_mesh_shape(submesh_shape)
+        plan = meshlib.carve_submeshes(names, sizes, n_jobs)
+
     def _job_cfg(i: int):
         return _soak_config(
             f"mtsoak_{'c' if concurrent else 's'}_{seed}_{i}",
             clients_per_job, concurrency, buffer_k, versions,
-            staleness_exponent=0.5, redispatch_timeout_s=2.0)
+            staleness_exponent=0.5, redispatch_timeout_s=2.0,
+            extra_flags=extra_flags)
 
     def _run_plane(job_indices) -> tuple[float, list, dict]:
         plane = MultiTenantControlPlane(slots=slots, journal_root=journal_root,
-                                        aot_dir=aot_dir)
+                                        aot_dir=aot_dir, plan=plan)
         try:
             for i in job_indices:
                 cfg = _job_cfg(i)
@@ -413,7 +457,8 @@ def run_multi_tenant_soak(n_jobs: int = 8, versions: int = 6, *,
     return {
         "mode": "concurrent" if concurrent else "sequential",
         "jobs": n_jobs,
-        "slots": slots,
+        "slots": len(plan) if plan is not None else slots,
+        "submesh": plan.describe() if plan is not None else None,
         "versions_per_job": versions,
         "versions_total": total_versions,
         "wall_s": round(wall, 4),

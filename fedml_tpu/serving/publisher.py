@@ -245,7 +245,7 @@ class HotSwapController:
     def __init__(self, predictor, version: int = 0, *,
                  canary_fraction: float = 0.0, canary_min_batches: int = 8,
                  regress_threshold: float = 0.5, latency_factor: float = 3.0,
-                 error_weight: float = 4.0):
+                 error_weight: float = 4.0, eval_batch=None):
         self._lock = threading.Lock()
         self._stable = (predictor, int(version))
         self._canary: Optional[tuple[Any, int]] = None
@@ -254,6 +254,18 @@ class HotSwapController:
         self.regress_threshold = float(regress_threshold)
         self.latency_factor = float(latency_factor)
         self.error_weight = float(error_weight)
+        #: optional labeled eval batch ``(x, y)``: each offered canary is
+        #: scored on REAL held-out accuracy (off the serving path, on the
+        #: watcher thread) and an accuracy regression vs the stable version
+        #: multiplies into the health score — a numerically healthy but
+        #: WRONG model now rolls back too
+        self.eval_batch = None
+        if eval_batch is not None:
+            import numpy as _np
+
+            ex, ey = eval_batch
+            self.eval_batch = (_np.asarray(ex, dtype=_np.float32),
+                               _np.asarray(ey))
         self.swaps = 0
         self.rollbacks = 0
         self.rejected: set[int] = set()
@@ -262,7 +274,27 @@ class HotSwapController:
         self._canary_errors = 0.0
         self._canary_lat_ewma: Optional[float] = None
         self._canary_batches = 0
+        self._stable_eval_acc = self._eval_accuracy(predictor)
+        self._canary_eval_acc: Optional[float] = None
         SERVED_VERSION.set(float(version))
+
+    def _eval_accuracy(self, predictor) -> Optional[float]:
+        """Accuracy of ``predictor`` on the labeled eval batch (None without
+        one, or when the predictor cannot score it — never raises into the
+        swap path)."""
+        if self.eval_batch is None or predictor is None:
+            return None
+        import numpy as _np
+
+        ex, ey = self.eval_batch
+        try:
+            logits = _np.asarray(predictor.predict_rows(ex))
+            return float(_np.mean(_np.argmax(logits, axis=-1)
+                                  == _np.asarray(ey).reshape(-1)))
+        except Exception:
+            log.warning("canary eval-batch scoring failed; accuracy factor "
+                        "skipped for this version", exc_info=True)
+            return None
 
     # -- routing (batcher dispatcher thread) ----------------------------------
     def route(self) -> tuple[Any, int, bool]:
@@ -318,12 +350,24 @@ class HotSwapController:
             limit = self.latency_factor * self._stable_lat_ewma
             if self._canary_lat_ewma > limit:
                 score *= limit / self._canary_lat_ewma
+        # real eval-set factor: a canary whose held-out accuracy fell below
+        # the stable version's is penalized proportionally (same
+        # multiplicative shape as the other factors — an improvement never
+        # boosts past 1.0)
+        if (self._canary_eval_acc is not None
+                and self._stable_eval_acc is not None
+                and self._stable_eval_acc > 0
+                and self._canary_eval_acc < self._stable_eval_acc):
+            score *= self._canary_eval_acc / self._stable_eval_acc
         return score
 
     def _promote_locked(self) -> None:  # graftlint: disable=GL004(caller holds _lock: observe_batch/offer call these inside their critical sections)
         pred, ver = self._canary
         self._stable = (pred, ver)
         self._canary = None
+        if self._canary_eval_acc is not None:
+            self._stable_eval_acc = self._canary_eval_acc
+        self._canary_eval_acc = None
         self.swaps += 1
         SWAPS.inc()
         SERVED_VERSION.set(float(ver))
@@ -337,10 +381,13 @@ class HotSwapController:
         self.rollbacks += 1
         ROLLBACKS.inc()
         log.warning("canary rollback: version %d health %.3f < %.3f after "
-                    "%d batches (%.0f errors) — stable version %d keeps "
-                    "serving", ver, self._health_score_locked(),
-                    self.regress_threshold, self._canary_batches,
-                    self._canary_errors, self._stable[1])
+                    "%d batches (%.0f errors, eval acc %s vs stable %s) — "
+                    "stable version %d keeps serving", ver,
+                    self._health_score_locked(), self.regress_threshold,
+                    self._canary_batches, self._canary_errors,
+                    self._canary_eval_acc, self._stable_eval_acc,
+                    self._stable[1])
+        self._canary_eval_acc = None
 
     # -- publication intake (watcher thread) ----------------------------------
     def wants_version(self, version: int) -> bool:
@@ -351,18 +398,24 @@ class HotSwapController:
 
     def offer(self, version: int, predictor) -> None:
         """Install a WARMED predictor for ``version``: direct promotion when
-        canary routing is off, else as the canary under a fresh score."""
+        canary routing is off, else as the canary under a fresh score.  With
+        an eval batch configured, the candidate is scored on it HERE (the
+        watcher thread, off the serving path) so the accuracy factor is in
+        place before the first canary batch reports."""
+        eval_acc = self._eval_accuracy(predictor)
         with self._lock:
             if version <= self._stable[1] or version in self.rejected:
                 return
             if self.canary_fraction <= 0:
                 self._canary = (predictor, version)
+                self._canary_eval_acc = eval_acc
                 self._promote_locked()
                 return
             self._canary = (predictor, version)
             self._canary_errors = 0.0
             self._canary_lat_ewma = None
             self._canary_batches = 0
+            self._canary_eval_acc = eval_acc
 
     # -- introspection --------------------------------------------------------
     @property
@@ -379,6 +432,8 @@ class HotSwapController:
                 "rollbacks": self.rollbacks,
                 "rejected_versions": sorted(self.rejected),
                 "canary_fraction": self.canary_fraction,
+                "stable_eval_acc": self._stable_eval_acc,
+                "canary_eval_acc": self._canary_eval_acc,
             }
 
 

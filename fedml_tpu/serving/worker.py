@@ -68,7 +68,8 @@ class ServingWorker:
                  canary_min_batches: int = 8, poll_s: float = 0.05,
                  feature_shape=None, aot_dir: Optional[str] = None,
                  bootstrap_timeout_s: float = 60.0,
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 eval_batch=None):
         from ..arguments import Config
         from ..models import model_hub
         from .batcher import MicroBatcher
@@ -127,10 +128,13 @@ class ServingWorker:
         # gateway time out.  (With --aot-dir the warm is a deserialized
         # program's first execution: milliseconds.)
         self.predictor.warm()
+        # optional labeled eval batch (x, y): canaries are scored on real
+        # held-out accuracy before promotion — see HotSwapController
         self.swap = HotSwapController(
             self.predictor, version=version,
             canary_fraction=canary_fraction,
-            canary_min_batches=canary_min_batches)
+            canary_min_batches=canary_min_batches,
+            eval_batch=eval_batch)
         self.batcher = MicroBatcher(
             self.predictor, controller=self.swap, max_batch=max_batch,
             max_queue=max_queue, flush_ms=flush_ms)

@@ -126,6 +126,14 @@ from ..core.checkpoint import RoundCheckpointMixin
 
 
 class MeshSimulator(RoundCheckpointMixin):
+    #: optional GangScheduler hook (cross_silo/runtime.py): when the
+    #: multi-tenant control plane attaches one, each population cohort
+    #: round requests a slot/lease before touching the mesh and releases
+    #: it after the round commits — the same round-boundary arbitration
+    #: the cross-silo servers use.  None (the default) = ungated,
+    #: bit-identical to before the hook existed.
+    round_gate = None
+
     def __init__(
         self,
         cfg: Config,
@@ -506,61 +514,81 @@ class MeshSimulator(RoundCheckpointMixin):
         scatter refreshed per-client state back to its shards.  State is
         gathered on the critical path AFTER the previous round's scatter —
         a client sampled in consecutive cohorts must see its fresh state."""
-        from ..population.cohorts import CohortPipeline
-
         pop = self._population
         out = []
         for _ in range(n):
-            r = self.round_idx
-            t0 = time.perf_counter()
-            pop.pipeline.prefetch_round(r)
-            ids, batch = pop.pipeline.obtain(r)
-            if r + 1 < self.cfg.comm_round:
-                pop.pipeline.prefetch_round(r + 1)
-            lanes = CohortPipeline.pad_ids(ids, pop.m_pad)
-            xs = self._pad_cohort_rows(batch.x, pop.m_pad)
-            if self.hp.compute_dtype == "bfloat16" and np.issubdtype(xs.dtype, np.floating):
-                import ml_dtypes
+            if self.round_gate is not None:
+                # cohort rounds arbitrate through the same device-slot
+                # scheduler as the cross-silo servers: block this (caller)
+                # thread until the slot/lease grant lands, run the round,
+                # release at the round boundary
+                import threading
 
-                xs = xs.astype(ml_dtypes.bfloat16)
-            ys = self._pad_cohort_rows(batch.y, pop.m_pad)
-            cs = pop.store.gather_state(ids)
-            if cs is not None:
-                cs = meshlib.shard_leading_axis(
-                    self._pad_cohort_rows(cs, pop.m_pad), self.mesh)
-            xs, ys = meshlib.shard_leading_axis((xs, ys), self.mesh)
-            cnts = jnp.asarray(self._pad_cohort_rows(batch.counts, pop.m_pad))
-            args = (
-                self.global_vars, self.server_state, cs, cnts, xs, ys,
-                jnp.asarray(lanes, jnp.int32), jnp.int32(r), self.root_key,
-                self.defense_history,
-            )
-            if pop.round_fn is None:
-                # first cohort with the AOT store: load (or export) the
-                # round program — a restarted server skips the re-trace
-                raw = self._make_population_round_fn(pop.m)
-                pop.round_fn = self._aot.cached_jit(
-                    raw, args,
-                    key=self._aot_key("sim.population_round",
-                                      trees={"args": args},
-                                      extra={"cohort": pop.m}),
-                )
-            with traced("sim.population_round", round_idx=r, cohort=pop.m,
-                        sink=self._otlp_sink):
-                gv, ss, new_cs, nd, metrics = pop.round_fn(*args)
-                host = {k: float(v) for k, v in metrics.items()}  # syncs
-            if new_cs is not None:
-                pop.store.scatter_state(ids, new_cs)
-            self.global_vars, self.server_state = gv, ss
-            if nd is not None:
-                self.defense_history = nd
-            self.round_idx += 1
-            ROUND_TIME.observe(time.perf_counter() - t0)
-            out.append(host)
+                granted = threading.Event()
+                self.round_gate.request(self, granted.set)
+                granted.wait()
+            try:
+                out.append(self._run_one_population_round())
+            finally:
+                if self.round_gate is not None:
+                    self.round_gate.release(self)
         # host boundary: the on-disk shards are this mode's checkpointable
         # client state — keep them consistent before eval/checkpoint runs
         pop.store.flush()
         return out
+
+    def _run_one_population_round(self) -> dict:
+        """One streamed cohort round (the body :meth:`_run_population_rounds`
+        gates); returns the round's host metrics."""
+        from ..population.cohorts import CohortPipeline
+
+        pop = self._population
+        r = self.round_idx
+        t0 = time.perf_counter()
+        pop.pipeline.prefetch_round(r)
+        ids, batch = pop.pipeline.obtain(r)
+        if r + 1 < self.cfg.comm_round:
+            pop.pipeline.prefetch_round(r + 1)
+        lanes = CohortPipeline.pad_ids(ids, pop.m_pad)
+        xs = self._pad_cohort_rows(batch.x, pop.m_pad)
+        if self.hp.compute_dtype == "bfloat16" and np.issubdtype(xs.dtype, np.floating):
+            import ml_dtypes
+
+            xs = xs.astype(ml_dtypes.bfloat16)
+        ys = self._pad_cohort_rows(batch.y, pop.m_pad)
+        cs = pop.store.gather_state(ids)
+        if cs is not None:
+            cs = meshlib.shard_leading_axis(
+                self._pad_cohort_rows(cs, pop.m_pad), self.mesh)
+        xs, ys = meshlib.shard_leading_axis((xs, ys), self.mesh)
+        cnts = jnp.asarray(self._pad_cohort_rows(batch.counts, pop.m_pad))
+        args = (
+            self.global_vars, self.server_state, cs, cnts, xs, ys,
+            jnp.asarray(lanes, jnp.int32), jnp.int32(r), self.root_key,
+            self.defense_history,
+        )
+        if pop.round_fn is None:
+            # first cohort with the AOT store: load (or export) the
+            # round program — a restarted server skips the re-trace
+            raw = self._make_population_round_fn(pop.m)
+            pop.round_fn = self._aot.cached_jit(
+                raw, args,
+                key=self._aot_key("sim.population_round",
+                                  trees={"args": args},
+                                  extra={"cohort": pop.m}),
+            )
+        with traced("sim.population_round", round_idx=r, cohort=pop.m,
+                    sink=self._otlp_sink):
+            gv, ss, new_cs, nd, metrics = pop.round_fn(*args)
+            host = {k: float(v) for k, v in metrics.items()}  # syncs
+        if new_cs is not None:
+            pop.store.scatter_state(ids, new_cs)
+        self.global_vars, self.server_state = gv, ss
+        if nd is not None:
+            self.defense_history = nd
+        self.round_idx += 1
+        ROUND_TIME.observe(time.perf_counter() - t0)
+        return host
 
     # ------------------------------------------------------------------
     def _aot_key(self, site: str, trees: Optional[dict] = None,

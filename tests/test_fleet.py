@@ -1,0 +1,336 @@
+"""One fleet for everything (ISSUE 19): per-job submesh partition of a
+single device fleet, the device-slot scheduler's token-bucket quota
+(throttled, never starved), the tenant-routed serving gateway under live
+training, fallback to the PR-14 time-sliced gate when the shapes don't
+tile, and the flags-unset regression pins (no SubmeshPlan object, no lease
+metrics — the time-sliced semantics bit-identical pins live in
+tests/test_multi_tenant.py)."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import Config
+from fedml_tpu.cross_silo.runtime import GangScheduler, ServerRuntime
+from fedml_tpu.obs import registry as obsreg
+from fedml_tpu.parallel import mesh as meshlib
+from fedml_tpu.sched.multi_tenant import MultiTenantControlPlane
+
+
+def _cfg(extra=None):
+    return Config(dataset="synthetic", model="lr", extra=dict(extra or {}))
+
+
+# ---------------------------------------------------------------------------
+# submesh carving + config plumbing
+# ---------------------------------------------------------------------------
+
+def test_carve_submeshes_disjoint_and_identically_shaped(eight_devices):
+    plan = meshlib.carve_submeshes(("clients",), (2,), 4)
+    assert len(plan) == 4
+    assert plan.describe() == {"jobs": 4, "shape": {"clients": 2},
+                               "devices_per_job": 2}
+    seen = set()
+    for i in range(4):
+        lease = plan.lease(i)
+        assert lease.axis_names == ("clients",)
+        assert lease.devices.shape == (2,)
+        ids = {d.id for d in lease.devices.flat}
+        assert not (ids & seen), "leases must be disjoint"
+        seen |= ids
+    assert seen == {d.id for d in eight_devices}
+    # shapes that don't tile the fleet refuse loudly
+    with pytest.raises(ValueError):
+        meshlib.carve_submeshes(("clients",), (3,), 3)  # 9 > 8 devices
+    with pytest.raises(ValueError):
+        meshlib.carve_submeshes(("clients",), (-1,), 2)  # non-concrete
+    with pytest.raises(ValueError):
+        meshlib.carve_submeshes(("clients",), (2,), 0)
+
+
+def test_submesh_plan_from_config_and_fallback(caplog, eight_devices):
+    """Flags unset -> no SubmeshPlan object at all; a shape that cannot
+    tile the fleet -> None WITH a warning (the control plane then keeps the
+    PR-14 time-sliced gate); a valid shape without mt_submesh_jobs derives
+    the job count from the fleet size."""
+    assert meshlib.submesh_plan_from_config(_cfg()) is None
+    assert not caplog.records
+
+    plan = meshlib.submesh_plan_from_config(
+        _cfg({"mt_submesh_shape": "clients:2"}))
+    assert plan is not None and len(plan) == 4  # 8 devices / 2 per job
+
+    with caplog.at_level("WARNING", logger="fedml_tpu.parallel.mesh"):
+        bad = _cfg({"mt_submesh_shape": "clients:3", "mt_submesh_jobs": 4})
+        assert meshlib.submesh_plan_from_config(bad) is None
+    assert any("falling back" in r.getMessage() for r in caplog.records)
+
+    # the plane built from the rejected config keeps slots semantics
+    plane = MultiTenantControlPlane(slots=2, base_cfg=bad)
+    try:
+        assert plane.plan is None
+        assert plane.slots == 2
+        assert plane.scheduler.plan is None
+    finally:
+        plane.close()
+
+
+def test_flags_unset_no_plan_no_lease_metrics():
+    """Regression pin: without the mt_submesh flags the scheduler is the
+    PR-14 time-sliced gate — no plan, no lease, slot grants metered as slot
+    grants and NEVER as lease grants, submesh gauge at zero."""
+    rt = ServerRuntime(name="t-noplan")
+    sched = GangScheduler(rt, slots=1)
+    lease_metric = obsreg.REGISTRY.get("fedml_fleet_lease_grants_total")
+    l0 = lease_metric.value(job="np")
+    try:
+        assert sched.plan is None
+        assert obsreg.REGISTRY.get("fedml_fleet_submeshes").value() == 0.0
+        job = object()
+        sched.register(job, "np")
+        assert sched.lease_of(job) is None
+        evt = threading.Event()
+        sched.request(job, evt.set)
+        assert evt.wait(5.0)
+        sched.release(job)
+        assert sched.stats["np"]["grants"] == 1
+        assert lease_metric.value(job="np") - l0 == 0.0
+    finally:
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# token-bucket quota: throttled, never starved
+# ---------------------------------------------------------------------------
+
+def test_quota_throttled_tenant_resumes_after_refill():
+    """A tenant past its burst is deferred (metered as throttled) while a
+    sibling with tokens is granted FIRST despite arriving later — and the
+    throttled tenant's grant arrives on its own once the bucket refills
+    (the refill timer re-pumps; nobody nudges the scheduler)."""
+    rt = ServerRuntime(name="t-quota")
+    sched = GangScheduler(rt, slots=1, quota_burst=2.0, quota_refill_s=0.3)
+    throttled_metric = obsreg.REGISTRY.get("fedml_fleet_quota_throttled_total")
+    t0 = throttled_metric.value(job="qa")
+    a, b = object(), object()
+    sched.register(a, "qa")
+    sched.register(b, "qb")
+    try:
+        # drain A's bucket with two immediate rounds
+        for _ in range(2):
+            evt = threading.Event()
+            sched.request(a, evt.set)
+            assert evt.wait(5.0)
+            sched.release(a)
+        # A (empty bucket) requests BEFORE B (full bucket): B wins the slot,
+        # A is metered throttled — capped, not starved
+        order = []
+        ea, eb = threading.Event(), threading.Event()
+        sched.request(a, lambda: (order.append("a"), ea.set()))
+        sched.request(b, lambda: (order.append("b"), eb.set()))
+        assert eb.wait(5.0), "sibling with tokens must not wait on A's quota"
+        assert order[0] == "b", order
+        assert sched.stats["qa"]["throttled"] >= 1
+        assert throttled_metric.value(job="qa") - t0 >= 1.0
+        sched.release(b)
+        # the refill timer resumes A without any further request/release
+        assert ea.wait(5.0), "throttled tenant starved past the refill"
+        sched.release(a)
+        assert sched.stats["qa"]["grants"] == 3
+        assert sched.stats["qb"]["grants"] == 1
+    finally:
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# submesh-vs-dedicated bitwise parity
+# ---------------------------------------------------------------------------
+
+def _parity_cfg(i, run_id):
+    # per-job learning rates: genuinely distinct jobs, so a single
+    # cross-tenant fold leak would break the bitwise identity
+    return Config(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=2, client_num_per_round=2, comm_round=2,
+        epochs=1, batch_size=16, learning_rate=0.05 + 0.02 * i,
+        partition_method="homo", synthetic_train_size=64,
+        synthetic_test_size=32, frequency_of_the_test=0,
+        compute_dtype="float32", metrics_jsonl_path="", run_id=run_id,
+        extra={"streaming_aggregation": True, "server_shard_fold": True})
+
+
+def _final_bytes(server):
+    import jax
+
+    from fedml_tpu.comm import wire
+
+    return wire.encode_pytree(jax.device_get(server.aggregator.global_vars))
+
+
+@pytest.mark.locksan
+def test_submesh_vs_dedicated_bitwise_parity(eight_devices):
+    """Two distinct sync jobs folding concurrently on disjoint 2-device
+    leases produce finals BIT-FOR-BIT equal to each job run alone on a
+    dedicated identically shaped mesh — submesh placement is invisible to
+    the math, and zero bytes bleed across tenants."""
+    import jax
+
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.cross_silo import build_client, build_server
+    from fedml_tpu.data import loader
+    from fedml_tpu.models import model_hub
+
+    plan = meshlib.carve_submeshes(("clients",), (2,), 2)
+    plane = MultiTenantControlPlane(slots=1, plan=plan)
+    fleet_finals = {}
+    try:
+        jobs = []
+        for i in range(2):
+            cfg = _parity_cfg(i, f"tfleet_par_c_{i}")
+            fedml_tpu.init(cfg)
+            jobs.append(plane.admit(cfg, job_id=f"t{i}"))
+        # each job's server folds on its OWN lease, not the full mesh
+        for i, job in enumerate(jobs):
+            ids = {d.id for d in job.mesh.devices.flat}
+            assert ids == {d.id for d in plan.lease(i).devices.flat}
+        assert not ({d.id for d in jobs[0].mesh.devices.flat}
+                    & {d.id for d in jobs[1].mesh.devices.flat})
+        plane.start()
+        out = plane.run_until_done(timeout=300.0)
+        for i, job in enumerate(jobs):
+            assert out["jobs"][f"t{i}"]["rounds"] == 2
+            fleet_finals[i] = _final_bytes(job.server)
+    finally:
+        plane.close()
+    assert fleet_finals[0] != fleet_finals[1], (
+        "identical finals would blind the parity check to a leak")
+
+    for i in range(2):
+        cfg = _parity_cfg(i, f"tfleet_par_d_{i}")
+        fedml_tpu.init(cfg)
+        ds = loader.load(cfg)
+        model = model_hub.create(cfg, ds.class_num)
+        dmesh = meshlib.make_mesh(("clients",), (2,),
+                                  devices=jax.devices()[:2])
+        InProcRouter.reset(cfg.run_id)
+        clients = [build_client(cfg, ds, model, rank=r, backend="INPROC")
+                   for r in (1, 2)]
+        for c in clients:
+            c.run_in_thread()
+        server = build_server(cfg, ds, model, backend="INPROC", mesh=dmesh)
+        try:
+            server.run_until_done(timeout=120.0)
+            for c in clients:
+                c.done.wait(5.0)
+            assert fleet_finals[i] == _final_bytes(server), (
+                f"job t{i}: submesh final != dedicated final")
+        finally:
+            for c in clients:
+                c.finish()
+            server.finish()
+            InProcRouter.reset(cfg.run_id)
+
+
+# ---------------------------------------------------------------------------
+# tenant-routed gateway under live training
+# ---------------------------------------------------------------------------
+
+@pytest.mark.locksan
+def test_gateway_routes_two_tenants_under_live_training(tmp_path, eight_devices):
+    """Two async jobs train on disjoint submeshes while BOTH tenants serve
+    through one gateway: zero dropped requests, every response tagged with
+    the requested tenant, and every served version attributable to that
+    tenant's own manifest (the tenants publish DIFFERENT version counts, so
+    a cross-tenant route would surface as an impossible version)."""
+    from fedml_tpu.cross_silo.async_soak import _soak_config
+    from fedml_tpu.serving.gateway import ServingGateway
+    from fedml_tpu.serving.publisher import ManifestWatcher
+    from fedml_tpu.serving.worker import ServingWorker
+
+    pub = str(tmp_path / "pub")
+    versions = {"t0": 3, "t1": 2}
+    plane = MultiTenantControlPlane(
+        slots=1, journal_root=str(tmp_path / "journals"),
+        plan=meshlib.carve_submeshes(("clients",), (2,), 2))
+    workers, gw = [], None
+    try:
+        for i, (jid, nver) in enumerate(versions.items()):
+            cfg = _soak_config(
+                f"tfleet_gw_{i}", 6, 3, 3, nver, staleness_exponent=0.5,
+                redispatch_timeout_s=5.0,
+                extra_flags={"server_shard_fold": True,
+                             "model_publish_dir": pub})
+            fedml_tpu.init(cfg)
+            job = plane.admit(cfg, job_id=jid, build_clients=False)
+            plane.attach_sim_fleet(job, drop_prob=0.0, latency_mean_s=0.08,
+                                   latency_sigma=0.25, seed=i, workers=2)
+        plane.start()
+        gw = ServingGateway(max_batch=8, flush_ms=1.0)
+        for jid in versions:
+            w = ServingWorker("lr", 10, publish_dir=os.path.join(pub, f"job_{jid}"),
+                              max_batch=16, flush_ms=1.0, poll_s=0.02,
+                              bootstrap_timeout_s=120.0)
+            workers.append(w)
+            gw.add_tenant(jid, port=w.start(block=False),
+                          publish_dir=os.path.join(pub, f"job_{jid}"))
+        gport = gw.start(block=False)
+        feat = workers[0].predictor.feature_shape[0]
+
+        def ask(tenant):
+            body = json.dumps({"tenant": tenant,
+                               "inputs": [[0.0] * feat]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gport}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30.0) as r:
+                return json.loads(r.read())
+
+        seen = {jid: set() for jid in versions}
+        dropped = 0
+        while not all(j.server.done.is_set() for j in plane.jobs.values()):
+            for jid in versions:
+                try:
+                    out = ask(jid)
+                    assert out["tenant"] == jid, out
+                    seen[jid].add(int(out["version"]))
+                except (urllib.error.URLError, OSError):
+                    dropped += 1
+            time.sleep(0.01)
+        out = plane.run_until_done(timeout=300.0)
+        for jid, nver in versions.items():
+            assert out["jobs"][jid]["rounds"] == nver, out
+        assert dropped == 0
+        # final state: each tenant serves exactly its own manifest's version
+        for (jid, nver), w in zip(versions.items(), workers):
+            manifest = ManifestWatcher(os.path.join(pub, f"job_{jid}")
+                                       ).read_manifest() or {}
+            assert int(manifest.get("version", -1)) == nver, (jid, manifest)
+            assert str(manifest.get("run_id", "")).endswith(f"_job_{jid}")
+            deadline = time.time() + 10.0
+            while w.served_version < nver and time.time() < deadline:
+                time.sleep(0.02)
+            final = ask(jid)
+            assert final["version"] == nver, (jid, final)
+            seen[jid].add(int(final["version"]))
+            # attribution: every version this tenant ever served exists in
+            # ITS publish history (0..nver) — t1 answering t0's version 3
+            # would fail here
+            assert seen[jid] <= set(range(nver + 1)), (jid, seen)
+            lane = gw.stats()["tenants"][jid]
+            assert lane["forwarded"] > 0 and lane["last_version"] == nver
+        # an unknown tenant is refused, never misrouted
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            ask("ghost")
+        assert exc.value.code == 404
+    finally:
+        if gw is not None:
+            gw.stop()
+        for w in workers:
+            w.stop()
+        plane.close()

@@ -83,7 +83,7 @@ def test_fix_preserves_runtime_semantics():
 def test_fix_skips_manual_sites_and_suppressions(tmp_path):
     (tmp_path / "mod.py").write_text(textwrap.dedent('''
         def f(cfg, name):
-            cfg.extra.setdefault("gan_z_dim", 3)  # statement: seeds the dict
+            cfg.extra.setdefault(name, 3)  # non-literal name: manual
             cfg.extra["seg_base"]  # statement-position subscript: no value use
             c = "silo_dp" in cfg.extra
             d = cfg.extra.get(name)
@@ -157,7 +157,8 @@ def test_fix_subscript_semantics():
 def test_fix_rewrites_value_position_setdefault(tmp_path):
     """The ROADMAP carried item: ``x = extra.setdefault(k, v)`` reads the
     flag with default ``v`` — rewritten to the registry-backed read.  The
-    statement form (pure dict seeding) stays manual."""
+    statement form becomes an explicit seed assignment (ISSUE 19
+    satellite) — see the statement-position tests below."""
     src = textwrap.dedent('''
         def f(cfg):
             a = cfg.extra.setdefault("mlp_hidden", 64)
@@ -169,16 +170,68 @@ def test_fix_rewrites_value_position_setdefault(tmp_path):
             return a, b
     ''')
     fixed, n, skipped = fix_source(src, "mod.py")
-    assert n == 3, fixed
+    assert n == 4, fixed
     assert "cfg_extra(cfg, 'mlp_hidden', 64)" in fixed
     assert "cfg_extra(cfg, 'silo_dp', None)" in fixed
     assert "cfg_extra(cfg, 'fused_blocks', False)" in fixed
-    # the statement-position seed survives untouched, with a manual note
-    assert 'cfg.extra.setdefault("comm_topk_ratio", 0.1)' in fixed
-    assert any("statement-position" in s for s in skipped)
+    # the statement-position seed becomes an explicit assignment through
+    # the registry-checked read
+    assert ("cfg.extra['comm_topk_ratio'] = "
+            "cfg_extra(cfg, 'comm_topk_ratio', 0.1)") in fixed
+    assert skipped == []
     compile(fixed, "mod.py", "exec")
     again, n2, _ = fix_source(fixed, "mod.py")
     assert n2 == 0 and again == fixed  # idempotent
+
+
+def test_fix_rewrites_statement_position_setdefault():
+    """ISSUE 19 satellite: a statement-position ``extra.setdefault(k, v)``
+    (pure dict seeding for raw downstream readers) is rewritten to
+    ``extra['k'] = cfg_extra(cfg, 'k', v)`` — seeded dict preserved, flag
+    name declared and GL001-checked — and the rewrite is idempotent."""
+    src = textwrap.dedent('''
+        def seed(cfg):
+            cfg.extra.setdefault("mlp_hidden", 64)
+            extra = cfg.extra
+            extra.setdefault("silo_dp")
+            return cfg
+    ''')
+    fixed, n, skipped = fix_source(src, "mod.py")
+    assert n == 2, fixed
+    assert skipped == []
+    assert ("cfg.extra['mlp_hidden'] = "
+            "cfg_extra(cfg, 'mlp_hidden', 64)") in fixed
+    # the local-alias receiver keeps its own spelling; the no-default form
+    # seeds the explicit None that setdefault() would have
+    assert "extra['silo_dp'] = cfg_extra(cfg, 'silo_dp', None)" in fixed
+    compile(fixed, "mod.py", "exec")
+    again, n2, again_skipped = fix_source(fixed, "mod.py")
+    assert n2 == 0 and again == fixed and again_skipped == []  # idempotent
+
+
+def test_fix_statement_setdefault_exec_semantics():
+    """Exec'd before/after: a PRESENT key keeps its value and a missing key
+    lands the same seed, so every raw downstream ``extra[...]`` reader sees
+    an identical dict."""
+    from fedml_tpu.arguments import Config
+
+    src = textwrap.dedent('''
+        def seed(cfg):
+            cfg.extra.setdefault("mlp_hidden", 64)
+            cfg.extra.setdefault("silo_dp", True)
+            return cfg.extra
+    ''')
+    fixed, n, _ = fix_source(src, "mod.py")
+    assert n == 2
+    orig_ns, fixed_ns = {}, {}
+    exec(compile(src, "o.py", "exec"), orig_ns)
+    exec(compile(fixed, "f.py", "exec"), fixed_ns)
+    for extra in ({}, {"mlp_hidden": 256}, {"mlp_hidden": 0, "silo_dp": False}):
+        got_orig = dict(orig_ns["seed"](
+            Config(dataset="synthetic", model="lr", extra=dict(extra))))
+        got_fixed = dict(fixed_ns["seed"](
+            Config(dataset="synthetic", model="lr", extra=dict(extra))))
+        assert got_orig == got_fixed, (extra, got_orig, got_fixed)
 
 
 def test_fix_setdefault_semantics_match_on_value_use():

@@ -43,6 +43,7 @@ INSTRUMENTED_MODULES = [
     "fedml_tpu.population.cohorts",
     "fedml_tpu.population.store",
     "fedml_tpu.serving.batcher",
+    "fedml_tpu.serving.gateway",
     "fedml_tpu.serving.publisher",
     "fedml_tpu.sim.engine",
 ]

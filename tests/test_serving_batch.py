@@ -260,6 +260,67 @@ def test_canary_promotes_healthy_version():
         b.stop()
 
 
+class _LabelPredictor:
+    """Predicts the class carried in feature 0 — or a constant wrong class.
+    Numerically healthy either way (finite, fast): only the labeled eval
+    batch can tell the good one from the bad one."""
+
+    def __init__(self, wrong=False):
+        self.wrong = wrong
+
+    def predict_rows(self, x):
+        x = np.asarray(x)
+        logits = np.zeros((x.shape[0], 2), np.float32)
+        cls = np.zeros(x.shape[0], int) if self.wrong \
+            else x[:, 0].round().astype(int)
+        logits[np.arange(x.shape[0]), cls] = 1.0
+        return logits
+
+
+def test_canary_rollback_on_eval_accuracy_regression():
+    """ISSUE 19 satellite: the labeled eval batch folds into the health
+    score — a canary that is numerically healthy (no errors, no latency
+    regression) but WRONG on held-out data rolls back; an accurate
+    candidate still promotes.  Without the eval batch the same wrong
+    canary sails through, proving the accuracy factor is load-bearing."""
+    from fedml_tpu.serving.publisher import HotSwapController
+
+    ex = np.array([[0.0, 1.0], [1.0, 0.0], [0.0, 0.0], [1.0, 1.0]], np.float32)
+    ey = np.array([0, 1, 0, 1])
+    good, bad = _LabelPredictor(), _LabelPredictor(wrong=True)
+
+    ctl = HotSwapController(good, version=1, canary_fraction=0.5,
+                            canary_min_batches=2, regress_threshold=0.6,
+                            eval_batch=(ex, ey))
+    stats = ctl.stats()
+    assert stats["stable_eval_acc"] == 1.0, stats
+    # wrong canary: every canary batch reports healthy, yet the eval factor
+    # (acc 0.5 vs stable 1.0) drags the score under the threshold
+    ctl.offer(2, bad)
+    assert ctl.stats()["canary_eval_acc"] == 0.5, ctl.stats()
+    for _ in range(2):
+        ctl.observe_batch(2, ok=True, execute_s=0.001, is_canary=True)
+    stats = ctl.stats()
+    assert stats["rollbacks"] == 1 and stats["served_version"] == 1, stats
+    assert 2 in stats["rejected_versions"], stats
+    assert stats["stable_eval_acc"] == 1.0  # stable's score survives rollback
+    # accurate candidate: same healthy batches, promotes
+    ctl.offer(3, _LabelPredictor())
+    for _ in range(2):
+        ctl.observe_batch(3, ok=True, execute_s=0.001, is_canary=True)
+    stats = ctl.stats()
+    assert stats["served_version"] == 3 and stats["swaps"] == 1, stats
+    assert stats["stable_eval_acc"] == 1.0, stats
+    # control: no eval batch -> the wrong canary promotes (nothing else
+    # about it regresses), which is exactly the gap the satellite closes
+    blind = HotSwapController(good, version=1, canary_fraction=0.5,
+                              canary_min_batches=2, regress_threshold=0.6)
+    blind.offer(2, bad)
+    for _ in range(2):
+        blind.observe_batch(2, ok=True, execute_s=0.001, is_canary=True)
+    assert blind.stats()["served_version"] == 2, blind.stats()
+
+
 @pytest.mark.locksan
 def test_hot_swap_e2e_publisher_to_worker(tmp_path, eight_devices):
     """The full publication channel under load: ModelPublisher commits
