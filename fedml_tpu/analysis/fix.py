@@ -9,6 +9,9 @@ semantics-preserving mechanical form — the ``.get`` call::
     extra = cfg.extra; ... extra.get("silo_dp", True) -> cfg_extra(cfg, 'silo_dp', True)
     x = extra.setdefault("k", 3)                      -> x = cfg_extra(cfg, 'k', 3)
     x = cfg.extra["k"]                                -> x = cfg_extra(cfg, 'k', None)
+    if "k" in cfg.extra: ...                          -> if cfg_extra_present(cfg, 'k'): ...
+    if "k" not in extra: ...                          -> if (not cfg_extra_present(cfg, 'k')): ...
+    cfg.extra["k"] = v                                -> set_cfg_extra(cfg, 'k', v)
 
 The original default expression is carried verbatim (``.get`` with no default
 becomes an explicit ``None``), so the rewrite never swaps in the registry
@@ -21,15 +24,14 @@ the dict-seeding side effect is what the registry replaces — every other
 registry-backed read supplies its own declared default, so the seed is
 dead weight.  A *statement*-position ``extra.setdefault(k, v)`` exists ONLY
 for that side effect (someone downstream reads the dict raw); it is
-rewritten to an EXPLICIT seed assignment through the registry-checked
-read::
+rewritten to an EXPLICIT seed through the registry-checked write::
 
-    cfg.extra.setdefault("k", 3)   ->   cfg.extra['k'] = cfg_extra(cfg, 'k', 3)
+    cfg.extra.setdefault("k", 3)   ->   set_cfg_extra(cfg, 'k', cfg_extra(cfg, 'k', 3))
 
 which preserves the seeded dict for every raw downstream reader (present
 key keeps its value via the ``cfg_extra`` resolution order, missing key
 lands the same default) while the flag name becomes declared and
-GL001-checked.
+GL001-checked on BOTH halves.
 
 Value-position ``extra["k"]`` subscript READS are rewritten to
 ``cfg_extra(cfg, 'k', None)`` (ISSUE 12 satellite).  This is the one rewrite
@@ -38,11 +40,19 @@ that intentionally changes missing-key behavior: the subscript raised
 crashes on an unset flag is exactly the misconfiguration failure mode the
 registry exists to kill, and every rewritten name becomes a declared,
 GL001-checked read.  Set keys behave identically (proven by test).
-Statement-position subscripts, Store/Del/augmented targets, and write sites
-are left alone.
 
-Sites the fixer cannot prove out — statement-position subscripts, ``in``
-membership tests, non-literal flag names, and receivers whose owning
+``"k" in extra`` / ``"k" not in extra`` membership tests are rewritten to
+``cfg_extra_present(cfg, 'k')`` (ISSUE 20 satellite) — the dedicated
+membership probe keeps present-but-``None`` distinct from absent, so the
+rewrite is semantics-preserving wherever the attribute-vs-dict resolution
+order agrees (the same alignment every other rewrite already accepts).
+The ``not in`` form is paren-wrapped so operator precedence survives any
+surrounding expression.  Single-target ``extra["k"] = value`` STORES
+become ``set_cfg_extra(cfg, 'k', value)`` — the one blessed write idiom,
+registry-checked like the reads.
+
+Sites the fixer cannot prove out — statement-position subscript reads,
+Del/augmented targets, non-literal flag names, and receivers whose owning
 config expression cannot be recovered — are reported for manual
 migration, never guessed at.
 
@@ -50,8 +60,9 @@ migration, never guessed at.
 default argument is rewritten on the next pass), which is also what makes
 ``--fix`` idempotent: a second run over fixed sources reports zero rewrites.
 The inserted import is the absolute ``from fedml_tpu.core.flags import
-cfg_extra`` — the package itself migrated in PR 5, so the fixer's targets
-are out-of-tree recipes/plugins where a relative import would not resolve.
+<helpers actually used>`` — the package itself migrated in PR 5, so the
+fixer's targets are out-of-tree recipes/plugins where a relative import
+would not resolve.
 """
 
 from __future__ import annotations
@@ -66,7 +77,11 @@ from .rules.gl001_flags import _is_extra_expr
 
 __all__ = ["fix_source", "fix_file", "fix_tree", "FixResult"]
 
-IMPORT_LINE = "from fedml_tpu.core.flags import cfg_extra"
+IMPORT_MODULE = "fedml_tpu.core.flags"
+#: canonical order for the inserted import's name list (and the detection
+#: of what an existing import already provides)
+HELPER_NAMES = ("cfg_extra", "cfg_extra_present", "set_cfg_extra")
+IMPORT_LINE = f"from {IMPORT_MODULE} import cfg_extra"  # the common single-helper form
 
 
 @dataclass
@@ -141,12 +156,14 @@ def _one_pass(source: str, relpath: str,
     }
     extra_vars: set[str] = set()
     assigned: dict[str, Optional[str]] = {}
-    candidates: list[tuple[tuple[int, int], str]] = []  # (span, replacement)
+    # (span, replacement, helpers the replacement calls)
+    candidates: list[tuple[tuple[int, int], str, tuple[str, ...]]] = []
     skipped: list[str] = []
-    has_import = any(
-        isinstance(n, ast.ImportFrom) and any(a.name == "cfg_extra" for a in n.names)
-        for n in ast.walk(tree)
-    )
+    imported = {
+        a.name
+        for n in ast.walk(tree) if isinstance(n, ast.ImportFrom)
+        for a in n.names if a.name in HELPER_NAMES
+    }
 
     def skip(node: ast.AST, why: str) -> None:
         if not suppressed(node.lineno):
@@ -165,13 +182,34 @@ def _one_pass(source: str, relpath: str,
             extra_vars.add(node.targets[0].id)
             assigned[node.targets[0].id] = _cfg_expr_of(node.value, assigned)
             continue
+        # single-target subscript STORE on an extra-like receiver: the whole
+        # statement becomes the registry-checked write (ISSUE 20 satellite)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Subscript) \
+                and _is_extra_expr(node.targets[0].value, extra_vars):
+            sub = node.targets[0]
+            name = str_const(sub.slice)
+            if name is None:
+                skip(node, "extra[<non-literal name>] = ... store — GL001 needs "
+                           "a literal flag name; migrate by hand")
+                continue
+            cfg_src = _cfg_expr_of(sub.value, assigned)
+            if cfg_src is None:
+                skip(node, f"extra[{name!r}] = ... store: owning config object "
+                           "not recoverable — migrate by hand")
+                continue
+            value_src = ast.unparse(node.value)
+            candidates.append((_span(node, offsets),
+                               f"set_cfg_extra({cfg_src}, {name!r}, {value_src})",
+                               ("set_cfg_extra",)))
+            continue
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
                 and node.args and _is_extra_expr(node.func.value, extra_vars):
             if node.func.attr == "setdefault" and id(node) in stmt_position:
-                # statement-position seed: rewrite to an explicit assignment
-                # through the registry-checked read — the seeded dict stays
-                # seeded for raw downstream readers, the name becomes a
-                # declared GL001-checked flag
+                # statement-position seed: rewrite to an explicit seed through
+                # the registry-checked write — the seeded dict stays seeded
+                # for raw downstream readers, the name becomes a declared
+                # GL001-checked flag on both the read and write halves
                 name = str_const(node.args[0])
                 cfg_src = _cfg_expr_of(node.func.value, assigned)
                 if (name is None or cfg_src is None
@@ -180,15 +218,12 @@ def _one_pass(source: str, relpath: str,
                                "non-literal name / unrecoverable config / odd "
                                "call shape — migrate by hand")
                     continue
-                recv = node.func.value
-                recv_src = ast.unparse(recv)
-                if not isinstance(recv, (ast.Name, ast.Attribute)):
-                    recv_src = f"({recv_src})"  # keep the target parseable
                 default_src = (ast.unparse(node.args[1])
                                if len(node.args) == 2 else "None")
                 candidates.append((_span(node, offsets),
-                                   f"{recv_src}[{name!r}] = "
-                                   f"cfg_extra({cfg_src}, {name!r}, {default_src})"))
+                                   f"set_cfg_extra({cfg_src}, {name!r}, "
+                                   f"cfg_extra({cfg_src}, {name!r}, {default_src}))",
+                                   ("cfg_extra", "set_cfg_extra")))
                 continue
             if node.func.attr not in ("get", "setdefault"):
                 continue
@@ -209,7 +244,7 @@ def _one_pass(source: str, relpath: str,
                 continue
             default_src = ast.unparse(node.args[1]) if len(node.args) == 2 else "None"
             replacement = f"cfg_extra({cfg_src}, {name!r}, {default_src})"
-            candidates.append((_span(node, offsets), replacement))
+            candidates.append((_span(node, offsets), replacement, ("cfg_extra",)))
         elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load) \
                 and _is_extra_expr(node.value, extra_vars):
             if id(node) in stmt_position:
@@ -230,36 +265,57 @@ def _one_pass(source: str, relpath: str,
             # read with default None (missing key: KeyError -> None — the
             # deliberate semantics change documented in the module docstring)
             candidates.append(
-                (_span(node, offsets), f"cfg_extra({cfg_src}, {name!r}, None)"))
+                (_span(node, offsets), f"cfg_extra({cfg_src}, {name!r}, None)",
+                 ("cfg_extra",)))
         elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
                 and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
                 and _is_extra_expr(node.comparators[0], extra_vars):
-            skip(node, "'name in extra' membership test has no cfg_extra "
-                       "equivalent (present-but-None is distinct) — migrate by hand")
+            # membership test: becomes the dedicated registry-checked probe
+            # (cfg_extra_present keeps present-but-None distinct from absent,
+            # so the rewrite preserves the dict-membership semantics)
+            name = str_const(node.left)
+            if name is None:
+                skip(node, "membership test with a non-literal name — "
+                           "migrate by hand")
+                continue
+            cfg_src = _cfg_expr_of(node.comparators[0], assigned)
+            if cfg_src is None:
+                skip(node, f"{name!r} in extra: owning config object not "
+                           "recoverable — migrate by hand")
+                continue
+            repl = f"cfg_extra_present({cfg_src}, {name!r})"
+            if isinstance(node.ops[0], ast.NotIn):
+                # paren-wrapped so precedence survives any surrounding context
+                repl = f"(not {repl})"
+            candidates.append((_span(node, offsets), repl, ("cfg_extra_present",)))
 
     # outermost candidates only: an inner .get inside another's default arg
     # is regenerated by the outer rewrite and picked up on the next pass
     candidates.sort(key=lambda c: c[0][0])
-    chosen: list[tuple[tuple[int, int], str]] = []
+    chosen: list[tuple[tuple[int, int], str, tuple[str, ...]]] = []
     last_end = -1
-    for (start, end), repl in candidates:
+    for (start, end), repl, helpers in candidates:
         if start < last_end:
             continue
-        chosen.append(((start, end), repl))
+        chosen.append(((start, end), repl, helpers))
         last_end = end
 
     if not chosen:
         return source, 0, skipped
     out = source
-    for (start, end), repl in sorted(chosen, key=lambda c: c[0][0], reverse=True):
+    for (start, end), repl, _helpers in sorted(
+            chosen, key=lambda c: c[0][0], reverse=True):
         out = out[:start] + repl + out[end:]
-    if not has_import:
-        out = _insert_import(out)
+    used = {h for _, _, helpers in chosen for h in helpers}
+    missing = [h for h in HELPER_NAMES if h in used and h not in imported]
+    if missing:
+        out = _insert_import(out, missing)
     return out, len(chosen), skipped
 
 
-def _insert_import(source: str) -> str:
-    """Insert the cfg_extra import after the leading docstring/import block."""
+def _insert_import(source: str, names: "list[str] | None" = None) -> str:
+    """Insert the flags-helper import (only the names actually needed) after
+    the leading docstring/import block."""
     tree = ast.parse(source)
     insert_after = 0
     for i, stmt in enumerate(tree.body):
@@ -272,10 +328,12 @@ def _insert_import(source: str) -> str:
             insert_after = stmt.end_lineno or stmt.lineno
             continue
         break
+    line = (IMPORT_LINE if not names
+            else f"from {IMPORT_MODULE} import {', '.join(names)}")
     lines = source.splitlines(keepends=True)
     pos = sum(len(l) for l in lines[:insert_after])
     sep = "\n" if insert_after else ""
-    return source[:pos] + sep + IMPORT_LINE + "\n" + source[pos:]
+    return source[:pos] + sep + line + "\n" + source[pos:]
 
 
 def fix_source(source: str, relpath: str = "<string>",

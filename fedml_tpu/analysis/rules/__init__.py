@@ -14,6 +14,9 @@ from .gl006_tracer_branch import TracerBranchRule
 from .gl007_lock_order import LockOrderRule
 from .gl008_thread_races import ThreadRaceRule
 from .gl009_handlers import HandlerConformanceRule
+from .gl010_host_sync import HostSyncRule
+from .gl011_recompile import RecompileHazardRule
+from .gl012_durability import AtomicDurabilityRule
 
 ALL_RULES = [
     FlagRegistryRule,
@@ -25,8 +28,12 @@ ALL_RULES = [
     LockOrderRule,
     ThreadRaceRule,
     HandlerConformanceRule,
+    HostSyncRule,
+    RecompileHazardRule,
+    AtomicDurabilityRule,
 ]
 
 __all__ = ["ALL_RULES", "FlagRegistryRule", "JitPurityRule", "DonationSafetyRule",
            "LockDisciplineRule", "MetricNamespaceRule", "TracerBranchRule",
-           "LockOrderRule", "ThreadRaceRule", "HandlerConformanceRule"]
+           "LockOrderRule", "ThreadRaceRule", "HandlerConformanceRule",
+           "HostSyncRule", "RecompileHazardRule", "AtomicDurabilityRule"]

@@ -2,7 +2,10 @@
 
 Detected read idioms (all must name a flag declared in ``core/flags.py``):
 
-- ``cfg_extra(cfg, "name"[, default])`` — the blessed accessor;
+- ``cfg_extra(cfg, "name"[, default])`` — the blessed accessor — plus its
+  family: ``cfg_extra_present(cfg, "name")`` membership probes and
+  ``set_cfg_extra(cfg, "name", value)`` writes (all registry-checked, all
+  carrying the flag name at the second argument);
 - ``extra.get("name", ...)`` / ``extra.setdefault("name", ...)`` /
   ``extra["name"]`` / ``"name" in extra`` where the receiver is extra-like
   (a ``cfg.extra`` attribute, a ``getattr(cfg, "extra", ...)`` expression,
@@ -88,7 +91,11 @@ def _collect_reads(mod: ModuleInfo, declared: dict[str, int]) -> list[_ReadSite]
             continue
         if isinstance(node, ast.Call):
             fn = dotted_name(node.func)
-            if fn.split(".")[-1] == "cfg_extra" and len(node.args) >= 2:
+            if fn.split(".")[-1] in ("cfg_extra", "cfg_extra_present",
+                                     "set_cfg_extra") and len(node.args) >= 2:
+                # the accessor family: value read, membership probe, and the
+                # blessed write all take the flag name at args[1] and count
+                # as registry-checked uses (keeps written-only flags alive)
                 reads.append(_ReadSite(str_const(node.args[1]), node.lineno, legacy=False))
                 continue
             if isinstance(node.func, ast.Attribute) \

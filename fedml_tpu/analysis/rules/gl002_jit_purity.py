@@ -15,6 +15,18 @@ Flagged inside a traced function body:
   ``REGISTRY.counter/gauge/histogram``, or any ``REGISTRY.*`` chain;
 - ``global`` / ``nonlocal`` declarations (trace-time host mutation).
 
+Allowlisted (ISSUE 20 satellite) — instrumentation that is *deliberately*
+trace-time and mutates nothing observable by the program:
+
+- ``REGISTRY.get(...)`` — the read-only registry lookup the cost-model
+  join uses (``REGISTRY.get`` returns an existing family; it registers
+  nothing and increments nothing, so recording once at trace time is the
+  correct behavior, not a frozen side effect);
+- ``<...>profiler.note_program/maybe_start/maybe_stop(...)`` (and the
+  ``attributor`` spelling) — the profiler-window bookkeeping hooks; the
+  attribution pipeline is designed around at-trace-time notes keyed by
+  program name, so a note inside traced code is its intended use.
+
 The rule resolves the traced callable statically when it is a lambda, a
 local ``def`` in the enclosing scope, or a module-level ``def``; dynamic
 targets (``self._fn``, call results) are out of scope — the donation rule
@@ -38,6 +50,10 @@ _CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic", "time.sleep"
 _LOG_RECEIVERS = {"log", "logger", "logging"}
 _LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception", "critical"}
 _METRIC_METHODS = {"observe", "inc", "set", "labels"}
+#: deliberately trace-time instrumentation (see module docstring)
+_PROFILER_METHODS = {"note_program", "maybe_start", "maybe_stop"}
+_PROFILER_RECEIVERS = ("profiler", "attributor")
+_REGISTRY_READONLY = {"get"}
 
 
 def _is_jit_entry(fn_chain: str) -> bool:
@@ -67,9 +83,25 @@ class _ImpurityScan(ast.NodeVisitor):
     def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
         self.hits.append((node.lineno, f"`nonlocal {', '.join(node.names)}` mutation"))
 
+    def _is_allowlisted(self, node: ast.Call, chain: str, tail: str) -> bool:
+        # read-only registry lookup (cost-model join): registers/mutates nothing
+        if "REGISTRY." in chain and tail in _REGISTRY_READONLY:
+            return True
+        # profiler-window bookkeeping on a profiler/attributor receiver:
+        # at-trace-time notes are the attribution pipeline's intended use
+        if isinstance(node.func, ast.Attribute) and tail in _PROFILER_METHODS:
+            recv = dotted_name(node.func.value).lower()
+            return any(r in recv for r in _PROFILER_RECEIVERS)
+        return False
+
     def visit_Call(self, node: ast.Call) -> None:
         chain = dotted_name(node.func)
         tail = chain.rsplit(".", 1)[-1] if chain else ""
+        if self._is_allowlisted(node, chain, tail):
+            # allowlisted call itself is fine — but its ARGUMENTS still trace,
+            # so keep walking for impurities nested inside them
+            self.generic_visit(node)
+            return
         if chain in _CLOCK_CALLS or (chain and any(
                 chain.endswith("." + c) for c in _CLOCK_CALLS)):
             self.hits.append((node.lineno, f"host clock call {chain}()"))

@@ -1,0 +1,307 @@
+"""GL010 — host-sync: no implicit device→host transfers on hot round paths.
+
+The ROADMAP's device-resident-rounds work (``jit(scan)`` chunks with
+device-side double buffering) is only safe if the steady-state round loop
+provably contains no hidden host synchronizations — FedJAX's core lesson
+(PAPERS.md 2108.02117) is that TPU simulation speed lives or dies on
+keeping the round loop free of host round-trips.  This rule enforces the
+static half (TRACESAN, ``analysis/tracesan.py``, is the runtime half):
+inside functions *reachable from a hot-path root* it flags every
+construct that forces the device to materialize a value on the host:
+
+- ``float()`` / ``int()`` / ``bool()`` on a value produced by a jax
+  computation (each blocks on the device and ships one scalar);
+- ``.item()`` / ``np.asarray`` / ``np.array`` on a device value;
+- ``jax.device_get`` / ``.block_until_ready()`` anywhere on the hot path
+  — legitimate *annotated measurement sites* (the one chunk-end sync, the
+  round-boundary metric export) carry a suppression naming the invariant;
+- iterating a device value or branching/comparing on one (``if loss <
+  0.5:``) — both force materialization (``is None`` / ``is not None``
+  stay clean, they are structural).
+
+**Hot-path roots** live in :data:`HOT_PATH_ROOTS` — a registry keyed by
+path suffix naming the entry points of the steady-state loop: the
+simulator round/chunk functions, the population cohort round, the server
+fold/finalize path, and the serving batcher execute.  Reachability
+extends GL002/GL006's traced-callable resolution to host code: from each
+root, local calls (bare module-level functions and ``self.method``) are
+followed within the module; nested ``def``s are skipped (they are traced
+functions — GL002/GL006 territory).
+
+**Device-value taint** is the repo's own conventions, applied in source
+order: results of ``jnp.*`` / ``jax.*`` calls, and results of calling any
+``*_fn`` name (``self._round_fn``, ``pop.round_fn``, ``self._eval_fn``,
+a local ``fn`` — the package-wide naming convention for compiled
+programs).  ``jax.device_get`` results are HOST values — they untaint,
+so the post-sync metric unpacking loop stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name
+
+#: path suffix -> qualified names ("Class.method" or "function") that anchor
+#: the hot round path in that module.  New steady-state entry points (a
+#: device-resident cohort loop, a new serving execute path) register here.
+HOT_PATH_ROOTS: dict[str, set[str]] = {
+    "sim/engine.py": {
+        "MeshSimulator.run_rounds",
+        "MeshSimulator.run_round",
+        "MeshSimulator.evaluate",
+        "MeshSimulator._run_one_population_round",
+    },
+    "cross_silo/server.py": {
+        "FedMLAggregator.fold",
+        "FedMLAggregator.fold_partial",
+        "FedMLAggregator.ingest_streaming",
+        "FedMLAggregator.aggregate",
+    },
+    "cross_silo/async_server.py": {
+        "AsyncFedMLServerManager.handle_message_receive_model",
+        "AsyncFedMLServerManager._close_virtual_round",
+    },
+    "serving/batcher.py": {
+        "MicroBatcher._execute",
+    },
+}
+
+
+def register_hot_path(path_suffix: str, qualname: str) -> None:
+    """Extension point: add one hot-path root (used by out-of-tree engines
+    that want their round loop under the same contract)."""
+    HOT_PATH_ROOTS.setdefault(path_suffix, set()).add(qualname)
+
+
+#: dotted-chain prefixes whose call results live on device
+_PRODUCER_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "jax.random.",
+                      "jax.tree_util.", "jax.tree.")
+#: numpy materializers — a device argument forces a full transfer
+_NP_MATERIALIZERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                     "onp.asarray", "onp.array"}
+_SCALARIZERS = {"float", "int", "bool"}
+
+
+#: jax.* calls returning HOST metadata (treedefs), not device values —
+#: comparing/branching on them is structural, not a sync
+_HOST_METADATA_CALLS = {"jax.device_get", "jax.tree_util.tree_structure",
+                        "jax.tree.structure"}
+
+
+def _is_producer_chain(chain: str) -> bool:
+    if chain.startswith(_PRODUCER_PREFIXES):
+        return chain not in _HOST_METADATA_CALLS
+    tail = chain.rsplit(".", 1)[-1]
+    # the repo-wide convention: compiled programs are bound to *_fn names
+    return tail == "fn" or tail.endswith("_fn")
+
+
+def _collect_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    """qualname -> def for module-level functions and class methods."""
+    out: dict[str, ast.FunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[f"{node.name}.{sub.name}"] = sub
+    return out
+
+
+def _local_calls(fn: ast.FunctionDef, qualname: str,
+                 funcs: dict[str, ast.FunctionDef]) -> set[str]:
+    """Qualnames of same-module callees: bare names and ``self.method``."""
+    cls = qualname.rsplit(".", 1)[0] if "." in qualname else None
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in funcs:
+            out.add(f.id)
+        elif (cls and isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name) and f.value.id == "self"
+              and f"{cls}.{f.attr}" in funcs):
+            out.add(f"{cls}.{f.attr}")
+    return out
+
+
+class _HotScan:
+    """Source-order taint + sink scan over one hot-path function body."""
+
+    def __init__(self) -> None:
+        self.tainted: set[str] = set()
+        self.hits: list[tuple[int, str]] = []
+
+    # -- taint ---------------------------------------------------------------
+    def expr_taint(self, e: Optional[ast.AST]) -> bool:
+        if e is None:
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Call):
+            chain = dotted_name(e.func)
+            if chain == "jax.device_get" or chain.endswith(".device_get"):
+                return False  # explicit sync: result is a host value
+            if _is_producer_chain(chain):
+                return True
+            # method call on a tainted receiver (metrics.items(), x.astype())
+            if isinstance(e.func, ast.Attribute):
+                return self.expr_taint(e.func.value)
+            return False
+        if isinstance(e, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self.expr_taint(e.value)
+        if isinstance(e, ast.BinOp):
+            return self.expr_taint(e.left) or self.expr_taint(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.expr_taint(e.operand)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self.expr_taint(v) for v in e.elts)
+        if isinstance(e, ast.IfExp):
+            return self.expr_taint(e.body) or self.expr_taint(e.orelse)
+        if isinstance(e, ast.Compare):
+            # `loss < 0.5` over a device value is tainted (the comparison
+            # itself would have to materialize) — `is`/`is not` structural
+            # checks are filtered by _static_predicate at the branch sink
+            return self.expr_taint(e.left) or any(
+                self.expr_taint(c) for c in e.comparators)
+        if isinstance(e, ast.BoolOp):
+            return any(self.expr_taint(v) for v in e.values)
+        return False
+
+    def _taint_target(self, t: ast.AST, on: bool) -> None:
+        if isinstance(t, ast.Name):
+            (self.tainted.add if on else self.tainted.discard)(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._taint_target(el, on)
+        elif isinstance(t, ast.Starred):
+            self._taint_target(t.value, on)
+
+    # -- sinks ---------------------------------------------------------------
+    def _static_predicate(self, test: ast.AST) -> bool:
+        """`x is None` / `is not` comparisons are structural, not syncs."""
+        return (isinstance(test, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops))
+
+    def check_expr(self, e: Optional[ast.AST]) -> None:
+        if e is None:
+            return
+        for node in ast.walk(e):
+            # comprehension targets inherit the iterable's taint first, so
+            # `{k: float(v) for k, v in metrics.items()}` sees tainted v
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                for gen in node.generators:
+                    if self.expr_taint(gen.iter):
+                        self._taint_target(gen.target, True)
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_name(node.func)
+            tail = chain.rsplit(".", 1)[-1] if chain else ""
+            arg0 = node.args[0] if node.args else None
+            if chain == "jax.device_get" or chain.endswith(".device_get"):
+                self.hits.append((node.lineno,
+                                  "explicit host sync jax.device_get()"))
+            elif tail == "block_until_ready" and isinstance(node.func, ast.Attribute):
+                self.hits.append((node.lineno, ".block_until_ready() host sync"))
+            elif chain in _SCALARIZERS and len(node.args) == 1 \
+                    and self.expr_taint(arg0):
+                self.hits.append((node.lineno,
+                                  f"implicit device->host sync {chain}() on a "
+                                  "jax value"))
+            elif tail == "item" and isinstance(node.func, ast.Attribute) \
+                    and self.expr_taint(node.func.value):
+                self.hits.append((node.lineno,
+                                  ".item() forces a device->host transfer"))
+            elif chain in _NP_MATERIALIZERS and node.args \
+                    and self.expr_taint(arg0):
+                self.hits.append((node.lineno,
+                                  f"{chain}() materializes a device value on "
+                                  "the host"))
+
+    # -- statements ----------------------------------------------------------
+    def scan(self, body: list[ast.stmt]) -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested defs are traced functions — GL002's domain
+            if isinstance(st, ast.Assign):
+                self.check_expr(st.value)
+                on = self.expr_taint(st.value)
+                for t in st.targets:
+                    self._taint_target(t, on)
+            elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+                self.check_expr(st.value)
+                if st.value is not None:
+                    self._taint_target(st.target, self.expr_taint(st.value))
+            elif isinstance(st, ast.For):
+                self.check_expr(st.iter)
+                if self.expr_taint(st.iter):
+                    self.hits.append((st.lineno,
+                                      "iterating a device value forces "
+                                      "materialization"))
+                    self._taint_target(st.target, True)
+                self.scan(st.body)
+                self.scan(st.orelse)
+            elif isinstance(st, (ast.If, ast.While)):
+                self.check_expr(st.test)
+                if self.expr_taint(st.test) and not self._static_predicate(st.test):
+                    self.hits.append((st.lineno,
+                                      "branching/comparing on a device value "
+                                      "forces a host sync"))
+                self.scan(st.body)
+                self.scan(st.orelse)
+            elif isinstance(st, ast.With):
+                for item in st.items:
+                    self.check_expr(item.context_expr)
+                self.scan(st.body)
+            elif isinstance(st, ast.Try):
+                self.scan(st.body)
+                for h in st.handlers:
+                    self.scan(h.body)
+                self.scan(st.orelse)
+                self.scan(st.finalbody)
+            elif isinstance(st, (ast.Expr, ast.Return)):
+                self.check_expr(st.value)
+            elif isinstance(st, (ast.Raise, ast.Assert)):
+                self.check_expr(getattr(st, "exc", None) or getattr(st, "test", None))
+
+
+class HostSyncRule(Rule):
+    id = "GL010"
+    title = "implicit device->host sync on a hot round path"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        roots: set[str] = set()
+        for suffix, names in HOT_PATH_ROOTS.items():
+            if mod.relpath.endswith(suffix):
+                roots |= names
+        if not roots:
+            return []
+        funcs = _collect_functions(mod.tree)
+        reachable: set[str] = set()
+        frontier = [r for r in roots if r in funcs]
+        while frontier:
+            qn = frontier.pop()
+            if qn in reachable:
+                continue
+            reachable.add(qn)
+            frontier.extend(_local_calls(funcs[qn], qn, funcs) - reachable)
+        findings: list[Finding] = []
+        for qn in sorted(reachable):
+            scan = _HotScan()
+            scan.scan(funcs[qn].body)
+            for line, what in scan.hits:
+                findings.append(Finding(
+                    self.id, mod.relpath, line,
+                    f"{what} inside hot-path function {qn!r} (reachable from "
+                    f"a HOT_PATH_ROOTS entry) — keep the steady-state round "
+                    f"loop free of host round-trips; annotate deliberate "
+                    f"measurement sites with a suppression naming the "
+                    f"invariant",
+                    symbol=f"{qn}:L{line}"))
+        return findings

@@ -1,0 +1,218 @@
+"""GL011 — recompile-hazard: silent per-call retracing of jitted programs.
+
+XLA caches compiled programs by (callable identity, static argument
+values, argument avals).  Two idioms silently defeat the cache and turn
+the steady-state round loop into a compile loop:
+
+1. **Re-wrapping inside a loop body** — ``jax.jit(f)`` (or ``pjit`` /
+   ``lax.scan`` / ``pallas_call``) evaluated inside a ``for``/``while``
+   body creates a *fresh* wrapper object each iteration, so every
+   iteration traces and compiles from scratch.  Hoist the wrapper (or
+   memoize it, like ``MeshSimulator._multi_round_fns``).
+
+2. **Per-call-varying Python scalars reaching a jitted callable** — a
+   raw loop index, cohort size, ``len()`` of a growing structure, or a
+   wall-clock read passed positionally to a jitted function is hashed
+   into the static trace for weak types or retraces on every new value.
+   The disciplined forms are: convert at the callsite
+   (``jnp.int32(r)`` — a device scalar, one program), or declare the
+   argument static at the wrap site (``static_argnums`` /
+   ``static_argnames`` — each distinct value is a deliberate variant),
+   or bake it into a hashable ``functools.partial``.
+
+The rule resolves jitted callables the same way GL002 resolves traced
+ones: ``f = jax.jit(g, ...)`` assignments and ``@jax.jit`` /
+``@partial(jax.jit, ...)`` decorations, per scope.  A wrap that declares
+``static_argnums``/``static_argnames`` is treated as disciplined and its
+callsites are not checked (the approximation is documented: the rule
+checks discipline exists, not the exact position mapping).  *Varying*
+expressions are loop targets of enclosing ``for`` loops, names augmented
+inside a loop (``i += 1`` counters), and direct ``len(...)`` /
+``time.*()`` reads — plus any arithmetic over those.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name
+from .gl002_jit_purity import JIT_ENTRY_SUFFIXES, _is_jit_entry
+
+_TIME_CALLS = ("time.time", "time.perf_counter", "time.monotonic",
+               "time.process_time")
+_STATIC_KWARGS = {"static_argnums", "static_argnames"}
+
+
+def _wrap_chain(call: ast.Call) -> str:
+    """The jit-entry chain of a wrap call, seeing through
+    ``partial(jax.jit, ...)``."""
+    chain = dotted_name(call.func)
+    if chain.endswith("partial") and call.args:
+        inner = dotted_name(call.args[0])
+        if _is_jit_entry(inner):
+            return inner
+    return chain
+
+
+def _has_static_discipline(call: ast.Call) -> bool:
+    return any(kw.arg in _STATIC_KWARGS for kw in call.keywords)
+
+
+class _JittedNames:
+    """name -> has_static_discipline, for one lexical scope."""
+
+    def __init__(self) -> None:
+        self.names: dict[str, bool] = {}
+
+    def harvest(self, body: list[ast.stmt]) -> None:
+        for st in body:
+            if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
+                if _is_jit_entry(_wrap_chain(st.value)):
+                    disciplined = _has_static_discipline(st.value)
+                    for t in st.targets:
+                        if isinstance(t, ast.Name):
+                            self.names[t.id] = disciplined
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in st.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        if _is_jit_entry(_wrap_chain(dec)):
+                            self.names[st.name] = _has_static_discipline(dec)
+                    elif _is_jit_entry(dotted_name(dec)):
+                        self.names[st.name] = False
+
+
+class _FnScan:
+    """Per-function walk tracking loop nesting and varying names."""
+
+    def __init__(self, rule: "RecompileHazardRule", mod: ModuleInfo,
+                 jitted: dict[str, bool], fn_name: str):
+        self.rule = rule
+        self.mod = mod
+        self.jitted = jitted
+        self.fn_name = fn_name
+        self.varying: set[str] = set()
+        self.hits: list[tuple[int, str]] = []
+
+    def _is_varying(self, e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.varying
+        if isinstance(e, ast.Call):
+            chain = dotted_name(e.func)
+            if chain == "len":
+                return True
+            return chain in _TIME_CALLS or any(
+                chain.endswith("." + t) for t in _TIME_CALLS)
+        if isinstance(e, ast.BinOp):
+            return self._is_varying(e.left) or self._is_varying(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self._is_varying(e.operand)
+        return False
+
+    def _check_call(self, node: ast.Call, in_loop: bool) -> None:
+        chain = dotted_name(node.func)
+        if in_loop and _is_jit_entry(_wrap_chain(node)):
+            self.hits.append((node.lineno,
+                              f"{chain}(...) evaluated inside a loop body — a "
+                              "fresh wrapper compiles every iteration; hoist "
+                              "or memoize the wrapped program"))
+            return
+        if isinstance(node.func, ast.Name) and node.func.id in self.jitted:
+            if self.jitted[node.func.id]:
+                return  # static_argnums/static_argnames discipline declared
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if self._is_varying(arg):
+                    src = ast.unparse(arg) if hasattr(ast, "unparse") else "?"
+                    self.hits.append((node.lineno,
+                                      f"per-call-varying Python scalar "
+                                      f"`{src}` reaches jitted "
+                                      f"{node.func.id}() — every new value "
+                                      "retraces; pass it as a device scalar "
+                                      "(jnp.int32/asarray), declare it in "
+                                      "static_argnums/static_argnames, or "
+                                      "bind it via a hashable partial"))
+
+    def _taint_loop_target(self, t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            self.varying.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._taint_loop_target(el)
+
+    def _walk_expr(self, e: ast.AST, in_loop: bool) -> None:
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                self._check_call(node, in_loop)
+
+    def scan(self, body: list[ast.stmt], depth: int = 0) -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested scopes get their own _FnScan
+            if isinstance(st, ast.For):
+                self._walk_expr(st.iter, depth > 0)
+                self._taint_loop_target(st.target)
+                self.scan(st.body, depth + 1)
+                self.scan(st.orelse, depth)
+            elif isinstance(st, ast.While):
+                self._walk_expr(st.test, depth > 0)
+                self.scan(st.body, depth + 1)
+                self.scan(st.orelse, depth)
+            elif isinstance(st, ast.AugAssign):
+                if depth > 0 and isinstance(st.target, ast.Name):
+                    self.varying.add(st.target.id)  # loop counter
+                self._walk_expr(st.value, depth > 0)
+            else:
+                for e in ast.iter_child_nodes(st):
+                    if isinstance(e, (ast.expr, ast.withitem, ast.keyword)):
+                        self._walk_expr(e, depth > 0)
+                if isinstance(st, ast.If):
+                    self.scan(st.body, depth)
+                    self.scan(st.orelse, depth)
+                elif isinstance(st, ast.With):
+                    self.scan(st.body, depth)
+                elif isinstance(st, ast.Try):
+                    self.scan(st.body, depth)
+                    for h in st.handlers:
+                        self.scan(h.body, depth)
+                    self.scan(st.orelse, depth)
+                    self.scan(st.finalbody, depth)
+
+
+class RecompileHazardRule(Rule):
+    id = "GL011"
+    title = "jit/pjit/scan callsite recompiles on every call"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        seen: set[tuple[str, int, str]] = set()
+
+        def emit(fn_name: str, hits: list[tuple[int, str]]) -> None:
+            for line, what in hits:
+                key = (fn_name, line, what)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    self.id, mod.relpath, line,
+                    f"{what} (in {fn_name!r})",
+                    symbol=f"{fn_name}:L{line}"))
+
+        def walk_scope(body: list[ast.stmt], inherited: dict[str, bool],
+                       owner: str) -> None:
+            jn = _JittedNames()
+            jn.harvest(body)
+            scope = dict(inherited, **jn.names)
+            scan = _FnScan(self, mod, scope, owner)
+            scan.scan(body)
+            emit(owner, scan.hits)
+            for st in body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk_scope(st.body, scope, st.name)
+                elif isinstance(st, ast.ClassDef):
+                    for sub in st.body:
+                        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            walk_scope(sub.body, scope, f"{st.name}.{sub.name}")
+
+        walk_scope(mod.tree.body, {}, "<module>")
+        return findings

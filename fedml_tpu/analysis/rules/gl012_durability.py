@@ -1,0 +1,208 @@
+"""GL012 — atomic-durability: every commit record under a durability
+directory follows the tmp+fsync+``os.replace`` envelope.
+
+Every recovery proof in the repo (server journal, client journal, model
+publisher, AOT store, flight recorder, performance timeline) silently
+depends on one filesystem invariant: a reader sees an OLD record or a
+COMPLETE new one, never a torn write — and a record that survived
+``os.replace`` actually reached the platter (the payload was fsync'd
+before the rename).  A SIGKILL soak passing today does not prove the
+envelope holds tomorrow; this rule pins it statically:
+
+- **Direct writes under a durability directory** — ``open(path, 'w'/'a'/
+  'x'/'+')`` (or ``Path.write_text``/``write_bytes``) where ``path`` is
+  *dir-tainted* — is a finding: the envelope writes a ``tempfile.mkstemp``
+  sibling and renames.  Deliberate append-only logs (whose recovery drops
+  a torn tail) carry a suppression naming that invariant.
+- **``os.replace`` without a payload fsync** — any ``os.replace`` in a
+  function with no preceding ``os.fsync`` call: the rename orders
+  metadata, not data; after a crash the new name can point at zero-length
+  garbage.  This is unconditional (every ``os.replace`` in the package IS
+  a durability commit).
+
+**Dir taint** starts at the flag registry: literal ``*_dir`` flag names
+read through ``cfg_extra`` (``aot_programs_dir``, ``server_journal_dir``,
+``flight_dir``, ``timeline_dir``, ``model_publish_dir``, ...), plus
+function parameters whose name ends in ``_dir`` or is ``directory``, and
+``self.<attr>`` fields assigned from a tainted expression in ``__init__``.
+It propagates through ``os.path.join/abspath/fspath``, ``str()``, and
+``Path()``; ``tempfile.mkstemp(dir=tainted)`` results are NOT tainted —
+the mkstemp sibling is exactly the envelope's tmp file.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Sequence
+
+from ..engine import Finding, ModuleInfo, Rule, dotted_name, str_const
+from .gl001_flags import FLAGS_MODULE, declared_flags
+
+#: propagating path constructors: f(tainted, ...) stays tainted
+_PATH_PROPAGATORS = {"os.path.join", "os.path.abspath", "os.path.realpath",
+                     "os.fspath", "str", "Path", "pathlib.Path"}
+_WRITE_MODES = ("w", "a", "x", "+")
+_TAINT_PARAM_NAMES = {"directory"}
+
+
+def _is_write_mode(call: ast.Call) -> bool:
+    mode = None
+    if len(call.args) >= 2:
+        mode = str_const(call.args[1])
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = str_const(kw.value)
+    return mode is not None and any(c in mode for c in _WRITE_MODES)
+
+
+class _DirTaint:
+    """Source-order dir-path taint for one function body."""
+
+    def __init__(self, dir_flags: set[str], self_tainted: set[str]):
+        self.dir_flags = dir_flags
+        self.self_tainted = self_tainted  # tainted `self.<attr>` names
+        self.tainted: set[str] = set()
+
+    def expr(self, e: Optional[ast.AST]) -> bool:
+        if e is None:
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Attribute):
+            if isinstance(e.value, ast.Name) and e.value.id == "self":
+                return e.attr in self.self_tainted
+            return self.expr(e.value)
+        if isinstance(e, ast.Call):
+            chain = dotted_name(e.func)
+            if chain == "cfg_extra" and len(e.args) >= 2:
+                name = str_const(e.args[1])
+                return name is not None and (
+                    name in self.dir_flags or name.endswith("_dir"))
+            if chain in _PATH_PROPAGATORS or chain.endswith(".joinpath"):
+                return any(self.expr(a) for a in e.args) or any(
+                    self.expr(kw.value) for kw in e.keywords)
+            if chain.startswith("tempfile."):
+                return False  # the envelope's own tmp sibling
+            if isinstance(e.func, ast.Attribute):
+                # path methods on a tainted receiver (p / "x" is BinOp below)
+                return self.expr(e.func.value)
+            return False
+        if isinstance(e, ast.BinOp):  # str concat / Path "/" operator
+            return self.expr(e.left) or self.expr(e.right)
+        if isinstance(e, ast.JoinedStr):
+            return any(self.expr(v.value) for v in e.values
+                       if isinstance(v, ast.FormattedValue))
+        if isinstance(e, ast.Subscript):
+            return self.expr(e.value)
+        if isinstance(e, ast.IfExp):
+            return self.expr(e.body) or self.expr(e.orelse)
+        return False
+
+
+def _class_self_taint(cls: ast.ClassDef, dir_flags: set[str]) -> set[str]:
+    """``self.X`` attrs a ctor assigns from a dir-tainted expression."""
+    out: set[str] = set()
+    for node in cls.body:
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "__init__"):
+            continue
+        taint = _DirTaint(dir_flags, set())
+        for arg in node.args.args + node.args.kwonlyargs:
+            if arg.arg.endswith("_dir") or arg.arg in _TAINT_PARAM_NAMES:
+                taint.tainted.add(arg.arg)
+        for st in ast.walk(node):
+            if isinstance(st, ast.Assign) and taint.expr(st.value):
+                for t in st.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        out.add(t.attr)
+            elif isinstance(st, ast.Assign):
+                for t in st.targets:
+                    if isinstance(t, ast.Name) and taint.expr(st.value):
+                        taint.tainted.add(t.id)
+    return out
+
+
+class AtomicDurabilityRule(Rule):
+    id = "GL012"
+    title = "non-atomic write under a durability directory / os.replace without fsync"
+
+    def finalize(self, modules: Sequence[ModuleInfo]) -> Iterable[Finding]:
+        flags_mod = next(
+            (m for m in modules if m.relpath.endswith(FLAGS_MODULE)), None)
+        dir_flags = set()
+        if flags_mod is not None:
+            dir_flags = {n for n in declared_flags(flags_mod)
+                         if n.endswith("_dir")}
+        findings: list[Finding] = []
+        for mod in modules:
+            if mod.relpath.endswith(FLAGS_MODULE):
+                continue
+            findings.extend(self._check(mod, dir_flags))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check(self, mod: ModuleInfo, dir_flags: set[str]) -> list[Finding]:
+        findings: list[Finding] = []
+
+        def scan_fn(fn: ast.FunctionDef, qual: str, self_taint: set[str]) -> None:
+            taint = _DirTaint(dir_flags, self_taint)
+            for arg in fn.args.args + fn.args.kwonlyargs:
+                if arg.arg.endswith("_dir") or arg.arg in _TAINT_PARAM_NAMES:
+                    taint.tainted.add(arg.arg)
+            fsync_lines: list[int] = []
+            sites: list[tuple[int, str]] = []
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node is not fn:
+                    continue
+                if isinstance(node, ast.Assign) and taint.expr(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            taint.tainted.add(t.id)
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = dotted_name(node.func)
+                if chain == "os.fsync" or chain.endswith(".fsync"):
+                    fsync_lines.append(node.lineno)
+                elif chain == "os.replace" or chain == "os.rename":
+                    sites.append((node.lineno, "replace"))
+                elif chain in ("open", "io.open") and node.args \
+                        and taint.expr(node.args[0]) and _is_write_mode(node):
+                    sites.append((node.lineno, "open"))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("write_text", "write_bytes") \
+                        and taint.expr(node.func.value):
+                    sites.append((node.lineno, "open"))
+            for line, kind in sites:
+                if kind == "replace":
+                    if not any(fl < line for fl in fsync_lines):
+                        findings.append(Finding(
+                            self.id, mod.relpath, line,
+                            f"os.replace in {qual!r} with no preceding "
+                            "os.fsync of the payload — the rename orders "
+                            "metadata, not data; a crash can leave the new "
+                            "name pointing at a torn record.  fsync the tmp "
+                            "file before renaming",
+                            symbol=f"{qual}:replace:L{line}"))
+                else:
+                    findings.append(Finding(
+                        self.id, mod.relpath, line,
+                        f"direct write under a durability directory in "
+                        f"{qual!r} — readers can observe a torn record; use "
+                        "the tmp+fsync+os.replace envelope (tempfile.mkstemp "
+                        "sibling, os.fsync, os.replace).  Append-only logs "
+                        "whose recovery tolerates a torn tail carry a "
+                        "suppression naming that invariant",
+                        symbol=f"{qual}:write:L{line}"))
+
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_fn(node, node.name, set())
+            elif isinstance(node, ast.ClassDef):
+                self_taint = _class_self_taint(node, dir_flags)
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        scan_fn(sub, f"{node.name}.{sub.name}", self_taint)
+        return findings

@@ -411,7 +411,7 @@ def cmd_obs(args) -> int:
 # -- lint (analysis/: AST invariant checker, tier-1-enforced) ----------------
 
 def cmd_lint(args) -> int:
-    """Run the GL001-GL009 static invariant rules over a package tree.
+    """Run the GL001-GL012 static invariant rules over a package tree.
 
     Exit 0 = clean (counting inline suppressions and the baseline),
     1 = unsuppressed findings or unparseable files.  Deliberately imports no
@@ -628,7 +628,7 @@ def main(argv=None) -> int:
                             "render as an attribution table")
     p.set_defaults(fn=cmd_obs)
 
-    p = sub.add_parser("lint", help="AST invariant checker (GL001-GL009) over fedml_tpu/")
+    p = sub.add_parser("lint", help="AST invariant checker (GL001-GL012) over fedml_tpu/")
     p.add_argument("path", nargs="?", default="",
                    help="package dir or single .py file (default: the installed fedml_tpu package)")
     p.add_argument("--baseline", default="",
@@ -636,9 +636,9 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings into the baseline instead of failing")
     p.add_argument("--fix", action="store_true",
-                   help="mechanically rewrite legacy extra.get(...) and "
-                        "value-position extra.setdefault(...) reads to "
-                        "cfg_extra(cfg, name, default) before linting")
+                   help="mechanically rewrite legacy extra idioms to the "
+                        "registry helpers (cfg_extra / cfg_extra_present / "
+                        "set_cfg_extra) before linting")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.set_defaults(fn=cmd_lint)
 

@@ -41,6 +41,8 @@ from typing import Any, Iterator, Optional
 
 import numpy as np
 
+from ..analysis import tracesan
+
 WIRE_VERSION = 1
 WIRE_VERSION_V2 = 2
 
@@ -154,18 +156,22 @@ def _prepare_frame(tree: Any) -> tuple:
     specs: list[dict] = []
     buffers: list = []
     compressed = False
-    for leaf in leaves:
-        if isinstance(leaf, CompressedLeaf):
-            compressed = True
-            specs.append(leaf.spec())
-            buffers.extend(_raw_view(s) for s in leaf.segments)
-        else:
-            # NOTE: spec shape from np.asarray, NOT ascontiguousarray — the
-            # latter promotes 0-d scalars to (1,) and would change v1 bytes
-            a = np.asarray(leaf)
-            specs.append({"dtype": a.dtype.str, "shape": list(a.shape),
-                          "nbytes": int(a.nbytes)})
-            buffers.append(_raw_view(a))
+    with tracesan.allow("wire_encode"):
+        # device leaves materialize here (np.asarray is the d2h): the wire
+        # boundary is THE legitimate host crossing of the upload path
+        for leaf in leaves:
+            if isinstance(leaf, CompressedLeaf):
+                compressed = True
+                specs.append(leaf.spec())
+                buffers.extend(_raw_view(s) for s in leaf.segments)
+            else:
+                # NOTE: spec shape from np.asarray, NOT ascontiguousarray —
+                # the latter promotes 0-d scalars to (1,) and would change
+                # v1 bytes
+                a = np.asarray(leaf)
+                specs.append({"dtype": a.dtype.str, "shape": list(a.shape),
+                              "nbytes": int(a.nbytes)})
+                buffers.append(_raw_view(a))
     if compressed:
         for spec in specs:
             spec.setdefault("codec", "raw")

@@ -479,6 +479,8 @@ class ProgramStore:
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)  # atomic: readers see old or complete new
         except OSError as e:
             log.warning("aot: could not persist %s (%s) — program stays "

@@ -18,6 +18,8 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from ..analysis import tracesan
+
 log = logging.getLogger("fedml_tpu.core.checkpoint")
 
 
@@ -36,7 +38,8 @@ class RoundCheckpointer:
 
     def save(self, round_idx: int, state: dict) -> None:
         """state: pytree dict (global_vars, server_state, client_states, key...)."""
-        state = jax.device_get(state)
+        with tracesan.allow("checkpoint"):
+            state = jax.device_get(state)
         try:
             self.mngr.save(round_idx, args=self._ocp.args.StandardSave(state))
         except ValueError:

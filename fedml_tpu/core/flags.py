@@ -27,7 +27,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Optional
 
-__all__ = ["FlagSpec", "FLAGS", "cfg_extra", "render_flag_reference"]
+__all__ = ["FlagSpec", "FLAGS", "cfg_extra", "cfg_extra_present",
+           "set_cfg_extra", "render_flag_reference"]
 
 
 @dataclass(frozen=True)
@@ -513,6 +514,42 @@ def cfg_extra(cfg, name: str, default: Any = _UNSET) -> Any:
         extra = getattr(cfg, "extra", None) or {}
         value = extra.get(name, _UNSET)  # graftlint: disable=GL001(the accessor itself)
     return fallback if value is _UNSET else value
+
+
+def cfg_extra_present(cfg, name: str) -> bool:
+    """Registry-checked membership: is the declared flag ``name`` explicitly
+    SET on ``cfg``?  The value-resolution twin of :func:`cfg_extra` for the
+    ``"name" in cfg.extra`` idiom — it follows the same resolution order (a
+    direct attribute counts as set, then the ``extra`` dict), and unlike
+    ``cfg_extra`` it keeps present-but-``None`` distinct from absent.
+
+    Raises ``KeyError`` for undeclared names, exactly like :func:`cfg_extra`.
+    """
+    if name not in FLAGS:
+        raise KeyError(
+            f"undeclared extra flag {name!r} — declare it in fedml_tpu/core/flags.py")
+    if cfg is None:
+        return False
+    if getattr(cfg, name, _UNSET) is not _UNSET:
+        return True
+    extra = getattr(cfg, "extra", None) or {}
+    return name in extra  # graftlint: disable=GL001(the membership accessor itself)
+
+
+def set_cfg_extra(cfg, name: str, value: Any) -> Any:
+    """Registry-checked WRITE of the declared flag ``name`` into
+    ``cfg.extra`` (the one blessed mutation idiom — harness code seeding a
+    flag for downstream readers).  Returns ``value`` so assignments can
+    chain.  Raises ``KeyError`` for undeclared names."""
+    if name not in FLAGS:
+        raise KeyError(
+            f"undeclared extra flag {name!r} — declare it in fedml_tpu/core/flags.py")
+    extra = getattr(cfg, "extra", None)
+    if extra is None:
+        extra = {}
+        cfg.extra = extra
+    extra[name] = value
+    return value
 
 
 def render_flag_reference() -> str:

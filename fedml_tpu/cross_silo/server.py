@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..algorithms import create as create_algorithm, hparams_from_config
+from ..analysis import tracesan
 from ..comm import codecs, wire
 from ..comm.comm_manager import FedMLCommManager
 from ..comm.message import Message
@@ -220,7 +221,7 @@ class FedMLAggregator:
     # -- receive-side bookkeeping -------------------------------------------
     def _host_global(self):
         if self._np_global is None:
-            self._np_global = jax.device_get(self.global_vars)
+            self._np_global = jax.device_get(self.global_vars)  # graftlint: disable=GL010(wire-ingest boundary: delta uploads reconstruct against a host copy of the global, cached once per round — one device_get per round, not per client)
         return self._np_global
 
     def _stream_template(self):
@@ -287,8 +288,11 @@ class FedMLAggregator:
         # dense fallbacks) — the quantity the <=2 acceptance bound tracks
         self._note_buffered(inflight=1)
         w = float(sample_num) * float(scale)
-        for i, _spec, arr in leaf_iter:
-            self._stream_acc.fold_leaf(i, w, arr)
+        with tracesan.allow("fold_ingest"):
+            # wire hands numpy views: each fold_leaf is a legitimate
+            # (annotated) host->device upload of one decoded leaf
+            for i, _spec, arr in leaf_iter:
+                self._stream_acc.fold_leaf(i, w, arr)
         self._stream_w += w
         if is_delta:
             self._stream_w_delta += w
@@ -356,8 +360,9 @@ class FedMLAggregator:
             self._stream_acc = make_stream_accumulator(
                 tmpl, sharded=self._shard_fold, mesh=self._mesh)
         self._note_buffered(inflight=1)
-        for i, _spec, arr in leaf_iter:
-            self._stream_acc.fold_partial_leaf(i, arr)
+        with tracesan.allow("fold_ingest"):
+            for i, _spec, arr in leaf_iter:
+                self._stream_acc.fold_partial_leaf(i, arr)
         self._stream_w += sum(fresh.values())
         self._stream_w_delta += float(w_delta)
         self._stream_folded += 1

@@ -25,6 +25,7 @@ from . import registry as obsreg
 #: Keep sorted; the lint-adjacent guarantee is the generator's stderr check,
 #: not this list's completeness by inspection.
 _REGISTERING_MODULES = (
+    "fedml_tpu.analysis.tracesan",
     "fedml_tpu.comm.base",
     "fedml_tpu.comm.chaos",
     "fedml_tpu.comm.codecs",
@@ -79,6 +80,7 @@ _SECTIONS = {
     "sim": "Simulation engine",
     "slo": "SLO watchdog",
     "timeline": "Performance timeline",
+    "tracesan": "Runtime trace sanitizer",
 }
 
 

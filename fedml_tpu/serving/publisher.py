@@ -115,6 +115,8 @@ class ModelPublisher:
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)  # readers see old or complete new
         except OSError:
             with contextlib.suppress(OSError):

@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import constants as C
 from ..algorithms import create as create_algorithm, hparams_from_config
+from ..analysis import tracesan
 from ..arguments import Config
 from ..core import aot as aotlib, pytree as pt, rng
 from ..core.flags import cfg_extra
@@ -205,7 +206,12 @@ class MeshSimulator(RoundCheckpointMixin):
                 counts=meshlib.pad_leading_axis_np(stacked.counts, self._n_pad),
             )
         self._data = self._place_data(stacked)
-        self.counts = jnp.asarray(stacked.counts)
+        # replicate ONCE at init: a bare jnp.asarray stays single-device and
+        # every mesh dispatch would re-reshard it device-to-device per call
+        # (witnessed by TRACESAN's round guard)
+        self.counts = (jnp.asarray(stacked.counts)
+                       if self.backend == C.SIMULATION_BACKEND_SP
+                       else meshlib.replicate(stacked.counts, self.mesh))
 
         # ---- model/state init ----
         k0 = rng.root_key(cfg.random_seed)
@@ -248,7 +254,9 @@ class MeshSimulator(RoundCheckpointMixin):
         self._otlp = obsotlp.exporter_from_config(cfg)
         self._otlp_sink = self._otlp.enqueue_span if self._otlp is not None else None
 
-        self.root_key = k0
+        # replicated at init for the same reason as counts: the key is a
+        # per-dispatch argument of every mesh round program
+        self.root_key = self._stage_scalar(k0)
         self.round_idx = 0
         # history for cross-round defenses: flat global delta of the previous
         # round, threaded through the jitted round as a real argument (a
@@ -579,8 +587,10 @@ class MeshSimulator(RoundCheckpointMixin):
             )
         with traced("sim.population_round", round_idx=r, cohort=pop.m,
                     sink=self._otlp_sink):
-            gv, ss, new_cs, nd, metrics = pop.round_fn(*args)
-            host = {k: float(v) for k, v in metrics.items()}  # syncs
+            with tracesan.round_guard(r):
+                gv, ss, new_cs, nd, metrics = pop.round_fn(*args)
+            with tracesan.allow("round_metrics"):
+                host = {k: float(v) for k, v in metrics.items()}  # graftlint: disable=GL010(annotated measurement site: round-boundary metric export — one scalar-dict sync per cohort round, behind the TRACESAN round_metrics allowlist)
         if new_cs is not None:
             pop.store.scatter_state(ids, new_cs)
         self.global_vars, self.server_state = gv, ss
@@ -710,6 +720,16 @@ class MeshSimulator(RoundCheckpointMixin):
         self._multi_round_fns[n] = fn
         return fn
 
+    def _stage_scalar(self, x):
+        """Explicitly place a per-round host scalar with the replicated
+        sharding the compiled programs expect.  Staging it deliberately (an
+        explicit ``device_put``, outside the TRACESAN round guard) keeps the
+        dispatch itself transfer-free — a bare ``jnp.int32`` lands on one
+        device and every mesh dispatch re-reshards it device-to-device."""
+        if self.backend == C.SIMULATION_BACKEND_SP:
+            return x
+        return jax.device_put(x, meshlib.replicated(self.mesh))
+
     def run_rounds(self, n: int) -> list[dict]:
         """Run ``n`` rounds as one compiled program (mesh backend); falls back
         to the host loop per round on the SP backend.  Returns one metrics
@@ -733,7 +753,8 @@ class MeshSimulator(RoundCheckpointMixin):
         args = (
             self.global_vars, self.server_state, self.client_states,
             self.counts, self._data[0], self._data[1],
-            jnp.int32(self.round_idx), self.root_key, self.defense_history,
+            self._stage_scalar(jnp.int32(self.round_idx)), self.root_key,
+            self.defense_history,
         )
         fn = self._get_multi_round_fn(n, example_args=args)
         if self.profiler is not None:
@@ -742,8 +763,10 @@ class MeshSimulator(RoundCheckpointMixin):
         try:
             with traced("sim.chunk", rounds=n, start_round=self.round_idx,
                         sink=self._otlp_sink):
-                gv, ss, cs, nd, stacked = fn(*args)
-                host = jax.device_get(stacked)  # the single host sync for the chunk
+                with tracesan.round_guard(self.round_idx, rounds=n):
+                    gv, ss, cs, nd, stacked = fn(*args)
+                with tracesan.allow("round_metrics"):
+                    host = jax.device_get(stacked)  # graftlint: disable=GL010(annotated measurement site: THE single explicit host sync for the whole scanned chunk — n rounds of stacked metrics in one transfer)
         except Exception as e:
             if self.profiler is not None:
                 self.profiler.finalize()  # keep the trace of the failing chunk
@@ -779,16 +802,21 @@ class MeshSimulator(RoundCheckpointMixin):
         if self.backend == C.SIMULATION_BACKEND_SP:
             metrics = self._run_round_sp(r)
         else:
-            gv, ss, cs, nd, metrics = self._round_fn(
-                self.global_vars, self.server_state, self.client_states,
-                self.counts, self._data[0], self._data[1],
-                jnp.int32(r), self.root_key, self.defense_history,
-            )
+            # staged OUTSIDE the guard: uploading the round index is an
+            # explicit (and replicated — see _stage_scalar) h2d per round
+            r_dev = self._stage_scalar(jnp.int32(r))
+            with tracesan.round_guard(r):
+                gv, ss, cs, nd, metrics = self._round_fn(
+                    self.global_vars, self.server_state, self.client_states,
+                    self.counts, self._data[0], self._data[1],
+                    r_dev, self.root_key, self.defense_history,
+                )
             self.global_vars, self.server_state, self.client_states = gv, ss, cs
             if nd is not None:
                 self.defense_history = nd
         self.round_idx += 1
-        return {k: float(v) for k, v in metrics.items()}
+        with tracesan.allow("round_metrics"):
+            return {k: float(v) for k, v in metrics.items()}  # graftlint: disable=GL010(annotated measurement site: single-round entry point syncs its own metric dict — the chunked path run_rounds amortizes this to one sync per chunk)
 
     def _run_round_sp(self, r: int) -> dict:
         """Sequential reference twin: same sampling, same per-client keys, same
@@ -834,7 +862,7 @@ class MeshSimulator(RoundCheckpointMixin):
         t0 = time.perf_counter()
         with traced("sim.eval", round_idx=self.round_idx, sink=self._otlp_sink):
             res = self._eval_fn(self.global_vars, *self._test)
-            out = {k: float(v) for k, v in res.items()}  # float() syncs
+            out = {k: float(v) for k, v in res.items()}  # graftlint: disable=GL010(annotated measurement site: evaluation runs OFF the round loop at frequency_of_the_test cadence — its scalar sync never sits on the steady-state path)
         EVAL_TIME.observe(time.perf_counter() - t0)
         return out
 
@@ -864,7 +892,7 @@ class MeshSimulator(RoundCheckpointMixin):
         self.round_idx = int(state["round_idx"])
         # the checkpointed RNG key is authoritative (guards against a drifted
         # --random_seed silently changing the sampling stream mid-run)
-        self.root_key = jnp.asarray(state["root_key"])
+        self.root_key = self._stage_scalar(jnp.asarray(state["root_key"]))
         if "client_states" in state:
             cs = meshlib.pad_leading_axis_np(state["client_states"], self._n_pad)
             self.client_states = meshlib.shard_leading_axis(cs, self.mesh)

@@ -25,6 +25,15 @@ from fedml_tpu.analysis.sanitizer import maybe_install_from_env
 
 maybe_install_from_env()
 
+# Runtime trace sanitizer (ISSUE 20): FEDML_TPU_TRACESAN=1 activates the
+# transfer/compile guard (jax.transfer_guard around steady-state rounds +
+# a jax.monitoring compile listener) before any round code runs.  Strict
+# no-op when the env var is unset — install() is the only path that
+# imports jax from the module.
+from fedml_tpu.analysis.tracesan import maybe_install_from_env as _tracesan_env
+
+_tracesan_env()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -58,6 +67,11 @@ def pytest_configure(config):
         "markers",
         "locksan: threaded e2e included in the runtime lock-sanitizer gate "
         "(test_sanitizer re-runs `-m locksan` under FEDML_TPU_LOCKSAN=1)")
+    config.addinivalue_line(
+        "markers",
+        "tracesan: steady-state round e2e included in the runtime trace-"
+        "sanitizer gate (test_tracesan re-runs `-m tracesan` under "
+        "FEDML_TPU_TRACESAN=1)")
 
 
 @pytest.fixture(scope="session")

@@ -85,7 +85,7 @@ def test_fix_skips_manual_sites_and_suppressions(tmp_path):
         def f(cfg, name):
             cfg.extra.setdefault(name, 3)  # non-literal name: manual
             cfg.extra["seg_base"]  # statement-position subscript: no value use
-            c = "silo_dp" in cfg.extra
+            c = name in cfg.extra  # non-literal membership: manual
             d = cfg.extra.get(name)
             e = cfg.extra[name]
             return c, d, e
@@ -100,15 +100,16 @@ def test_fix_skips_manual_sites_and_suppressions(tmp_path):
     assert (tmp_path / "mod.py").read_text() == before  # untouched
     notes = "\n".join(res.skipped)
     assert "setdefault" in notes and "statement-position extra[...]" in notes
-    assert "membership test" in notes
+    assert "membership test with a non-literal name" in notes
     assert notes.count("literal flag name") == 2  # .get(name) + extra[name]
     assert "fused_blocks" not in notes  # suppressed site: no nag either
 
 
 def test_fix_rewrites_value_position_subscript(tmp_path):
     """ISSUE 12 satellite: value-position ``extra["k"]`` reads become
-    ``cfg_extra(cfg, 'k', None)``; statement-position reads and write
-    targets stay untouched."""
+    ``cfg_extra(cfg, 'k', None)``.  Statement-position reads stay report-
+    only; single-target stores now rewrite to ``set_cfg_extra`` (ISSUE 20
+    satellite) with only the helpers actually used imported."""
     src = textwrap.dedent('''
         def f(cfg):
             a = cfg.extra["mlp_hidden"]
@@ -117,16 +118,17 @@ def test_fix_rewrites_value_position_subscript(tmp_path):
             if cfg.extra["fused_blocks"]:
                 a += 1
             cfg.extra["comm_topk_ratio"]  # statement position: report-only
-            cfg.extra["mlp_hidden"] = 3   # write target: untouched
+            cfg.extra["mlp_hidden"] = 3   # write target: blessed-write rewrite
             return a, b
     ''')
     fixed, n, skipped = fix_source(src, "mod.py")
-    assert n == 3, fixed
+    assert n == 4, fixed
     assert "cfg_extra(cfg, 'mlp_hidden', None)" in fixed
     assert "cfg_extra(cfg, 'silo_dp', None)" in fixed
     assert "cfg_extra(cfg, 'fused_blocks', None)" in fixed
     assert 'cfg.extra["comm_topk_ratio"]' in fixed  # statement form survives
-    assert 'cfg.extra["mlp_hidden"] = 3' in fixed   # store ctx survives
+    assert "set_cfg_extra(cfg, 'mlp_hidden', 3)" in fixed  # store: rewritten
+    assert "from fedml_tpu.core.flags import cfg_extra, set_cfg_extra" in fixed
     assert any("statement-position extra[...]" in s for s in skipped)
     compile(fixed, "mod.py", "exec")
     again, n2, _ = fix_source(fixed, "mod.py")
@@ -174,10 +176,10 @@ def test_fix_rewrites_value_position_setdefault(tmp_path):
     assert "cfg_extra(cfg, 'mlp_hidden', 64)" in fixed
     assert "cfg_extra(cfg, 'silo_dp', None)" in fixed
     assert "cfg_extra(cfg, 'fused_blocks', False)" in fixed
-    # the statement-position seed becomes an explicit assignment through
-    # the registry-checked read
-    assert ("cfg.extra['comm_topk_ratio'] = "
-            "cfg_extra(cfg, 'comm_topk_ratio', 0.1)") in fixed
+    # the statement-position seed becomes an explicit seed through the
+    # registry-checked write (ISSUE 20: set_cfg_extra replaces the raw store)
+    assert ("set_cfg_extra(cfg, 'comm_topk_ratio', "
+            "cfg_extra(cfg, 'comm_topk_ratio', 0.1))") in fixed
     assert skipped == []
     compile(fixed, "mod.py", "exec")
     again, n2, _ = fix_source(fixed, "mod.py")
@@ -185,10 +187,12 @@ def test_fix_rewrites_value_position_setdefault(tmp_path):
 
 
 def test_fix_rewrites_statement_position_setdefault():
-    """ISSUE 19 satellite: a statement-position ``extra.setdefault(k, v)``
-    (pure dict seeding for raw downstream readers) is rewritten to
-    ``extra['k'] = cfg_extra(cfg, 'k', v)`` — seeded dict preserved, flag
-    name declared and GL001-checked — and the rewrite is idempotent."""
+    """ISSUE 19 satellite (write half upgraded by ISSUE 20): a statement-
+    position ``extra.setdefault(k, v)`` (pure dict seeding for raw
+    downstream readers) is rewritten to
+    ``set_cfg_extra(cfg, 'k', cfg_extra(cfg, 'k', v))`` — seeded dict
+    preserved, flag name declared and GL001-checked on both halves — and
+    the rewrite is idempotent."""
     src = textwrap.dedent('''
         def seed(cfg):
             cfg.extra.setdefault("mlp_hidden", 64)
@@ -199,11 +203,12 @@ def test_fix_rewrites_statement_position_setdefault():
     fixed, n, skipped = fix_source(src, "mod.py")
     assert n == 2, fixed
     assert skipped == []
-    assert ("cfg.extra['mlp_hidden'] = "
-            "cfg_extra(cfg, 'mlp_hidden', 64)") in fixed
-    # the local-alias receiver keeps its own spelling; the no-default form
-    # seeds the explicit None that setdefault() would have
-    assert "extra['silo_dp'] = cfg_extra(cfg, 'silo_dp', None)" in fixed
+    assert ("set_cfg_extra(cfg, 'mlp_hidden', "
+            "cfg_extra(cfg, 'mlp_hidden', 64))") in fixed
+    # the no-default form seeds the explicit None that setdefault() would have
+    assert ("set_cfg_extra(cfg, 'silo_dp', "
+            "cfg_extra(cfg, 'silo_dp', None))") in fixed
+    assert "from fedml_tpu.core.flags import cfg_extra, set_cfg_extra" in fixed
     compile(fixed, "mod.py", "exec")
     again, n2, again_skipped = fix_source(fixed, "mod.py")
     assert n2 == 0 and again == fixed and again_skipped == []  # idempotent
@@ -248,6 +253,80 @@ def test_fix_setdefault_semantics_match_on_value_use():
     for extra in ({}, {"mlp_hidden": 256}):
         assert (orig_ns["f"](Config(dataset="synthetic", model="lr", extra=dict(extra)))
                 == fixed_ns["f"](Config(dataset="synthetic", model="lr", extra=dict(extra))))
+
+
+def test_fix_rewrites_membership_tests():
+    """ISSUE 20 satellite: value-position ``"k" in extra`` / ``not in``
+    membership tests become ``cfg_extra_present(cfg, 'k')`` (the ``not in``
+    form paren-wrapped), and only the helper actually used is imported."""
+    src = textwrap.dedent('''
+        def f(cfg):
+            a = "mlp_hidden" in cfg.extra
+            extra = cfg.extra
+            b = "silo_dp" not in extra
+            if "fused_blocks" in (getattr(cfg, "extra", {}) or {}):
+                a = not a
+            return a, b
+    ''')
+    fixed, n, skipped = fix_source(src, "mod.py")
+    assert n == 3, fixed
+    assert skipped == []
+    assert "from fedml_tpu.core.flags import cfg_extra_present" in fixed
+    assert "a = cfg_extra_present(cfg, 'mlp_hidden')" in fixed
+    assert "b = (not cfg_extra_present(cfg, 'silo_dp'))" in fixed
+    assert "if cfg_extra_present(cfg, 'fused_blocks'):" in fixed
+    compile(fixed, "mod.py", "exec")
+    again, n2, _ = fix_source(fixed, "mod.py")
+    assert n2 == 0 and again == fixed  # idempotent
+
+
+def test_fix_membership_exec_semantics():
+    """Exec'd before/after: membership agrees set/unset, including the
+    present-but-None key the probe exists to keep distinct from absent."""
+    from fedml_tpu.arguments import Config
+
+    src = textwrap.dedent('''
+        def f(cfg):
+            return "mlp_hidden" in cfg.extra, "silo_dp" not in cfg.extra
+    ''')
+    fixed, n, _ = fix_source(src, "mod.py")
+    assert n == 2
+    orig_ns, fixed_ns = {}, {}
+    exec(compile(src, "o.py", "exec"), orig_ns)
+    exec(compile(fixed, "f.py", "exec"), fixed_ns)
+    for extra in ({}, {"mlp_hidden": 256}, {"mlp_hidden": None},
+                  {"mlp_hidden": 0, "silo_dp": False}):
+        cfg = Config(dataset="synthetic", model="lr", extra=dict(extra))
+        assert fixed_ns["f"](cfg) == orig_ns["f"](cfg), extra
+
+
+def test_fix_store_exec_semantics():
+    """Exec'd before/after: the ``set_cfg_extra`` rewrite lands the same
+    dict contents a raw subscript store would, and is idempotent."""
+    from fedml_tpu.arguments import Config
+
+    src = textwrap.dedent('''
+        def seed(cfg, v):
+            cfg.extra["mlp_hidden"] = v
+            extra = cfg.extra
+            extra["silo_dp"] = v * 2
+            return cfg.extra
+    ''')
+    fixed, n, _ = fix_source(src, "mod.py")
+    assert n == 2, fixed
+    assert "set_cfg_extra(cfg, 'mlp_hidden', v)" in fixed
+    assert "set_cfg_extra(cfg, 'silo_dp', v * 2)" in fixed
+    orig_ns, fixed_ns = {}, {}
+    exec(compile(src, "o.py", "exec"), orig_ns)
+    exec(compile(fixed, "f.py", "exec"), fixed_ns)
+    for v in (3, 0):
+        got_orig = dict(orig_ns["seed"](
+            Config(dataset="synthetic", model="lr", extra={}), v))
+        got_fixed = dict(fixed_ns["seed"](
+            Config(dataset="synthetic", model="lr", extra={}), v))
+        assert got_orig == got_fixed == {"mlp_hidden": v, "silo_dp": v * 2}
+    again, n2, _ = fix_source(fixed, "mod.py")
+    assert n2 == 0 and again == fixed  # idempotent
 
 
 def test_fixed_package_is_gl001_legacy_clean(tmp_path):

@@ -36,13 +36,21 @@ def test_cnn_zoo_forward_and_grad(model_name, eight_devices):
     assert sum(n > 0 for n in norms) > len(norms) // 2  # gradients actually flow
 
 
+@pytest.mark.slow
 def test_cnn_zoo_trains_one_fl_round(eight_devices):
     """mobilenet runs an end-to-end FedAvg round (registration is real, not
     just a forward pass).  SP backend: the vmapped-mesh mobilenet round is a
     ~6-minute CPU compile that defeats the persistent cache (CPU AOT
     machine-feature rejection on large entries); SP runs the identical
     model/trainer code through the identical server path, and conv-on-mesh
-    coverage lives in test_small_cnn_mesh_round below."""
+    coverage lives in test_small_cnn_mesh_round below.
+
+    @slow: ~210 s every run (the mobilenet step compile also defeats the
+    cache), ~25% of the tier-1 wall-clock ceiling.  Tier-1 keeps the same
+    marginal coverage via test_small_cnn_mesh_round (conv through the full
+    vmapped mesh round + server path) and
+    test_cnn_zoo_forward_and_grad[mobilenet] (mobilenet registration +
+    gradient flow)."""
     import fedml_tpu
     from fedml_tpu.runner import FedMLRunner
 
